@@ -1,0 +1,95 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A ground-up rebuild of the reference framework's capabilities
+(PaddlePaddle-compatible user API) designed for TPU: jax/XLA is the kernel
+library and executor, GSPMD/shard_map over `jax.sharding.Mesh` is the
+distributed runtime, and pallas provides fused kernels for the hot ops.
+
+Top-level surface mirrors python/paddle/__init__.py.
+"""
+from __future__ import annotations
+
+import jax as _jax
+import jax.numpy as _jnp
+
+# Enable 64-bit types for paddle parity (int64 indices, optional float64).
+# Python scalars stay weakly typed, so f32 compute paths are unaffected.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+# dtypes
+float16 = _jnp.float16
+bfloat16 = _jnp.bfloat16
+float32 = _jnp.float32
+float64 = _jnp.float64
+int8 = _jnp.int8
+int16 = _jnp.int16
+int32 = _jnp.int32
+int64 = _jnp.int64
+uint8 = _jnp.uint8
+bool = _jnp.bool_
+complex64 = _jnp.complex64
+complex128 = _jnp.complex128
+
+from .tensor_impl import Tensor, Parameter  # noqa: E402,F401
+from .framework import (  # noqa: E402,F401
+    no_grad, enable_grad, set_grad_enabled, set_default_dtype, get_default_dtype,
+    seed, CPUPlace, TPUPlace, CUDAPlace,
+)
+from .framework import random as _fw_random  # noqa: E402
+from .framework import device  # noqa: E402,F401
+from .tensor import *  # noqa: E402,F401,F403
+from .tensor import einsum  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from .autograd import grad  # noqa: E402,F401
+# PENDING from . import nn  # noqa: E402,F401
+# PENDING from . import optimizer  # noqa: E402,F401
+# PENDING from . import io  # noqa: E402,F401
+# PENDING from . import amp  # noqa: E402,F401
+# PENDING from . import jit  # noqa: E402,F401
+# PENDING from . import static  # noqa: E402,F401
+# PENDING from . import distributed  # noqa: E402,F401
+# PENDING from . import vision  # noqa: E402,F401
+# PENDING from . import metric  # noqa: E402,F401
+# PENDING from . import models  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
+# PENDING from . import profiler  # noqa: E402,F401
+# PENDING from . import distribution  # noqa: E402,F401
+# PENDING from . import sparse  # noqa: E402,F401
+# PENDING save/load
+# PENDING from .hapi import Model, summary  # noqa: E402,F401
+# PENDING from . import callbacks  # noqa: E402,F401
+
+from .framework.device import (  # noqa: E402,F401
+    set_device, get_device, is_compiled_with_cuda,
+)
+
+
+def disable_static(place=None):
+    """Dygraph is the default and only eager mode; kept for API parity."""
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for compiled "
+        "execution (XLA Programs replace static-graph Programs).")
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_grad_enabled():
+    from .framework.state import grad_enabled
+    return grad_enabled()
+
+
+def get_flags(flags=None):
+    from . import flags as _flags
+    return _flags.get_flags(flags)
+
+
+def set_flags(flags):
+    from . import flags as _flags
+    return _flags.set_flags(flags)

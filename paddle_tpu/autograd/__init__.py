@@ -1,0 +1,155 @@
+"""paddle_tpu.autograd — eager autograd API.
+
+Parity with python/paddle/autograd: backward, grad, no_grad, PyLayer.
+Functional transforms (jacobian/hessian/vjp/jvp) ride jax directly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.state import no_grad, enable_grad, set_grad_enabled, grad_enabled
+from ..tensor_impl import Tensor, as_tensor_data
+from .node import GradNode
+from .engine import backward, backward_multi, grad, register_tensor_hook
+
+__all__ = [
+    "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+    "PyLayer", "PyLayerContext", "jacobian", "hessian", "vjp", "jvp",
+]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *a):  # API parity no-ops (no aliasing on XLA)
+        pass
+
+    def mark_non_differentiable(self, *a):
+        pass
+
+    def set_materialize_grads(self, v):
+        self._materialize_grads = bool(v)
+
+
+class PyLayer:
+    """Custom op with user-defined backward (ref: python/paddle/autograd/py_layer.py).
+
+    class Tanh(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle_tpu.tanh(x); ctx.save_for_backward(y); return y
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor(); return dy * (1 - y * y)
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_parents = [a for a in args if isinstance(a, Tensor)]
+        needs = grad_enabled() and any(not t.stop_gradient for t in tensor_parents)
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        if not needs:
+            return outputs
+        leaves, treedef = jax.tree_util.tree_flatten(
+            outputs, is_leaf=lambda x: isinstance(x, Tensor))
+        avals = [jax.ShapeDtypeStruct(tuple(l.shape), l.dtype) for l in leaves]
+
+        def vjp_fn(cot_struct):
+            cot_leaves, _ = jax.tree_util.tree_flatten(cot_struct)
+            cot_tensors = [
+                Tensor(c) if not (isinstance(c, np.ndarray) and c.dtype == jax.dtypes.float0)
+                else None for c in cot_leaves]
+            cot_tensors = [c for c in cot_tensors if c is not None]
+            with no_grad():
+                gs = cls.backward(ctx, *cot_tensors)
+            if not isinstance(gs, (tuple, list)):
+                gs = (gs,)
+            out = []
+            for g in gs:
+                out.append(None if g is None else as_tensor_data(g))
+            # pad/truncate to parent count
+            out = (list(out) + [None] * len(tensor_parents))[: len(tensor_parents)]
+            return tuple(out)
+
+        node = GradNode(vjp_fn, tensor_parents, treedef, avals, op_name=cls.__name__)
+        new_leaves = []
+        for i, l in enumerate(leaves):
+            t = Tensor(l._data, stop_gradient=False)
+            t._node = node
+            t._out_idx = i
+            new_leaves.append(t)
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+# -- functional transforms (thin jax bridges) --------------------------------
+def _to_pure(func):
+    def pure(*arrays):
+        tensors = [Tensor(a, stop_gradient=False) for a in arrays]
+        out = func(*tensors)
+        return jax.tree_util.tree_map(
+            as_tensor_data, out, is_leaf=lambda x: isinstance(x, Tensor))
+    return pure
+
+
+def jacobian(func, xs, create_graph=False):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [as_tensor_data(x) for x in xs_list]
+    jac = jax.jacrev(_to_pure(func), argnums=tuple(range(len(arrays))))(*arrays)
+    wrapped = jax.tree_util.tree_map(Tensor, jac)
+    return wrapped if isinstance(xs, (list, tuple)) else (
+        wrapped[0] if isinstance(wrapped, tuple) else wrapped)
+
+
+def hessian(func, xs, create_graph=False):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [as_tensor_data(x) for x in xs_list]
+    h = jax.hessian(_to_pure(func), argnums=tuple(range(len(arrays))))(*arrays)
+    wrapped = jax.tree_util.tree_map(Tensor, h)
+    return wrapped if isinstance(xs, (list, tuple)) else (
+        wrapped[0] if isinstance(wrapped, tuple) else wrapped)
+
+
+def vjp(func, xs, v=None):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [as_tensor_data(x) for x in xs_list]
+    out, pullback = jax.vjp(_to_pure(func), *arrays)
+    if v is None:
+        v_arr = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v_arr = jax.tree_util.tree_map(
+            as_tensor_data, v, is_leaf=lambda x: isinstance(x, Tensor))
+    grads = pullback(v_arr)
+    return (jax.tree_util.tree_map(Tensor, out),
+            jax.tree_util.tree_map(Tensor, grads if isinstance(xs, (list, tuple)) else grads[0]))
+
+
+def jvp(func, xs, v=None):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [as_tensor_data(x) for x in xs_list]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        v_list = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [as_tensor_data(t) for t in v_list]
+    out, tangent_out = jax.jvp(_to_pure(func), tuple(arrays), tuple(tangents))
+    return (jax.tree_util.tree_map(Tensor, out), jax.tree_util.tree_map(Tensor, tangent_out))

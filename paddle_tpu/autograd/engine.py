@@ -1,0 +1,312 @@
+"""Backward engine: reverse-topological walk over the eager tape.
+
+Re-design of the reference's backward engine (ref: paddle/fluid/eager/
+backward.cc `RunBackward`): instead of C++ grad-op kernels we call the stored
+jax.vjp pullbacks; XLA executes the pullback computations on device.
+
+Cotangents flow through the walk as Tensors. With `create_graph=True` each
+pullback is re-derived via jax.vjp over (primals, cotangents) and dispatched
+through `dispatch.apply`, so computed gradients carry their own tape edges back
+to both the primal inputs and the incoming cotangents (full higher-order
+support, e.g. grad-of-grad).
+"""
+from __future__ import annotations
+
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_impl import Tensor
+from ..framework import state as _st
+
+_leaf_hooks = weakref.WeakKeyDictionary()  # Tensor -> [hook]
+
+
+def register_tensor_hook(t: Tensor, hook):
+    """paddle Tensor.register_hook parity. Hook: grad_tensor -> grad_tensor|None."""
+    if t._node is not None:
+        t._node.add_hook(t._out_idx, hook)
+    else:
+        _leaf_hooks.setdefault(t, []).append(hook)
+
+    class _Handle:
+        def remove(self_inner):
+            if t._node is not None and t._node.hooks:
+                hooks = t._node.hooks.get(t._out_idx, [])
+                if hook in hooks:
+                    hooks.remove(hook)
+            elif t in _leaf_hooks and hook in _leaf_hooks[t]:
+                _leaf_hooks[t].remove(hook)
+
+    return _Handle()
+
+
+def _is_float0(x):
+    return isinstance(x, np.ndarray) and x.dtype == jax.dtypes.float0
+
+
+def _zeros_cot(aval):
+    if jnp.issubdtype(aval.dtype, jnp.floating) or jnp.issubdtype(aval.dtype, jnp.complexfloating):
+        return jnp.zeros(aval.shape, aval.dtype)
+    return np.zeros(aval.shape, jax.dtypes.float0)
+
+
+def _acc(a, b):
+    """Accumulate cotangent Tensors; dispatched add when either carries a tape."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a._node is not None or b._node is not None:
+        from ..dispatch import apply
+        return apply(jnp.add, a, b, op_name="grad_acc")
+    return Tensor(a._data + b._data)
+
+
+def _topo_order(root_nodes):
+    """Reverse-topological order via iterative postorder DFS."""
+    visited, order = set(), []
+    for root in root_nodes:
+        if root is None or id(root) in visited:
+            continue
+        stack = [(root, False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                continue
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, True))
+            for p in node.parents:
+                if p is not None and p._node is not None and id(p._node) not in visited:
+                    stack.append((p._node, False))
+    return list(reversed(order))
+
+
+def _call_vjp(node, cots, create_graph):
+    """cots: {out_idx: Tensor}. Returns list of Tensor|None aligned with parents."""
+    full = []
+    for i, av in enumerate(node.out_avals):
+        c = cots.get(i)
+        if c is None:
+            full.append(_zeros_cot(av))
+        else:
+            full.append(c)
+
+    if not create_graph:
+        leaves = [c._data if isinstance(c, Tensor) else c for c in full]
+        struct = jax.tree_util.tree_unflatten(node.out_treedef, leaves)
+        with _st.no_grad():
+            raw = node.vjp_fn(struct)
+        out = []
+        for g in raw:
+            out.append(None if g is None or _is_float0(g) else Tensor(g))
+        return out
+
+    # Higher-order path: re-derive pullback over (primals, cotangents).
+    if node.fwd_fn is None:
+        raise RuntimeError(
+            f"Op {node.op_name} does not support create_graph=True (custom PyLayer "
+            "without double-backward).")
+    tensor_parent_ix = [i for i, p in enumerate(node.parents) if p is not None]
+    real_cot_ix = [i for i, c in enumerate(full) if isinstance(c, Tensor)]
+    raw_leaves = [c._data if isinstance(c, Tensor) else c for c in full]
+    primals0 = node.primals
+    treedef = node.out_treedef
+    fwd = node.fwd_fn
+
+    def fn(*args):
+        k = len(tensor_parent_ix)
+        primals = list(primals0)
+        for j, pi in enumerate(tensor_parent_ix):
+            primals[pi] = args[j]
+        leaves = list(raw_leaves)
+        for j, ci in enumerate(real_cot_ix):
+            leaves[ci] = args[k + j]
+        _, vjp_fn = jax.vjp(fwd, *primals)
+        gs = vjp_fn(jax.tree_util.tree_unflatten(treedef, leaves))
+        # drop float0s (non-differentiable inputs) — they confuse tree wrapping
+        return tuple(g for g in gs if not _is_float0(g))
+
+    inputs = [node.parents[i] for i in tensor_parent_ix] + [full[i] for i in real_cot_ix]
+    from ..dispatch import apply
+    outs = apply(fn, *inputs, op_name=f"{node.op_name}_grad")
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    # re-align to parents: float0 slots (non-float primals) were dropped
+    aligned, it = [], iter(outs)
+    for i, p in enumerate(node.parents):
+        a = node.primals[i]
+        diff = hasattr(a, "dtype") and (
+            jnp.issubdtype(a.dtype, jnp.floating) or jnp.issubdtype(a.dtype, jnp.complexfloating))
+        if diff:
+            aligned.append(next(it, None))
+        else:
+            aligned.append(None)
+    return aligned
+
+
+def run_backward(roots, seeds, retain_graph=False, create_graph=False):
+    """Core walk. roots: list[Tensor]; seeds: list[Tensor] same length.
+    Accumulates into leaf .grad."""
+    _walk(roots, seeds, retain_graph, create_graph, inputs=None, accumulate=True)
+
+
+def _walk(roots, seeds, retain_graph, create_graph, inputs, accumulate):
+    targets = {}
+    results = [None] * (len(inputs) if inputs else 0)
+    leaf_inputs = {}
+    if inputs:
+        for i, t in enumerate(inputs):
+            if t._node is not None:
+                targets.setdefault((id(t._node), t._out_idx), []).append(i)
+            else:
+                leaf_inputs.setdefault(id(t), []).append(i)
+
+    store = {}  # id(node) -> {out_idx: Tensor}
+    node_by_id = {}
+    leaf_grads = {}  # id(tensor) -> (tensor, Tensor grad)
+
+    def add_leaf(t, g):
+        if g is None:
+            return
+        key = id(t)
+        if key in leaf_grads:
+            leaf_grads[key] = (t, _acc(leaf_grads[key][1], g))
+        else:
+            leaf_grads[key] = (t, g)
+
+    root_nodes = []
+    for t, seed in zip(roots, seeds):
+        if t._node is None:
+            if inputs and id(t) in leaf_inputs:
+                for i in leaf_inputs[id(t)]:
+                    results[i] = _acc(results[i], seed)
+            if accumulate and not t.stop_gradient:
+                add_leaf(t, seed)
+            continue
+        node_by_id[id(t._node)] = t._node
+        slot = store.setdefault(id(t._node), {})
+        slot[t._out_idx] = _acc(slot.get(t._out_idx), seed)
+        root_nodes.append(t._node)
+
+    order = _topo_order(root_nodes)
+
+    for node in order:
+        cots = store.pop(id(node), None)
+        if cots is None:
+            continue
+        if node.vjp_fn is None and node.fwd_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time; the saved "
+                "intermediate results were freed. Pass retain_graph=True.")
+        if node.hooks:
+            for idx, hooks in node.hooks.items():
+                if idx in cots and cots[idx] is not None:
+                    for h in hooks:
+                        out = h(cots[idx])
+                        if out is not None:
+                            cots[idx] = out if isinstance(out, Tensor) else Tensor(out)
+        # harvest interior targets
+        for idx, cot in cots.items():
+            key = (id(node), idx)
+            if key in targets and cot is not None:
+                for i in targets[key]:
+                    results[i] = _acc(results[i], cot)
+        in_cots = _call_vjp(node, cots, create_graph)
+        if not retain_graph and not create_graph:
+            node.vjp_fn = None
+            node.fwd_fn = None
+            node.primals = None
+        for parent, g in zip(node.parents, in_cots):
+            if parent is None or g is None:
+                continue
+            if parent._node is None:
+                if inputs and id(parent) in leaf_inputs:
+                    for i in leaf_inputs[id(parent)]:
+                        results[i] = _acc(results[i], g)
+                if accumulate and not parent.stop_gradient:
+                    add_leaf(parent, g)
+            else:
+                slot = store.setdefault(id(parent._node), {})
+                slot[parent._out_idx] = _acc(slot.get(parent._out_idx), g)
+
+    for t, g in leaf_grads.values():
+        for h in _leaf_hooks.get(t, []):
+            out = h(g)
+            if out is not None:
+                g = out if isinstance(out, Tensor) else Tensor(out)
+        if t._grad is None:
+            t._grad = g
+        else:
+            t._grad = _acc(t._grad, g)
+        if not create_graph:
+            t._grad.stop_gradient = True
+    return results
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False):
+    """Tensor.backward(): seed with ones (any shape, paddle semantics)."""
+    if tensor.stop_gradient and tensor._node is None:
+        return
+    if grad_tensor is None:
+        seed = Tensor(jnp.ones(tensor._data.shape, tensor._data.dtype))
+    elif isinstance(grad_tensor, Tensor):
+        seed = grad_tensor
+    else:
+        seed = Tensor(jnp.asarray(grad_tensor).astype(tensor._data.dtype))
+    run_backward([tensor], [seed], retain_graph=retain_graph)
+
+
+def backward_multi(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    tensors = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    seeds = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            seeds.append(Tensor(jnp.ones(t._data.shape, t._data.dtype)))
+        else:
+            seeds.append(g if isinstance(g, Tensor) else Tensor(jnp.asarray(g)))
+    run_backward(tensors, seeds, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (ref: python/paddle/fluid/dygraph/base.py::grad)."""
+    single_out = not isinstance(outputs, (list, tuple))
+    outputs = [outputs] if single_out else list(outputs)
+    inputs_list = [inputs] if not isinstance(inputs, (list, tuple)) else list(inputs)
+    if retain_graph is None:
+        retain_graph = create_graph
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    seeds = []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            seeds.append(Tensor(jnp.ones(t._data.shape, t._data.dtype)))
+        else:
+            seeds.append(g if isinstance(g, Tensor) else Tensor(jnp.asarray(g)))
+    collected = _walk(outputs, seeds, retain_graph, create_graph,
+                      inputs=inputs_list, accumulate=False)
+    res = []
+    for g in collected:
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused in the graph. "
+                    "Set allow_unused=True if this is intended.")
+            res.append(None)
+        else:
+            if not create_graph:
+                g.stop_gradient = True
+            res.append(g)
+    return res

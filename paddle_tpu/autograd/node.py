@@ -1,0 +1,34 @@
+"""Tape node for eager autograd.
+
+Re-design of the reference's per-op GradNode graph (ref: paddle/fluid/eager/
+grad_node_info.h). One node per dispatched op; holds the jax.vjp pullback
+(which owns the saved residuals) and edges to parent tensors.
+"""
+from __future__ import annotations
+
+
+class GradNode:
+    __slots__ = ("vjp_fn", "parents", "out_treedef", "out_avals", "op_name", "hooks",
+                 "fwd_fn", "primals")
+
+    def __init__(self, vjp_fn, parents, out_treedef, out_avals, op_name=None,
+                 fwd_fn=None, primals=None):
+        self.vjp_fn = vjp_fn          # cotangent-pytree -> tuple(input cotangents)
+        self.parents = parents        # list[Tensor | None], aligned with vjp inputs
+        self.out_treedef = out_treedef
+        self.out_avals = out_avals    # list[ShapeDtypeStruct] per output leaf
+        self.op_name = op_name
+        self.hooks = None             # {out_idx: [hook]}
+        # For double-backward (create_graph=True): re-derive the pullback as a
+        # traced op over (primals, cotangents). fwd_fn is the pure forward
+        # closure; primals the original input arrays.
+        self.fwd_fn = fwd_fn
+        self.primals = primals
+
+    def add_hook(self, out_idx, hook):
+        if self.hooks is None:
+            self.hooks = {}
+        self.hooks.setdefault(out_idx, []).append(hook)
+
+    def __repr__(self):
+        return f"GradNode({self.op_name}, n_parents={len(self.parents)})"

@@ -1,0 +1,136 @@
+"""Eager op dispatch.
+
+Every eager paddle_tpu op funnels through `apply(fn, *tensor_inputs, **static_kw)`:
+  * unwraps Tensors to jax arrays,
+  * applies the AMP dtype policy (ref: python/paddle/amp/auto_cast.py op lists),
+  * executes on device via XLA; when any input requires grad, runs through
+    `jax.vjp` so the pullback (with residuals) is recorded on a tape GradNode.
+
+This replaces the reference's C++ dygraph dispatch + PHI kernel selection
+(ref: paddle/fluid/eager/auto_code_generated api, paddle/phi/kernels): XLA is
+the kernel library, the tape is Python-side.
+
+Rules for op implementations: tensor-valued arguments are passed positionally
+(jax types only), all static configuration via keyword closure args.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework import state as _st
+from .tensor_impl import Tensor, as_tensor_data
+from .autograd.node import GradNode
+
+# ---------------------------------------------------------------------------
+# AMP op lists (ref: python/paddle/amp/amp_lists.py). White -> compute in
+# amp dtype (bf16/fp16, feeds the MXU); black -> force fp32 (numerics).
+WHITE_OPS = {
+    "matmul", "bmm", "mm", "mv", "addmm", "linear", "einsum",
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "attention", "flash_attention",
+}
+BLACK_OPS = {
+    "softmax_with_cross_entropy", "cross_entropy", "log_softmax",
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square",
+    "mean", "sum", "prod", "cumsum", "norm", "softmax",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
+    "sigmoid_cross_entropy_with_logits", "cosine_similarity", "erf",
+    "reduce_mean", "reduce_sum", "var", "std", "logsumexp",
+}
+
+_FLOATS = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
+
+
+def _amp_cast(op_name, arrays):
+    level = _st._state.amp_level
+    if level is None or op_name is None:
+        return arrays
+    amp_dtype = _st._state.amp_dtype
+    white = (op_name in WHITE_OPS or op_name in _st._state.amp_custom_white)
+    black = (op_name in BLACK_OPS or op_name in _st._state.amp_custom_black)
+    if black:
+        target = jnp.float32
+    elif white or level == "O2":
+        target = amp_dtype
+    else:
+        return arrays
+
+    def cast(a):
+        if isinstance(a, (jax.Array,)) or hasattr(a, "dtype"):
+            if a.dtype in _FLOATS and a.dtype != jnp.dtype(target):
+                return a.astype(target) if hasattr(a, "astype") else jnp.asarray(a, target)
+        return a
+
+    return [cast(a) for a in arrays]
+
+
+def apply(fn, *inputs, op_name=None, **static_kw):
+    """Dispatch `fn(*arrays, **static_kw)` eagerly with tape recording."""
+    arrays = [as_tensor_data(x) for x in inputs]
+    arrays = _amp_cast(op_name, arrays)
+
+    needs_grad = _st.grad_enabled() and any(
+        isinstance(x, Tensor) and not x.stop_gradient for x in inputs
+    )
+    if static_kw:
+        call = functools.partial(fn, **static_kw)
+    else:
+        call = fn
+
+    if not needs_grad:
+        out = call(*arrays)
+        return _wrap_outputs(out, node=None)
+
+    out, vjp_fn = jax.vjp(call, *arrays)
+    parents = [x if isinstance(x, Tensor) else None for x in inputs]
+    leaves, treedef = jax.tree_util.tree_flatten(out)
+    avals = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+    node = GradNode(vjp_fn, parents, treedef, avals, op_name=op_name,
+                    fwd_fn=call, primals=arrays)
+    return _wrap_outputs(out, node=node)
+
+
+def _wrap_outputs(out, node):
+    leaves, treedef = jax.tree_util.tree_flatten(out)
+    tensors = []
+    for i, leaf in enumerate(leaves):
+        differentiable = jnp.issubdtype(leaf.dtype, jnp.floating) or jnp.issubdtype(
+            leaf.dtype, jnp.complexfloating)
+        t = Tensor(leaf, stop_gradient=not (node is not None and differentiable))
+        if node is not None and differentiable:
+            t._node = node
+            t._out_idx = i
+        tensors.append(t)
+    return jax.tree_util.tree_unflatten(treedef, tensors)
+
+
+def apply_inplace(target: Tensor, fn, *inputs, op_name=None, **static_kw):
+    """Run `fn` like `apply` but rebind the result onto `target` (in-place API).
+
+    The tape must reference the *pre-mutation* value of `target`, so any input
+    aliasing `target` is replaced by a snapshot (otherwise the rebound node
+    would become its own parent)."""
+    snap = None
+    if any(x is target for x in inputs):
+        snap = Tensor(target._data, stop_gradient=target.stop_gradient)
+        snap._node = target._node
+        snap._out_idx = target._out_idx
+        inputs = tuple(snap if x is target else x for x in inputs)
+    result = apply(fn, *inputs, op_name=op_name, **static_kw)
+    assert isinstance(result, Tensor)
+    target._data = result._data
+    target._node = result._node
+    target._out_idx = result._out_idx
+    if result._node is not None:
+        target.stop_gradient = False
+    return target
+
+
+def no_tape_call(fn, *inputs, **static_kw):
+    """Execute without tape regardless of grad mode (utility for inference paths)."""
+    arrays = [as_tensor_data(x) for x in inputs]
+    return _wrap_outputs(fn(*arrays, **static_kw), node=None)

@@ -1,0 +1,21 @@
+from .state import (  # noqa: F401
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+    grad_enabled,
+    set_default_dtype,
+    get_default_dtype,
+    to_jnp_dtype,
+    functional_trace,
+    in_functional_trace,
+)
+from .random import seed, get_seed, next_key, fork_rng  # noqa: F401
+from .device import (  # noqa: F401
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+    Place,
+    set_device,
+    get_device,
+    device_count,
+)

@@ -1,0 +1,81 @@
+"""Device/place API (ref: python/paddle/device/__init__.py).
+
+On TPU there is one accelerator type; jax manages placement. We keep Place
+objects for API parity and route `set_device` to jax default-device selection.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    def __init__(self, kind: str, device_id: int = 0):
+        self._kind = kind
+        self._id = device_id
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._id})" if self._kind != "cpu" else "Place(cpu)"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self._kind, self._id) == (other._kind, other._id)
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu")
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+class CUDAPlace(TPUPlace):
+    """Alias for scripts written against the reference's GPU API: maps to the
+    local accelerator (ref CUDAPlace semantics -> accelerator device n)."""
+
+
+_current = None
+
+
+def _default_kind() -> str:
+    return jax.default_backend()  # "tpu" | "cpu" | ...
+
+
+def set_device(device: str):
+    """paddle.device.set_device("tpu:0"|"cpu"|"gpu:0") parity; gpu maps to tpu."""
+    global _current
+    kind, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    kind = {"gpu": "tpu", "cuda": "tpu", "tpu": "tpu", "cpu": "cpu"}.get(kind, kind)
+    try:
+        dev = jax.devices(kind)[idx]
+    except RuntimeError:
+        dev = jax.devices()[0]
+        kind = dev.platform
+    jax.config.update("jax_default_device", dev)
+    _current = f"{kind}:{idx}" if kind != "cpu" else "cpu"
+    return _current
+
+
+def get_device() -> str:
+    if _current is not None:
+        return _current
+    kind = _default_kind()
+    return "cpu" if kind == "cpu" else f"{kind}:0"
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:  # API parity; we are a TPU framework
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True

@@ -1,0 +1,60 @@
+"""Seeded randomness with explicit key threading.
+
+The reference uses a global stateful generator (ref: python/paddle/framework/random.py,
+paddle/phi/core/generator.cc). On TPU/XLA, stateful RNG breaks trace purity, so we
+keep a host-side splitting key for eager mode and a *fork* mechanism: functional
+code (TrainStep / to_static) installs a traced base key, and every `next_key()`
+inside the region derives from it deterministically via fold_in counters.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.seed = 0
+        self.key = jax.random.key(0)
+        self.forked = None  # (base_key, counter) while inside fork_rng
+        self.philox_counter = 0
+
+
+_rng = _RngState()
+
+
+def seed(s: int):
+    """paddle.seed parity: reset the global generator."""
+    _rng.seed = int(s)
+    _rng.key = jax.random.key(int(s))
+    _rng.philox_counter = 0
+    return _rng
+
+
+def get_seed() -> int:
+    return _rng.seed
+
+
+def next_key():
+    """Return a fresh PRNG key. Inside fork_rng, derives from the forked base key
+    (trace-safe: the sequence is a pure function of the base key)."""
+    if _rng.forked is not None:
+        base, counter = _rng.forked
+        _rng.forked = (base, counter + 1)
+        return jax.random.fold_in(base, counter)
+    _rng.key, sub = jax.random.split(_rng.key)
+    return sub
+
+
+@contextlib.contextmanager
+def fork_rng(base_key):
+    """Install a (possibly traced) base key; next_key() becomes a pure function
+    of it for the duration. Used by functional_call/TrainStep for dropout etc."""
+    prev = _rng.forked
+    _rng.forked = (base_key, 0)
+    try:
+        yield
+    finally:
+        _rng.forked = prev

@@ -1,0 +1,122 @@
+"""Global framework state: grad mode, default dtype, AMP policy.
+
+TPU-native re-design of the reference's global tracer/AMP state
+(ref: python/paddle/fluid/framework.py, python/paddle/amp/auto_cast.py).
+State is plain Python (consulted at op-dispatch time); nothing here is
+traced into XLA programs.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.default_dtype = jnp.float32
+        # AMP: level in {None, "O1", "O2"}; dtype is a jnp dtype
+        self.amp_level = None
+        self.amp_dtype = jnp.bfloat16
+        self.amp_custom_white = set()
+        self.amp_custom_black = set()
+        # When true, op dispatch must not record tape nodes (functional tracing).
+        self.functional_trace = False
+
+
+_state = _State()
+
+
+def grad_enabled() -> bool:
+    return _state.grad_enabled and not _state.functional_trace
+
+
+def set_grad_enabled(mode: bool):
+    """Context manager / direct setter mirroring paddle.set_grad_enabled."""
+    return _GradMode(mode)
+
+
+class _GradMode(contextlib.ContextDecorator):
+    def __init__(self, mode: bool):
+        self._mode = bool(mode)
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = self._mode
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad parity: context manager and decorator."""
+
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+@contextlib.contextmanager
+def functional_trace():
+    """Mark region as functional tracing: no tape recording, pure ops only."""
+    prev = _state.functional_trace
+    _state.functional_trace = True
+    try:
+        yield
+    finally:
+        _state.functional_trace = prev
+
+
+def in_functional_trace() -> bool:
+    return _state.functional_trace
+
+
+_DTYPE_ALIASES = {
+    "float32": jnp.float32, "float64": jnp.float64, "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16, "fp32": jnp.float32, "fp16": jnp.float16,
+    "bf16": jnp.bfloat16, "int32": jnp.int32, "int64": jnp.int64,
+    "int16": jnp.int16, "int8": jnp.int8, "uint8": jnp.uint8,
+    "bool": jnp.bool_, "complex64": jnp.complex64, "complex128": jnp.complex128,
+}
+
+
+def to_jnp_dtype(dtype):
+    """Normalize a paddle-style dtype spec (str / jnp dtype / np dtype) to jnp."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("paddle.", "")
+        if key in _DTYPE_ALIASES:
+            return _DTYPE_ALIASES[key]
+    return jnp.dtype(dtype).type if not hasattr(dtype, "dtype") else dtype
+
+
+def set_default_dtype(dtype):
+    d = to_jnp_dtype(dtype)
+    if jnp.dtype(d).kind != "f":
+        raise TypeError(f"default dtype must be floating, got {dtype}")
+    _state.default_dtype = d
+
+
+def get_default_dtype():
+    return _state.default_dtype

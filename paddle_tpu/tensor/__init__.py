@@ -1,0 +1,175 @@
+"""paddle_tpu.tensor — op surface + Tensor method installation.
+
+Mirrors python/paddle/tensor/__init__.py's monkey-patch approach
+(ref: python/paddle/tensor/__init__.py `tensor_method_func`): ops are defined
+as free functions, then attached as Tensor methods here.
+"""
+from __future__ import annotations
+
+import builtins
+import inspect
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_impl import Tensor, as_tensor_data
+from ..dispatch import apply as _apply, apply_inplace
+from . import creation, random, math, manipulation, linalg, logic, search, stat
+from .einsum import einsum  # noqa: F401
+
+from .creation import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+
+def rank(x):
+    return Tensor(jnp.asarray(as_tensor_data(x).ndim, dtype=jnp.int32))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(as_tensor_data(x).shape, dtype=jnp.int32))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(dtype)
+
+
+def finfo(dtype):
+    return jnp.finfo(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Tensor method installation
+_BINARY_DUNDERS = {
+    "__add__": math.add, "__sub__": math.subtract, "__mul__": math.multiply,
+    "__truediv__": math.divide, "__floordiv__": math.floor_divide,
+    "__mod__": math.mod, "__pow__": math.pow, "__matmul__": math.matmul,
+    "__eq__": logic.equal, "__ne__": logic.not_equal,
+    "__lt__": logic.less_than, "__le__": logic.less_equal,
+    "__gt__": logic.greater_than, "__ge__": logic.greater_equal,
+    "__and__": logic.logical_and, "__or__": logic.logical_or,
+    "__xor__": logic.logical_xor,
+}
+_RBINARY_DUNDERS = {
+    "__radd__": math.add, "__rmul__": math.multiply,
+    "__rsub__": lambda x, y: math.subtract(y, x),
+    "__rtruediv__": lambda x, y: math.divide(y, x),
+    "__rfloordiv__": lambda x, y: math.floor_divide(y, x),
+    "__rmod__": lambda x, y: math.mod(y, x),
+    "__rpow__": lambda x, y: math.pow(y, x),
+    "__rmatmul__": lambda x, y: math.matmul(y, x),
+}
+
+
+def _make_binop(fn, swap=False):
+    def op(self, other):
+        if swap:
+            return fn(self, other)
+        return fn(self, other)
+    return op
+
+
+def _getitem(self, idx):
+    idx = _unwrap_index(idx)
+    return _apply(lambda a: a[idx], self, op_name="getitem")
+
+
+def _setitem(self, idx, value):
+    idx = _unwrap_index(idx)
+    if isinstance(value, Tensor):
+        apply_inplace(self, lambda a, v: a.at[idx].set(v.astype(a.dtype)), self, value,
+                      op_name="setitem")
+    else:
+        apply_inplace(self, lambda a: a.at[idx].set(jnp.asarray(value).astype(a.dtype)),
+                      self, op_name="setitem")
+    return self
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_unwrap_index(i) for i in idx]
+    if isinstance(idx, builtins.slice):
+        return builtins.slice(_maybe_int(idx.start), _maybe_int(idx.stop), _maybe_int(idx.step))
+    return idx
+
+
+def _maybe_int(v):
+    if isinstance(v, Tensor):
+        return int(np.asarray(v._data))
+    return v
+
+
+def _iter(self):
+    for i in range(self.shape[0]):
+        yield self[i]
+
+
+def _install_tensor_methods():
+    for name, fn in _BINARY_DUNDERS.items():
+        setattr(Tensor, name, _make_binop(fn))
+    for name, fn in _RBINARY_DUNDERS.items():
+        setattr(Tensor, name, _make_binop(fn))
+    Tensor.__hash__ = object.__hash__
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__invert__ = lambda self: logic.logical_not(self)
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+    Tensor.__iter__ = _iter
+
+    modules = [math, manipulation, linalg, logic, search, stat]
+    skip = {"einsum"}
+    for mod in modules:
+        for name, fn in vars(mod).items():
+            if name.startswith("_") or name in skip or not callable(fn):
+                continue
+            if inspect.ismodule(fn) or isinstance(fn, type):
+                continue
+            params = list(inspect.signature(fn).parameters)
+            if not params or params[0] not in (
+                    "x", "input", "a", "condition", "sorted_sequence"):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # einsum is not a method; selected creation helpers as methods
+    Tensor.astype = manipulation.cast
+    Tensor.cast = manipulation.cast
+    Tensor.fill_ = manipulation.fill_
+    Tensor.zero_ = manipulation.zero_
+    Tensor.dim = lambda self: self.ndim
+    Tensor.rank = lambda self: self.ndim
+    Tensor.numel = lambda self: self.size
+    Tensor.element_size = lambda self: jnp.dtype(self.dtype).itemsize
+
+    # paddle-style in-place aliases: x.add_(y) etc. rebind data on the object
+    def _make_inplace(fn):
+        def op(self, *args, **kw):
+            snap = Tensor(self._data, stop_gradient=self.stop_gradient)
+            snap._node = self._node
+            snap._out_idx = self._out_idx
+            out = fn(snap, *args, **kw)
+            self._data = out._data
+            self._node = out._node
+            self._out_idx = out._out_idx
+            if out._node is not None:
+                self.stop_gradient = False
+            return self
+        return op
+
+    for base in ("add", "subtract", "multiply", "divide", "clip", "scale", "exp",
+                 "sqrt", "rsqrt", "floor", "ceil", "round", "reciprocal", "abs",
+                 "tanh", "sigmoid", "pow"):
+        fn = getattr(math, base)
+        setattr(Tensor, base + "_", _make_inplace(fn))
+
+
+_install_tensor_methods()

@@ -1,0 +1,186 @@
+"""Tensor creation ops (ref: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, as_tensor_data
+from ..dispatch import apply as _apply
+from ..framework.state import get_default_dtype, to_jnp_dtype
+
+
+def _norm_dtype(dtype, default=None):
+    d = to_jnp_dtype(dtype)
+    return d if d is not None else default
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        arr = data._data
+    else:
+        arr = np.asarray(data) if not hasattr(data, "dtype") else data
+        if hasattr(arr, "dtype") and arr.dtype == np.float64 and dtype is None:
+            # paddle maps python/np float64 input to default dtype
+            if not (isinstance(data, np.ndarray) and data.dtype == np.float64):
+                arr = arr.astype(np.float32)
+    arr = jnp.asarray(arr)
+    d = _norm_dtype(dtype)
+    if d is not None:
+        arr = arr.astype(d)
+    elif jnp.issubdtype(arr.dtype, jnp.floating) and not isinstance(data, (Tensor, np.ndarray)) \
+            and not hasattr(data, "dtype"):
+        arr = arr.astype(get_default_dtype())
+    t = Tensor(arr, stop_gradient=stop_gradient)
+    return t
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _norm_dtype(dtype, get_default_dtype())))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _norm_dtype(dtype, get_default_dtype())))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = as_tensor_data(fill_value)
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = jnp.int64
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, _norm_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(as_tensor_data(x), dtype=_norm_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(as_tensor_data(x), dtype=_norm_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(as_tensor_data(x), as_tensor_data(fill_value),
+                                dtype=_norm_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = as_tensor_data(start)
+    end = as_tensor_data(end) if end is not None else None
+    step = as_tensor_data(step)
+    if end is None:
+        start, end = 0, start
+    d = _norm_dtype(dtype)
+    if d is None:
+        py = [x for x in (start, end, step) if isinstance(x, (int, float))]
+        d = jnp.int64 if all(isinstance(x, int) for x in (start, end, step)
+                             if isinstance(x, (int, float))) and len(py) else get_default_dtype()
+        for x in (start, end, step):
+            if hasattr(x, "dtype"):
+                d = x.dtype
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(as_tensor_data(start), as_tensor_data(stop), int(num),
+                               dtype=_norm_dtype(dtype, get_default_dtype())))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(as_tensor_data(start), as_tensor_data(stop), int(num),
+                               base=base, dtype=_norm_dtype(dtype, get_default_dtype())))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns if num_columns is None else int(num_columns),
+                          dtype=_norm_dtype(dtype, get_default_dtype())))
+
+
+def tril(x, diagonal=0, name=None):
+    return _apply(lambda a: jnp.tril(a, k=int(diagonal)), x, op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return _apply(lambda a: jnp.triu(a, k=int(diagonal)), x, op_name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_norm_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_norm_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[as_tensor_data(t) for t in tensors], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(int(offset))
+            base = jnp.full((n, n), padding_value, a.dtype)
+            return base + jnp.diag(a, k=int(offset)) - jnp.diag(
+                jnp.full((a.shape[0],), padding_value, a.dtype), k=int(offset))
+        return jnp.diag(a, k=int(offset))
+    return _apply(f, x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return _apply(lambda a: jnp.diagflat(a, k=int(offset)), x, op_name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def f(a):
+        n = a.shape[-1] + abs(int(offset))
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-int(offset), 0)
+        c = idx + max(int(offset), 0)
+        out = out.at[..., r, c].set(a)
+        return jnp.moveaxis(jnp.moveaxis(out, -2, dim1), -1, dim2) if (dim1, dim2) != (-2, -1) else out
+    return _apply(f, x, op_name="diag_embed")
+
+
+def assign(x, output=None):
+    data = as_tensor_data(x)
+    data = jnp.asarray(data)
+    if output is None:
+        return Tensor(data)
+    output.set_value(data)
+    return output
+
+
+def numel(x):
+    a = as_tensor_data(x)
+    return Tensor(jnp.asarray(int(np.prod(a.shape)) if a.shape else 1, dtype=jnp.int64))
+
+
+def clone(x):
+    return x.clone() if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(as_tensor_data(s)) if not isinstance(s, (int, np.integer)) else int(s)
+                 for s in shape)

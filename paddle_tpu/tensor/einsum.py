@@ -1,0 +1,13 @@
+"""einsum (ref: python/paddle/tensor/einsum.py). XLA maps contractions to MXU."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..dispatch import apply as _apply
+
+
+def einsum(equation, *operands):
+    if not isinstance(equation, str):
+        # paddle also allows einsum(op0, op1, ..., equation=...) — not supported
+        raise TypeError("einsum equation must be a string")
+    return _apply(lambda *arrs: jnp.einsum(equation, *arrs), *operands, op_name="einsum")

@@ -1,0 +1,236 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py, einsum.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, as_tensor_data
+from ..dispatch import apply as _apply
+from .math import _ax, matmul, mm, bmm, mv, dot  # noqa: F401  (re-export surface)
+
+
+def t(x, name=None):
+    def f(a):
+        if a.ndim < 2:
+            return a
+        if a.ndim == 2:
+            return a.T
+        raise ValueError("paddle.t only supports ndim<=2; use transpose")
+    return _apply(f, x, op_name="t")
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        ax = _ax(axis)
+        if p == "fro" or (p == 2 and ax is None):
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return jnp.sum(s, axis=-1, keepdims=keepdim)
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim), 1.0 / p)
+    return _apply(f, x, op_name="norm")
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    def f(a):
+        return jnp.linalg.norm(a, ord=None if p == "fro" else p, axis=tuple(axis),
+                               keepdims=keepdim)
+    return _apply(f, x, op_name="matrix_norm")
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = a - b
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+    return _apply(f, x, y, op_name="dist")
+
+
+def cond(x, p=None, name=None):
+    return _apply(lambda a: jnp.linalg.cond(a, p=p), x, op_name="cond")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return _apply(f, x, op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return _apply(f, x, y, op_name="cholesky_solve")
+
+
+def qr(x, mode="reduced", name=None):
+    out = _apply(lambda a: jnp.linalg.qr(a, mode=mode), x, op_name="qr")
+    return out if mode != "r" else out
+
+
+def svd(x, full_matrices=False, name=None):
+    return _apply(lambda a: jnp.linalg.svd(a, full_matrices=full_matrices),
+                  x, op_name="svd")
+
+
+def svdvals(x):
+    return _apply(lambda a: jnp.linalg.svd(a, compute_uv=False), x, op_name="svdvals")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _apply(lambda a: jnp.linalg.pinv(a, rcond=rcond, hermitian=hermitian),
+                  x, op_name="pinv")
+
+
+def inv(x, name=None):
+    return _apply(jnp.linalg.inv, x, op_name="inv")
+
+
+def solve(x, y, name=None):
+    return _apply(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return _apply(f, x, y, op_name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return _apply(f, x, y, op_name="lstsq")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, (piv + 1).astype(jnp.int32)  # paddle returns 1-based pivots
+    out = _apply(f, x, op_name="lu")
+    if get_infos:
+        lu_mat, piv = out
+        return lu_mat, piv, Tensor(jnp.zeros((), jnp.int32))
+    return out
+
+
+def eig(x, name=None):
+    a = np.asarray(as_tensor_data(x))
+    w, v = np.linalg.eig(a)  # XLA lacks nonsymmetric eig on TPU; host fallback
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return _apply(lambda a: jnp.linalg.eigh(a, symmetrize_input=True), x, op_name="eigh")
+
+
+def eigvals(x, name=None):
+    a = np.asarray(as_tensor_data(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _apply(lambda a: jnp.linalg.eigvalsh(a), x, op_name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    return _apply(lambda a: jnp.linalg.matrix_power(a, int(n)), x, op_name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    def f(a):
+        return jnp.linalg.matrix_rank(a, tol=as_tensor_data(tol) if tol is not None else None)
+    return _apply(f, x, op_name="matrix_rank")
+
+
+def multi_dot(x, name=None):
+    return _apply(lambda *arrs: jnp.linalg.multi_dot(arrs), *x, op_name="multi_dot")
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return _apply(f, x, y, op_name="cross")
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    a = np.asarray(as_tensor_data(x))
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    hist, _ = np.histogram(a, bins=int(bins), range=(float(lo), float(hi)))
+    return Tensor(jnp.asarray(hist, dtype=jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    def f(a, *w):
+        return jnp.bincount(a.astype(jnp.int32), weights=w[0] if w else None,
+                            minlength=int(minlength),
+                            length=None)
+    a = np.asarray(as_tensor_data(x))
+    length = max(int(a.max()) + 1 if a.size else 0, int(minlength))
+    def g(arr, *w):
+        return jnp.bincount(arr.astype(jnp.int32), weights=w[0] if w else None, length=length)
+    if weights is not None:
+        return _apply(g, x, weights, op_name="bincount")
+    return _apply(g, x, op_name="bincount")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, op_name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+                  x, op_name="cov")
+
+
+def det(x, name=None):
+    return _apply(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return _apply(f, x, op_name="slogdet")
+
+
+def matrix_exp(x, name=None):
+    return _apply(jax.scipy.linalg.expm, x, op_name="matrix_exp")
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+        idx = jnp.arange(m)
+        for i in range(n):
+            # Householder vector: v[i]=1, v[>i]=a[>i, i], v[<i]=0
+            v = jnp.where(idx == i, jnp.ones((), a.dtype),
+                          jnp.where(idx > i, a[..., :, i], jnp.zeros((), a.dtype)))
+            h = jnp.eye(m, dtype=a.dtype) - t_[..., i, None, None] * jnp.einsum(
+                "...i,...j->...ij", v, v)
+            q = q @ h
+        return q[..., :, :n]
+    return _apply(f, x, tau, op_name="householder_product")

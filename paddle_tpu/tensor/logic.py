@@ -1,0 +1,72 @@
+"""Comparison & logical ops (ref: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_impl import Tensor, as_tensor_data
+from ..dispatch import apply as _apply
+
+
+def _cmp(jfn, name):
+    def op(x, y, name_=None):
+        return _apply(jfn, x, y, op_name=name)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(lambda a, b: jnp.equal(a, b), "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, name=None):
+    return _apply(jnp.logical_not, x, op_name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return _apply(jnp.bitwise_not, x, op_name="bitwise_not")
+
+
+def equal_all(x, y, name=None):
+    return _apply(lambda a, b: jnp.array_equal(a, b), x, y, op_name="equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                  x, y, op_name="allclose")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                  x, y, op_name="isclose")
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    a = as_tensor_data(x)
+    return Tensor(jnp.asarray(int(np.prod(a.shape)) == 0))
+
+
+def is_complex(x):
+    return jnp.issubdtype(as_tensor_data(x).dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(as_tensor_data(x).dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(as_tensor_data(x).dtype, jnp.floating)

@@ -1,0 +1,425 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py).
+
+XLA arrays are immutable; ops like scatter/put_along_axis lower to
+`.at[...]` functional updates (XLA scatter HLO) instead of in-place writes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, as_tensor_data
+from ..dispatch import apply as _apply
+from ..framework.state import to_jnp_dtype
+from .math import _ax
+
+
+def cast(x, dtype):
+    d = to_jnp_dtype(dtype)
+    return _apply(lambda a: a.astype(d), x, op_name="cast")
+
+
+astype = cast
+
+
+def reshape(x, shape, name=None):
+    shape = _static_shape(shape)
+    return _apply(lambda a: jnp.reshape(a, shape), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    from ..dispatch import apply_inplace
+    shape = _static_shape(shape)
+    return apply_inplace(x, lambda a: jnp.reshape(a, shape), x, op_name="reshape")
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(as_tensor_data(s)) for s in shape)
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return _apply(lambda a: jnp.transpose(a, perm), x, op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return _apply(lambda a: jnp.moveaxis(a, source, destination), x, op_name="moveaxis")
+
+
+def swapaxes(x, axis1, axis2):
+    return _apply(lambda a: jnp.swapaxes(a, int(axis1), int(axis2)), x, op_name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    axis = int(as_tensor_data(axis))
+    tensors = list(x)
+    return _apply(lambda *arrs: jnp.concatenate(arrs, axis=axis), *tensors, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return _apply(lambda *arrs: jnp.stack(arrs, axis=int(axis)), *tensors, op_name="stack")
+
+
+def unstack(x, axis=0, num=None):
+    def f(a):
+        n = num if num is not None else a.shape[axis]
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+    return list(_apply(f, x, op_name="unstack"))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(as_tensor_data(axis))
+
+    def f(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        sections = [int(as_tensor_data(s)) for s in num_or_sections]
+        total = a.shape[axis]
+        known = [s for s in sections if s != -1]
+        sections2 = [s if s != -1 else total - int(np.sum(known)) for s in sections]
+        splits = np.cumsum(sections2)[:-1].tolist()
+        return tuple(jnp.split(a, splits, axis=axis))
+    return list(_apply(f, x, op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    def f(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=int(axis)))
+    return list(_apply(f, x, op_name="tensor_split"))
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        ax = _ax(axis)
+        if ax is None:
+            return jnp.squeeze(a)
+        if isinstance(ax, int):
+            ax = (ax,)
+        ax = tuple(a_ for a_ in ax if a.shape[a_] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+    return _apply(f, x, op_name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _ax(axis)
+    return _apply(lambda a: jnp.expand_dims(a, ax), x, op_name="unsqueeze")
+
+
+squeeze_ = squeeze
+unsqueeze_ = unsqueeze
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        if nd == 0:
+            return a.reshape(1)
+        s, e = start_axis % nd, stop_axis % nd
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return a.reshape(new_shape)
+    return _apply(f, x, op_name="flatten")
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(int(as_tensor_data(r)) for r in repeat_times) \
+        if not isinstance(repeat_times, int) else (int(repeat_times),)
+    return _apply(lambda a: jnp.tile(a, reps), x, op_name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = _static_shape(shape)
+
+    def f(a):
+        tgt = list(shape)
+        src = list(a.shape)
+        # -1 keeps the source dim; align from the right
+        off = len(tgt) - len(src)
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = src[i - off] if i >= off else 1
+        return jnp.broadcast_to(a, tuple(tgt))
+    return _apply(f, x, op_name="expand")
+
+
+def broadcast_to(x, shape, name=None):
+    shape = _static_shape(shape)
+    return _apply(lambda a: jnp.broadcast_to(a, shape), x, op_name="broadcast_to")
+
+
+def expand_as(x, y, name=None):
+    return _apply(lambda a, b: jnp.broadcast_to(a, b.shape), x, y, op_name="expand_as")
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(_apply(lambda *arrs: jnp.broadcast_arrays(*arrs), *inputs,
+                       op_name="broadcast_tensors"))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(as_tensor_data(axis))
+    return _apply(lambda a, i: jnp.take(a, i.astype(jnp.int32).reshape(-1), axis=axis),
+                  x, index, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+    return _apply(f, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        # paddle overwrite=False: zero out target rows then accumulate
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+    return _apply(f, x, index, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True):
+    from ..dispatch import apply_inplace
+    def f(a, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+    return apply_inplace(x, f, x, index, updates, op_name="scatter")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shape = _static_shape(shape)
+    def f(idx, u):
+        idx = idx.astype(jnp.int32)
+        out = jnp.zeros(shape, u.dtype)
+        return out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return _apply(f, index, updates, op_name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, u):
+        idx = idx.astype(jnp.int32)
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return _apply(f, x, index, updates, op_name="scatter_nd_add")
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape) if v.shape != i.shape else v
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v.astype(a.dtype), axis=int(axis), inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply"}[reduce]
+        # emulate via take/put loop-free: use at[] with open_indices
+        idx = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(a.ndim)])
+               for k, s in enumerate(i.shape)]
+        idx[int(axis) % a.ndim] = i
+        if mode == "add":
+            return a.at[tuple(idx)].add(v.astype(a.dtype))
+        return a.at[tuple(idx)].multiply(v.astype(a.dtype))
+    return _apply(f, x, indices, values if isinstance(values, Tensor) else jnp.asarray(values),
+                  op_name="put_along_axis")
+
+
+def take_along_axis(x, indices, axis, name=None):
+    return _apply(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=int(axis)),
+                  x, indices, op_name="take_along_axis")
+
+
+def index_select(x, index, axis=0, name=None):
+    return _apply(lambda a, i: jnp.take(a, i.astype(jnp.int32).reshape(-1), axis=int(axis)),
+                  x, index, op_name="index_select")
+
+
+def index_sample(x, index):
+    def f(a, i):
+        return jnp.take_along_axis(a, i.astype(jnp.int32), axis=1)
+    return _apply(f, x, index, op_name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32).reshape(-1)
+        moved = jnp.moveaxis(a, int(axis), 0)
+        vm = jnp.moveaxis(v, int(axis), 0)
+        out = moved.at[i].add(vm.astype(a.dtype))
+        return jnp.moveaxis(out, 0, int(axis))
+    return _apply(f, x, index, value, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(a, v, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i
+                    for i in idx)
+        if accumulate:
+            return a.at[idx].add(v.astype(a.dtype))
+        return a.at[idx].set(v.astype(a.dtype))
+    return _apply(f, x, value, *indices, op_name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    # dynamic-shape op: eager only (same as reference's dygraph-only usage)
+    a, m = as_tensor_data(x), as_tensor_data(mask)
+    return Tensor(a[np.asarray(m).astype(bool)])
+
+
+def masked_fill(x, mask, value, name=None):
+    return _apply(lambda a, m: jnp.where(m, jnp.asarray(as_tensor_data(value), a.dtype), a),
+                  x, mask, op_name="masked_fill")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _apply(lambda a: jnp.roll(a, shifts, axis=_ax(axis)), x, op_name="roll")
+
+
+def flip(x, axis, name=None):
+    return _apply(lambda a: jnp.flip(a, axis=_ax(axis)), x, op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, op_name="rot90")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y, op_name="where")
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(as_tensor_data(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v, dtype=jnp.int64)) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        def f(a):
+            return jnp.repeat(a, reps, axis=_ax(axis), total_repeat_length=int(reps.sum()))
+        return _apply(f, x, op_name="repeat_interleave")
+    return _apply(lambda a: jnp.repeat(a, int(repeats), axis=_ax(axis)),
+                  x, op_name="repeat_interleave")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn import functional as F
+    return F.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def slice(x, axes, starts, ends):
+    def f(a):
+        idx = [np.s_[:]] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            s = int(as_tensor_data(s)); e = int(as_tensor_data(e))
+            idx[ax] = np.s_[s:e]
+        return a[tuple(idx)]
+    return _apply(f, x, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    def f(a):
+        idx = [np.s_[:]] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = np.s_[int(s):int(e):int(st)]
+        return a[tuple(idx)]
+    return _apply(f, x, op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def f(a):
+        shp = _static_shape(shape)
+        offs = [0] * a.ndim if offsets is None else [int(as_tensor_data(o)) for o in offsets]
+        idx = tuple(np.s_[o:o + (s if s != -1 else a.shape[d] - o)]
+                    for d, (o, s) in enumerate(zip(offs, shp)))
+        return a[idx]
+    return _apply(f, x, op_name="crop")
+
+
+def as_real(x):
+    def f(a):
+        return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
+    return _apply(f, x, op_name="as_real")
+
+
+def as_complex(x):
+    return _apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, op_name="as_complex")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs):
+    outs = [_apply(jnp.atleast_1d, x, op_name="atleast_1d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = [_apply(jnp.atleast_2d, x, op_name="atleast_2d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = [_apply(jnp.atleast_3d, x, op_name="atleast_3d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def unfold(x, axis, size, step, name=None):
+    def f(a):
+        n = (a.shape[axis] - size) // step + 1
+        slices = [jax.lax.dynamic_slice_in_dim(a, int(s), size, axis)
+                  for s in range(0, n * step, step)]
+        return jnp.stack(slices, axis=axis)
+    return _apply(f, x, op_name="unfold")
+
+
+def fill_(x, value):
+    x._data = jnp.full_like(x._data, as_tensor_data(value))
+    return x
+
+
+def zero_(x):
+    x._data = jnp.zeros_like(x._data)
+    return x
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False):
+    a = x._data
+    n = min(a.shape[-2], a.shape[-1])
+    i = jnp.arange(n - abs(int(offset)))
+    r = i + max(-int(offset), 0)
+    c = i + max(int(offset), 0)
+    x._data = a.at[..., r, c].set(value)
+    return x

@@ -1,0 +1,371 @@
+"""Elementwise & reduction math ops (ref: python/paddle/tensor/math.py, ops.py).
+
+Every op dispatches through `paddle_tpu.dispatch.apply`, so it is eager,
+tape-recorded, and AMP-aware. On TPU these all lower to XLA HLO; elementwise
+chains fuse into neighboring MXU ops automatically.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, as_tensor_data
+from ..dispatch import apply as _apply
+from ..framework.state import to_jnp_dtype
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = np.asarray(axis._data).tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _unary(jfn, name):
+    def op(x, name_=None, **kw):
+        return _apply(jfn, x, op_name=name)
+    op.__name__ = name
+    return op
+
+
+def _binary(jfn, name):
+    def op(x, y, name_=None):
+        return _apply(jfn, x, y, op_name=name)
+    op.__name__ = name
+    return op
+
+
+# -- elementwise unary -------------------------------------------------------
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+abs = _unary(jnp.abs, "abs")
+neg = _unary(jnp.negative, "neg")
+square = _unary(jnp.square, "square")
+sign = _unary(jnp.sign, "sign")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda a: a - jnp.trunc(a), "frac")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+i0 = _unary(jax.scipy.special.i0, "i0")
+i1 = _unary(jax.scipy.special.i1, "i1")
+angle = _unary(jnp.angle, "angle")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+
+# -- elementwise binary ------------------------------------------------------
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+mod = _binary(jnp.mod, "mod")
+remainder = mod
+floor_mod = mod
+pow = _binary(lambda a, b: jnp.power(a, b), "pow")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+hypot = _binary(jnp.hypot, "hypot")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+heaviside = _binary(jnp.heaviside, "heaviside")
+nextafter = _binary(jnp.nextafter, "nextafter")
+copysign = _binary(jnp.copysign, "copysign")
+gcd = _binary(jnp.gcd, "gcd")
+lcm = _binary(jnp.lcm, "lcm")
+ldexp = _binary(jnp.ldexp, "ldexp")
+inner = _binary(jnp.inner, "inner")
+outer = _binary(lambda a, b: jnp.outer(a, b), "outer")
+kron = _binary(jnp.kron, "kron")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+    out = _apply(f, x, as_tensor_data(scale), as_tensor_data(bias), op_name="scale")
+    if act == "relu":
+        return _apply(jax.nn.relu, out, op_name="relu")
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, op_name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))).astype(jnp.int32),
+            axis=0)[0]
+    return _apply(f, index, *inputs, op_name="multiplex")
+
+
+def lerp(x, y, weight, name=None):
+    return _apply(lambda a, b, w: a + w * (b - a), x, y,
+                  weight if isinstance(weight, Tensor) else as_tensor_data(weight),
+                  op_name="lerp")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = as_tensor_data(min) if min is not None else None
+    hi = as_tensor_data(max) if max is not None else None
+    return _apply(lambda a: jnp.clip(a, lo, hi), x, op_name="clip")
+
+
+def isnan(x, name=None):
+    return _apply(jnp.isnan, x, op_name="isnan")
+
+
+def isinf(x, name=None):
+    return _apply(jnp.isinf, x, op_name="isinf")
+
+
+def isfinite(x, name=None):
+    return _apply(jnp.isfinite, x, op_name="isfinite")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                  x, op_name="nan_to_num")
+
+
+# -- reductions --------------------------------------------------------------
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = to_jnp_dtype(dtype)
+    return _apply(lambda a: jnp.sum(a, axis=_ax(axis), keepdims=keepdim, dtype=d),
+                  x, op_name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda a: jnp.mean(a, axis=_ax(axis), keepdims=keepdim),
+                  x, op_name="mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = to_jnp_dtype(dtype)
+    return _apply(lambda a: jnp.prod(a, axis=_ax(axis), keepdims=keepdim, dtype=d),
+                  x, op_name="prod")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda a: jnp.max(a, axis=_ax(axis), keepdims=keepdim), x, op_name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda a: jnp.min(a, axis=_ax(axis), keepdims=keepdim), x, op_name="min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda a: jax.scipy.special.logsumexp(a, axis=_ax(axis), keepdims=keepdim),
+                  x, op_name="logsumexp")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = to_jnp_dtype(dtype)
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=_ax(axis), dtype=d)
+    return _apply(f, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = to_jnp_dtype(dtype)
+    def f(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1), dtype=d)
+        return jnp.cumprod(a, axis=_ax(dim), dtype=d)
+    return _apply(f, x, op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = _ax(axis) if axis is not None else 0
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.maximum, arr, axis=ax if axis is not None else 0)
+        eq = arr == vals
+        idx = jnp.arange(arr.shape[ax if axis is not None else 0])
+        shape = [1] * arr.ndim
+        shape[ax if axis is not None else 0] = -1
+        idxs = jnp.where(eq, idx.reshape(shape), 0)
+        indices = jax.lax.associative_scan(jnp.maximum, idxs, axis=ax if axis is not None else 0)
+        return vals, indices.astype(to_jnp_dtype(dtype))
+    return _apply(f, x, op_name="cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = _ax(axis) if axis is not None else 0
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.minimum, arr, axis=ax)
+        eq = arr == vals
+        idx = jnp.arange(arr.shape[ax])
+        shape = [1] * arr.ndim
+        shape[ax] = -1
+        idxs = jnp.where(eq, idx.reshape(shape), 0)
+        indices = jax.lax.associative_scan(jnp.maximum, idxs, axis=ax)
+        return vals, indices.astype(to_jnp_dtype(dtype))
+    return _apply(f, x, op_name="cummin")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _apply(lambda a: jnp.nansum(a, axis=_ax(axis), keepdims=keepdim,
+                                       dtype=to_jnp_dtype(dtype)), x, op_name="nansum")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda a: jnp.nanmean(a, axis=_ax(axis), keepdims=keepdim),
+                  x, op_name="nanmean")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda a: jnp.count_nonzero(a, axis=_ax(axis), keepdims=keepdim)
+                  .astype(jnp.int64), x, op_name="count_nonzero")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda a: jnp.all(a, axis=_ax(axis), keepdims=keepdim), x, op_name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda a: jnp.any(a, axis=_ax(axis), keepdims=keepdim), x, op_name="any")
+
+
+# -- matmul-class (MXU) ------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return _apply(f, x, y, op_name="matmul")
+
+
+def mm(x, y, name=None):
+    return _apply(jnp.matmul, x, y, op_name="mm")
+
+
+def bmm(x, y, name=None):
+    return _apply(jnp.matmul, x, y, op_name="bmm")
+
+
+def mv(x, vec, name=None):
+    return _apply(lambda a, v: a @ v, x, vec, op_name="mv")
+
+
+def dot(x, y, name=None):
+    return _apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, op_name="matmul")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, op_name="addmm")
+
+
+def inverse(x, name=None):
+    return _apply(jnp.linalg.inv, x, op_name="inverse")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                  x, op_name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                  x, op_name="diagonal")
+
+
+# -- sort/search-class (kept here for paddle.math parity surface) ------------
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmax(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else _ax(axis))
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, _ax(axis))
+        elif keepdim:
+            out = out.reshape((1,) * a.ndim)
+        return out.astype(to_jnp_dtype(dtype))
+    return _apply(f, x, op_name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmin(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else _ax(axis))
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, _ax(axis))
+        elif keepdim:
+            out = out.reshape((1,) * a.ndim)
+        return out.astype(to_jnp_dtype(dtype))
+    return _apply(f, x, op_name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=_ax(axis), descending=descending)
+        return idx.astype(jnp.int64)
+    return _apply(f, x, op_name="argsort")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=_ax(axis))
+        return jnp.flip(out, axis=_ax(axis)) if descending else out
+    return _apply(f, x, op_name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(as_tensor_data(k))
+    def f(a):
+        ax = -1 if axis is None else _ax(axis)
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+    return _apply(f, x, op_name="topk")

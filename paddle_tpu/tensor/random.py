@@ -1,0 +1,118 @@
+"""Random ops (ref: python/paddle/tensor/random.py).
+
+All draws consume keys from the seeded global generator
+(paddle_tpu.framework.random); inside functional traces the keys derive from
+the traced base key, keeping jit'd programs pure and reproducible.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, as_tensor_data
+from ..framework.random import next_key
+from ..framework.state import get_default_dtype, to_jnp_dtype
+from .creation import _shape, _norm_dtype
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    d = _norm_dtype(dtype, get_default_dtype())
+    return Tensor(jax.random.normal(next_key(), _shape(shape), dtype=d))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor_data(mean)
+        s = as_tensor_data(std)
+        out_shape = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ()))
+        return Tensor(m + s * jax.random.normal(next_key(), out_shape, dtype=get_default_dtype()))
+    d = get_default_dtype()
+    return Tensor(mean + std * jax.random.normal(next_key(), _shape(shape or [1]), dtype=d))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = _norm_dtype(dtype, get_default_dtype())
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=d,
+                                     minval=as_tensor_data(min), maxval=as_tensor_data(max)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = _norm_dtype(dtype, jnp.int64)
+    return Tensor(jax.random.randint(next_key(), _shape(shape), int(low), int(high), dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    a = as_tensor_data(x)
+    return randint(low, high, a.shape, dtype or a.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(to_jnp_dtype(dtype)))
+
+
+def shuffle(x, axis=0):
+    a = as_tensor_data(x)
+    return Tensor(jax.random.permutation(next_key(), a, axis=axis, independent=False))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    a = as_tensor_data(x)
+    logits = jnp.log(jnp.maximum(a, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(*a.shape[:-1], int(num_samples)))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(next_key(), a.shape, dtype=logits.dtype)
+        _, out = jax.lax.top_k(logits + g, int(num_samples))
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    a = as_tensor_data(x)
+    return Tensor(jax.random.bernoulli(next_key(), a).astype(a.dtype))
+
+
+def poisson(x, name=None):
+    a = as_tensor_data(x)
+    return Tensor(jax.random.poisson(next_key(), a, dtype=jnp.int64).astype(a.dtype))
+
+
+def exponential_(x, lam=1.0):
+    a = as_tensor_data(x)
+    out = jax.random.exponential(next_key(), a.shape, dtype=a.dtype) / lam
+    if isinstance(x, Tensor):
+        x._data = out
+        return x
+    return Tensor(out)
+
+
+def normal_(x, mean=0.0, std=1.0):
+    a = as_tensor_data(x)
+    out = mean + std * jax.random.normal(next_key(), a.shape, dtype=a.dtype)
+    if isinstance(x, Tensor):
+        x._data = out
+        return x
+    return Tensor(out)
+
+
+def uniform_(x, min=-1.0, max=1.0):
+    a = as_tensor_data(x)
+    out = jax.random.uniform(next_key(), a.shape, dtype=a.dtype, minval=min, maxval=max)
+    if isinstance(x, Tensor):
+        x._data = out
+        return x
+    return Tensor(out)

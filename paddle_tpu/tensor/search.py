@@ -1,0 +1,79 @@
+"""Search ops (ref: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, as_tensor_data
+from ..dispatch import apply as _apply
+from .math import argmax, argmin, argsort, sort, topk  # noqa: F401
+from .manipulation import masked_select, nonzero, where, index_select, index_sample  # noqa: F401
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return _apply(f, sorted_sequence, values, op_name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output shape: host computation (same as reference dygraph semantics)
+    a = np.asarray(as_tensor_data(x))
+    out = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(out, tuple):
+        return Tensor(jnp.asarray(out))
+    res = [Tensor(jnp.asarray(out[0]))]
+    for extra in out[1:]:
+        res.append(Tensor(jnp.asarray(extra.astype(np.int64))))
+    return tuple(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(as_tensor_data(x))
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    if a.size == 0:
+        vals = a
+        inverse = np.zeros(0, np.int64)
+        counts = np.zeros(0, np.int64)
+    else:
+        sl = [np.s_[:]] * a.ndim
+        sl[ax] = np.s_[1:]
+        sl_prev = [np.s_[:]] * a.ndim
+        sl_prev[ax] = np.s_[:-1]
+        diff = np.any(a[tuple(sl)] != a[tuple(sl_prev)],
+                      axis=tuple(i for i in range(a.ndim) if i != ax)) \
+            if a.ndim > 1 else a[1:] != a[:-1]
+        keep = np.concatenate([[True], diff])
+        vals = np.compress(keep, a, axis=ax)
+        group = np.cumsum(keep) - 1
+        inverse = group.astype(np.int64)
+        counts = np.bincount(group).astype(np.int64)
+    res = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        res.append(Tensor(jnp.asarray(inverse)))
+    if return_counts:
+        res.append(Tensor(jnp.asarray(counts)))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return _apply(lambda a, b: jnp.isin(a, b, invert=invert), x, test_x, op_name="isin")

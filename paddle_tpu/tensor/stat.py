@@ -1,0 +1,91 @@
+"""Statistics ops (ref: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..dispatch import apply as _apply
+from .math import _ax
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda a: jnp.mean(a, axis=_ax(axis), keepdims=keepdim), x, op_name="mean")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _apply(lambda a: jnp.var(a, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), x, op_name="var")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _apply(lambda a: jnp.std(a, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), x, op_name="std")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_ax(axis), keepdims=keepdim)
+        # "min" mode: lower of the two middle values
+        ax = _ax(axis)
+        arr = a.reshape(-1) if ax is None else a
+        ax2 = 0 if ax is None else ax
+        srt = jnp.sort(arr, axis=ax2)
+        n = srt.shape[ax2]
+        out = jnp.take(srt, (n - 1) // 2, axis=ax2)
+        if keepdim:
+            out = jnp.expand_dims(out, ax2) if ax is not None else out.reshape((1,) * a.ndim)
+        return out
+    return _apply(f, x, op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda a: jnp.nanmedian(a, axis=_ax(axis), keepdims=keepdim),
+                  x, op_name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def f(a):
+        return jnp.quantile(a, jnp.asarray(q), axis=_ax(axis), keepdims=keepdim,
+                            method=interpolation)
+    return _apply(f, x, op_name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    def f(a):
+        return jnp.nanquantile(a, jnp.asarray(q), axis=_ax(axis), keepdims=keepdim)
+    return _apply(f, x, op_name="nanquantile")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = _ax(axis)
+        srt = jnp.sort(a, axis=ax)
+        idxs = jnp.argsort(a, axis=ax)
+        val = jnp.take(srt, int(k) - 1, axis=ax)
+        idx = jnp.take(idxs, int(k) - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            val = jnp.expand_dims(val, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return val, idx
+    return _apply(f, x, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = _ax(axis) % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        n = moved.shape[-1]
+        # count matches for each element; pick the value with max count,
+        # ties broken by the largest value (paddle returns last occurrence)
+        eq = moved[..., :, None] == moved[..., None, :]
+        counts = eq.sum(-1)
+        best = jnp.argmax(counts + jnp.linspace(0, 0.5, n), axis=-1)
+        vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+        idx = best.astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        else:
+            vals = jnp.moveaxis(vals[..., None], -1, ax)[..., 0] if False else vals
+        return vals, idx
+    return _apply(f, x, op_name="mode")

@@ -1,0 +1,260 @@
+"""Eager Tensor for paddle_tpu.
+
+TPU-native re-design of the reference dygraph Tensor (ref: paddle/fluid/eager,
+python/paddle/fluid/dygraph/varbase_patch_methods.py). The Tensor wraps a
+jax.Array; eager ops dispatch through `paddle_tpu.dispatch.apply`, which both
+executes on-device via XLA and (when grads are needed) records a tape node
+holding the `jax.vjp` pullback. `.backward()` walks that tape.
+
+Unlike the reference there are no views/strides: XLA arrays are immutable, so
+"in-place" methods rebind `_data` on the same Python object (semantically
+equivalent for the supported API surface; true aliasing is not exposed).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .framework import state as _st
+
+
+class Tensor:
+    __slots__ = (
+        "_data", "stop_gradient", "_grad", "_node", "_out_idx", "name",
+        "persistable", "_placeholder", "__weakref__",
+    )
+
+    def __init__(self, data, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array) and not _is_tracer(data):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+        self._placeholder = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def place(self):
+        from .framework import device as _dev
+        try:
+            devs = getattr(self._data, "devices", None)
+            if devs:
+                d = next(iter(devs()))
+                return _dev.Place(d.platform, d.id)
+        except Exception:
+            pass
+        return _dev.Place(jax.default_backend(), 0)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value)
+        self._grad = value
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        prefix = "Parameter" if isinstance(self, Parameter) else "Tensor"
+        try:
+            body = np.array2string(np.asarray(self._data), precision=8, separator=", ")
+        except Exception:  # tracers
+            body = repr(self._data)
+        return (f"{prefix}(shape={self.shape}, dtype={self._data.dtype}, "
+                f"stop_gradient={self.stop_gradient},\n       {body})")
+
+    # -- conversions --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        arr = np.asarray(self._data)
+        return arr.item(*args) if args else arr.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __float__(self):
+        return float(np.asarray(self._data))
+
+    def __int__(self):
+        return int(np.asarray(self._data))
+
+    def __bool__(self):
+        return bool(np.asarray(self._data))
+
+    def __index__(self):
+        return int(np.asarray(self._data))
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, *a, **k):
+        return self._data.__dlpack__(*a, **k)
+
+    def __jax_array__(self):
+        return self._data
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .autograd import engine
+        engine.backward(self, grad_tensor, retain_graph)
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._data))
+        else:
+            self._grad = None
+
+    clear_grad = clear_gradient
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def register_hook(self, hook):
+        from .autograd import engine
+        return engine.register_tensor_hook(self, hook)
+
+    # -- in-place -----------------------------------------------------------
+    def set_value(self, value):
+        """In-place rebind; shape must match (ref Tensor.set_value semantics)."""
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch {tuple(value.shape)} vs {tuple(self._data.shape)}")
+        self._data = value.astype(self._data.dtype)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def _rebind(self, new_data, node=None, out_idx=0):
+        """Internal: rebind after an in-place differentiable op."""
+        self._data = new_data
+        self._node = node
+        self._out_idx = out_idx
+        return self
+
+    # -- misc parity helpers -------------------------------------------------
+    def clone(self):
+        from .dispatch import apply
+        return apply(lambda x: x + jnp.zeros((), x.dtype), self, op_name="clone")
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        # supports .to(dtype) / .to("tpu") minimal parity
+        from .framework.state import to_jnp_dtype
+        for a in args:
+            if isinstance(a, str) and a.lower() in ("cpu", "tpu", "gpu"):
+                continue
+            d = to_jnp_dtype(a)
+            if d is not None:
+                return self.astype(d)
+        if "dtype" in kwargs:
+            return self.astype(kwargs["dtype"])
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    @property
+    def T(self):
+        from .tensor import linalg
+        return linalg.t(self)
+
+    @property
+    def mT(self):
+        from .tensor import manipulation as m
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return m.transpose(self, perm)
+
+    # Arithmetic dunders and tensor methods are attached by
+    # paddle_tpu.tensor._install_tensor_methods() to avoid circular imports.
+
+
+class Parameter(Tensor):
+    __slots__ = ("trainable", "regularizer", "need_clip", "dist_spec",
+                 "is_distributed", "optimize_attr", "no_sync")
+
+    _name_counter = [0]
+
+    def __init__(self, data, name=None, trainable=True, regularizer=None,
+                 need_clip=True, dist_spec=None):
+        if name is None:
+            name = f"param_{Parameter._name_counter[0]}"
+            Parameter._name_counter[0] += 1
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        # Optional jax PartitionSpec for GSPMD placement (set by parallel layers)
+        self.dist_spec = dist_spec
+        self.is_distributed = False
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.no_sync = False
+        self.persistable = True
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def as_tensor_data(x):
+    """Unwrap Tensor -> jax array; pass through scalars/arrays."""
+    return x._data if isinstance(x, Tensor) else x
+
+
+def wrap(data, stop_gradient=True):
+    return Tensor(data, stop_gradient=stop_gradient)
