@@ -43,7 +43,7 @@ from .tensor import *  # noqa: E402,F401,F403
 from .tensor import einsum  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
 from .autograd import grad  # noqa: E402,F401
-# PENDING from . import nn  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
 # PENDING from . import optimizer  # noqa: E402,F401
 # PENDING from . import io  # noqa: E402,F401
 # PENDING from . import amp  # noqa: E402,F401

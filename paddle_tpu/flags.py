@@ -1,0 +1,28 @@
+"""Framework flags (ref: FLAGS_* in paddle/fluid/framework + paddle.set_flags).
+
+TPU-relevant knobs only; unknown flags are stored and returned verbatim so
+scripts written against the reference don't crash.
+"""
+from __future__ import annotations
+
+_FLAGS = {
+    "FLAGS_use_flash_attention": True,
+    "FLAGS_cudnn_deterministic": False,   # accepted, no-op on TPU
+    "FLAGS_embedding_deterministic": False,
+    "FLAGS_use_remat": False,
+    "FLAGS_matmul_precision": "default",  # default|highest (f32 on MXU)
+    "FLAGS_donate_buffers": True,
+}
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        _FLAGS[k] = v
+
+
+def get_flags(flags=None):
+    if flags is None:
+        return dict(_FLAGS)
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}

@@ -1,0 +1,157 @@
+"""Activation functionals (ref: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...dispatch import apply as _apply
+
+
+def _act(jfn, name):
+    def op(x, name_=None, **kw):
+        return _apply(jfn, x, op_name=name)
+    op.__name__ = name
+    return op
+
+
+relu = _act(jax.nn.relu, "relu")
+relu6 = _act(lambda a: jnp.clip(a, 0, 6), "relu6")
+sigmoid = _act(jax.nn.sigmoid, "sigmoid")
+tanh = _act(jnp.tanh, "tanh")
+silu = _act(jax.nn.silu, "silu")
+swish = silu
+mish = _act(lambda a: a * jnp.tanh(jax.nn.softplus(a)), "mish")
+tanhshrink = _act(lambda a: a - jnp.tanh(a), "tanhshrink")
+softsign = _act(jax.nn.soft_sign, "softsign")
+hardswish = _act(jax.nn.hard_swish, "hardswish")
+hardsigmoid = _act(lambda a: jnp.clip(a / 6.0 + 0.5, 0.0, 1.0), "hardsigmoid")
+selu_default = _act(jax.nn.selu, "selu")
+
+
+def gelu(x, approximate=False, name=None):
+    return _apply(lambda a: jax.nn.gelu(a, approximate=approximate), x, op_name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x, op_name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return _apply(lambda a: jax.nn.elu(a, alpha), x, op_name="elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return _apply(lambda a: jax.nn.celu(a, alpha), x, op_name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                  x, op_name="selu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.upper().startswith("NC") else a.ndim - 1
+        shape[ch_axis] = -1
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return _apply(f, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...framework.random import next_key
+    import jax.random as jr
+    def f(a):
+        if training:
+            slope = jr.uniform(next_key(), a.shape, a.dtype, lower, upper)
+        else:
+            slope = (lower + upper) / 2.0
+        return jnp.where(a >= 0, a, slope * a)
+    return _apply(f, x, op_name="rrelu")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _apply(lambda a: jnp.where(a * beta > threshold, a,
+                                      jax.nn.softplus(a * beta) / beta),
+                  x, op_name="softplus")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _apply(lambda a: jnp.where(a > threshold, a - threshold,
+                                      jnp.where(a < -threshold, a + threshold, 0.0)),
+                  x, op_name="softshrink")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0),
+                  x, op_name="hardshrink")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _apply(lambda a: jnp.clip(a, min, max), x, op_name="hardtanh")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _apply(lambda a: jnp.where(a > threshold, a, 0.0), x, op_name="thresholded_relu")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.state import to_jnp_dtype
+    d = to_jnp_dtype(dtype)
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=int(axis))
+    return _apply(f, x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.state import to_jnp_dtype
+    d = to_jnp_dtype(dtype)
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=int(axis))
+    return _apply(f, x, op_name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+    import jax.random as jr
+    def f(a):
+        g = jr.gumbel(next_key(), a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), a.shape[axis],
+                                    axis=axis, dtype=a.dtype)
+            return y + jax.lax.stop_gradient(onehot - y)  # straight-through
+        return y
+    return _apply(f, x, op_name="gumbel_softmax")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return _apply(f, x, op_name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return _apply(f, x, op_name="glu")
+
+
+def tanh_(x):
+    from ...dispatch import apply_inplace
+    return apply_inplace(x, jnp.tanh, x, op_name="tanh")
+
+
+def relu_(x):
+    from ...dispatch import apply_inplace
+    return apply_inplace(x, jax.nn.relu, x, op_name="relu")

@@ -1,0 +1,93 @@
+"""Attention functionals.
+
+Parity target: paddle.nn.functional.scaled_dot_product_attention and the
+incubate fused flash_attention ops (ref: python/paddle/incubate/nn/functional).
+On TPU the hot path routes to a pallas flash-attention kernel
+(paddle_tpu/ops/pallas_kernels/flash_attention.py); elsewhere (CPU tests) it
+uses the composed XLA path below.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...dispatch import apply as _apply
+from ...tensor_impl import Tensor
+
+
+def _sdpa_reference(q, k, v, mask=None, causal=False, scale=None, dropout_key=None,
+                    dropout_p=0.0):
+    """q,k,v: [B, S, H, D] (paddle flash_attention layout)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    # compute in f32 for numerics, output in input dtype
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * s
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """paddle layout: [batch, seq, num_heads, head_dim]."""
+    from ...framework.random import next_key
+    dropout_key = next_key() if (dropout_p > 0.0 and training) else None
+    use_flash = _flash_ok(query)
+
+    def f(q, k, v, *m):
+        mask = m[0] if m else None
+        if use_flash and mask is None:
+            from ...ops.pallas_kernels.flash_attention import flash_attention_bshd
+            return flash_attention_bshd(q, k, v, causal=is_causal)
+        return _sdpa_reference(q, k, v, mask=mask, causal=is_causal,
+                               dropout_key=dropout_key,
+                               dropout_p=dropout_p if training else 0.0)
+
+    args = [attn_mask] if attn_mask is not None else []
+    return _apply(f, query, key, value, *args, op_name="flash_attention")
+
+
+def _flash_ok(q):
+    """Route to the pallas kernel when on TPU with MXU-friendly shapes."""
+    try:
+        import jax as _j
+        if _j.default_backend() != "tpu":
+            return False
+        from ..  import functional  # noqa
+        from ...flags import get_flags
+        if not get_flags(["FLAGS_use_flash_attention"])["FLAGS_use_flash_attention"]:
+            return False
+        shape = q.shape if not isinstance(q, Tensor) else q._data.shape
+        d = shape[-1]
+        return d in (64, 128, 256) and shape[1] % 128 == 0
+    except Exception:
+        return False
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """ref: python/paddle/incubate/nn/functional flash_attention API."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
+                                       training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError(
+        "varlen flash attention is not provided; TPU path uses dense batches "
+        "with masks (see scaled_dot_product_attention)")

@@ -1,0 +1,383 @@
+"""Common functionals (ref: python/paddle/nn/functional/common.py, input.py).
+
+linear/embedding are MXU ops; dropout threads the seeded PRNG key explicitly
+so it stays pure under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...dispatch import apply as _apply
+from ...tensor_impl import Tensor, as_tensor_data
+from ...framework.random import next_key
+from ...framework.state import to_jnp_dtype
+
+
+def linear(x, weight, bias=None, name=None):
+    def f(a, w, *b):
+        out = a @ w.astype(a.dtype)
+        if b:
+            out = out + b[0].astype(out.dtype)
+        return out
+    if bias is not None:
+        return _apply(f, x, weight, bias, op_name="linear")
+    return _apply(f, x, weight, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    key = next_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return _apply(f, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format.upper() == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format.upper() == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return _apply(f, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return _apply(f, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return _apply(lambda a: jax.nn.one_hot(a.astype(jnp.int32), int(num_classes),
+                                           dtype=jnp.float32), x, op_name="one_hot")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l, *pd):
+        k = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / k
+    if prior_dist is not None:
+        return _apply(f, label, prior_dist, op_name="label_smooth")
+    return _apply(f, label, op_name="label_smooth")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def f(a):
+        p = pad
+        if isinstance(p, Tensor):
+            p = np.asarray(p._data).tolist()
+        p = [int(v) for v in p]
+        if len(p) == 2 * a.ndim:
+            # full-form [d0_lo,d0_hi,d1_lo,d1_hi,...]
+            pads = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # partial form applies to spatial dims; paddle order is
+            # [lo,hi] per spatial dim starting from the LAST spatial dim group
+            nspatial = len(p) // 2
+            pads = [(0, 0)] * a.ndim
+            channel_last = not data_format.upper().startswith("NC")
+            if channel_last:
+                spatial = list(range(1, a.ndim - 1))
+            else:
+                spatial = list(range(2, a.ndim))
+            spatial = spatial[-nspatial:] if nspatial <= len(spatial) else spatial
+            # paddle lists pads from the last dim backwards in pairs? No:
+            # paddle's partial pad is [left, right, top, bottom, front, back]
+            # i.e. starts at the last spatial dim and walks backwards.
+            for i in range(nspatial):
+                dim = spatial[len(spatial) - 1 - i]
+                pads[dim] = (p[2 * i], p[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, pads, mode="constant", constant_values=value)
+        return jnp.pad(a, pads, mode=jmode)
+    return _apply(f, x, op_name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis))
+        nb = jnp.sqrt(jnp.sum(jnp.square(b), axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return _apply(f, x1, x2, op_name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1, keepdims=keepdim),
+                         1.0 / p)
+    return _apply(f, x, y, op_name="pairwise_distance")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+    if bias is not None:
+        return _apply(f, x1, x2, weight, bias, op_name="bilinear")
+    return _apply(f, x1, x2, weight, op_name="bilinear")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    channel_last = not data_format.upper().startswith("NC")
+
+    def f(a):
+        nspatial = a.ndim - 2
+        spatial_axes = list(range(1, a.ndim - 1)) if channel_last else \
+            list(range(2, a.ndim))
+        in_sizes = [a.shape[ax] for ax in spatial_axes]
+        if size is not None:
+            s = size
+            if isinstance(s, Tensor):
+                s = np.asarray(s._data).tolist()
+            out_sizes = [int(as_tensor_data(v)) for v in (s if isinstance(s, (list, tuple)) else [s])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                [scale_factor] * nspatial
+            out_sizes = [int(i * float(as_tensor_data(f_))) for i, f_ in zip(in_sizes, sf)]
+        jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                 "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if channel_last:
+            new_shape = (a.shape[0],) + tuple(out_sizes) + (a.shape[-1],)
+        else:
+            new_shape = a.shape[:2] + tuple(out_sizes)
+        if jmode == "nearest":
+            return jax.image.resize(a, new_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate with explicit gather
+            return _resize_align_corners(a, spatial_axes, out_sizes, jmode)
+        return jax.image.resize(a, new_shape, method=jmode)
+
+    return _apply(f, x, op_name="interpolate")
+
+
+def _resize_align_corners(a, spatial_axes, out_sizes, method):
+    out = a
+    for ax, o in zip(spatial_axes, out_sizes):
+        i = out.shape[ax]
+        if o == i:
+            continue
+        if o == 1:
+            idx = jnp.zeros((1,), jnp.float32)
+        else:
+            idx = jnp.arange(o, dtype=jnp.float32) * (i - 1) / (o - 1)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, i - 1)
+        w = (idx - lo).astype(out.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = -1
+        lo_v = jnp.take(out, lo, axis=ax)
+        hi_v = jnp.take(out, hi, axis=ax)
+        out = lo_v * (1 - w.reshape(shape)) + hi_v * w.reshape(shape)
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def f(a):
+        if data_format.upper() == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c // (r * r), r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+    return _apply(f, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def f(a):
+        if data_format.upper() == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c, h // r, r, w // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h // r, r, w // r, r, c)
+        out = out.transpose(0, 2, 4, 1, 3, 5)
+        return out.reshape(n, h // r, w // r, c * r * r)
+    return _apply(f, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def f(a):
+        if data_format.upper() == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, g, c // g).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return _apply(f, x, op_name="channel_shuffle")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True,
+                name=None):
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * (w - 1) / 2
+            iy = (gy + 1) * (h - 1) / 2
+        else:
+            ix = ((gx + 1) * w - 1) / 2
+            iy = ((gy + 1) * h - 1) / 2
+
+        def sample(iy_, ix_):
+            iy_c = jnp.clip(iy_, 0, h - 1).astype(jnp.int32)
+            ix_c = jnp.clip(ix_, 0, w - 1).astype(jnp.int32)
+            batch = jnp.arange(n).reshape(n, 1, 1)
+            vals = a[batch, :, iy_c, ix_c]  # [n, gh, gw, c]
+            if padding_mode == "zeros":
+                valid = ((iy_ >= 0) & (iy_ <= h - 1) & (ix_ >= 0) & (ix_ <= w - 1))
+                vals = vals * valid[..., None]
+            return vals
+
+        if mode == "nearest":
+            out = sample(jnp.round(iy), jnp.round(ix))
+        else:
+            x0, y0 = jnp.floor(ix), jnp.floor(iy)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = ((x1 - ix) * (y1 - iy))[..., None]
+            wb = ((x1 - ix) * (iy - y0))[..., None]
+            wc = ((ix - x0) * (y1 - iy))[..., None]
+            wd = ((ix - x0) * (iy - y0))[..., None]
+            out = (sample(y0, x0) * wa + sample(y1, x0) * wb +
+                   sample(y0, x1) * wc + sample(y1, x1) * wd)
+        return jnp.moveaxis(out, -1, 1)
+    return _apply(f, x, grid, op_name="grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def f(th):
+        n, _, h, w = [int(as_tensor_data(s)) for s in out_shape]
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h,w,3]
+        return jnp.einsum("nij,hwj->nhwi", th, base)
+    return _apply(f, theta, op_name="affine_grid")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _tuple
+    k = _tuple(kernel_sizes, 2)
+    s = _tuple(strides, 2)
+    p = _tuple(paddings, 2) if not isinstance(paddings, (list, tuple)) or \
+        len(paddings) == 2 else tuple(paddings)
+    d = _tuple(dilations, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        if len(p) == 2:
+            pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+        else:
+            pads = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]))
+        a2 = jnp.pad(a, pads)
+        patches = jax.lax.conv_general_dilated_patches(
+            a2, filter_shape=k, window_strides=s, padding="VALID",
+            rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # [n, c*kh*kw, oh, ow] -> [n, c*kh*kw, oh*ow]
+        return patches.reshape(n, patches.shape[1], -1)
+    return _apply(f, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _tuple
+    out_hw = _tuple(output_sizes, 2)
+    k = _tuple(kernel_sizes, 2)
+    s = _tuple(strides, 2)
+    p = _tuple(paddings, 2)
+    d = _tuple(dilations, 2)
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_hw[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out_hw[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = a.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, out_hw[0] + 2 * p[0], out_hw[1] + 2 * p[1]), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi:hi + oh * s[0]:s[0], wj:wj + ow * s[1]:s[1]].add(
+                    cols[:, :, i, j])
+        return out[:, :, p[0]:out.shape[2] - p[0], p[1]:out.shape[3] - p[1]] \
+            if (p[0] or p[1]) else out
+    return _apply(f, x, op_name="fold")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, l):
+        sim = a @ p.T
+        lab = l.reshape(-1, 1) == l.reshape(1, -1)
+        target = lab.astype(sim.dtype) / jnp.sum(lab, axis=1, keepdims=True)
+        ce = jnp.mean(jnp.sum(-target * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1)) +
+                        jnp.mean(jnp.sum(jnp.square(p), 1))) / 2
+        return ce + reg
+    return _apply(f, anchor, positive, labels, op_name="npair_loss")

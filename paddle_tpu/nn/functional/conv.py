@@ -1,0 +1,172 @@
+"""Convolution functionals (ref: python/paddle/nn/functional/conv.py).
+
+Weight layout matches the reference: [out_c, in_c/groups, *kernel]; data
+layouts NCL/NCHW/NCDHW (or channels-last variants). Lowered to
+`lax.conv_general_dilated`, which XLA tiles onto the MXU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...dispatch import apply as _apply
+from ...tensor_impl import as_tensor_data
+
+
+def _norm_padding(padding, ndims, data_format):
+    """Returns (lax_padding, pre_pad_mode). lax padding: str or [(lo,hi)]*ndims."""
+    if isinstance(padding, str):
+        return padding.upper(), None
+    if isinstance(padding, int):
+        return [(padding, padding)] * ndims, None
+    padding = [int(as_tensor_data(p)) if not isinstance(p, (list, tuple)) else p
+               for p in padding]
+    if len(padding) == ndims and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding], None
+    if len(padding) == 2 * ndims:
+        # [before, after, before, after, ...] per spatial dim (paddle flat form)
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(ndims)], None
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        if len(padding) == ndims:
+            return [tuple(p) for p in padding], None
+        # NCHW-style 4/5-d padding including batch/channel dims
+        spatial = padding[2:] if data_format.upper().startswith("NC") else padding[1:-1]
+        return [tuple(p) for p in spatial], None
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _dim_numbers(ndims, channel_last):
+    if ndims == 1:
+        return ("NWC", "OIW", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndims == 2:
+        return ("NHWC", "OIHW", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "OIDHW", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, ndims,
+          op_name):
+    channel_last = not data_format.upper().startswith("NC")
+    stride = _tuple(stride, ndims)
+    dilation = _tuple(dilation, ndims)
+    pad, _ = _norm_padding(padding, ndims, data_format)
+    dn = _dim_numbers(ndims, channel_last)
+
+    def f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w.astype(a.dtype), window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=int(groups),
+            preferred_element_type=None)
+        if b:
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = -1
+            out = out + b[0].astype(out.dtype).reshape(shape)
+        return out
+
+    if bias is not None:
+        return _apply(f, x, weight, bias, op_name=op_name)
+    return _apply(f, x, weight, op_name=op_name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCW" if data_format.upper() in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, df, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2,
+                 "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3,
+                 "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, ndims, output_size, op_name):
+    channel_last = not data_format.upper().startswith("NC")
+    stride = _tuple(stride, ndims)
+    dilation = _tuple(dilation, ndims)
+    out_padding = _tuple(output_padding, ndims)
+    pad, _ = _norm_padding(padding, ndims, data_format)
+    dn = _dim_numbers(ndims, channel_last)
+
+    def f(a, w, *b):
+        # Gradient-of-conv formulation: lhs_dilation=stride implements the
+        # fractionally-strided conv. Padding per dim: k_eff-1-p_lo, k_eff-1-p_hi+op.
+        k = w.shape[2:]
+        if isinstance(pad, str):
+            if pad == "SAME":
+                raise NotImplementedError("SAME padding for conv_transpose unsupported")
+            p_list = [(0, 0)] * ndims  # VALID
+        else:
+            p_list = pad
+        tpad = []
+        for i in range(ndims):
+            ke = (k[i] - 1) * dilation[i] + 1
+            lo, hi = p_list[i]
+            tpad.append((ke - 1 - lo, ke - 1 - hi + out_padding[i]))
+        # weight [in_c, out_c/groups, *k] for transpose (reference layout);
+        # flip spatial dims and swap io for the gradient formulation
+        wt = jnp.flip(w, axis=tuple(range(2, w.ndim)))
+        if int(groups) > 1:
+            ic, ocg = wt.shape[0], wt.shape[1]
+            wt = wt.reshape((int(groups), ic // int(groups), ocg) + wt.shape[2:])
+            wt = jnp.swapaxes(wt, 1, 2)
+            wt = wt.reshape((int(groups) * ocg, ic // int(groups)) + w.shape[2:])
+        else:
+            wt = jnp.swapaxes(wt, 0, 1)
+        out = jax.lax.conv_general_dilated(
+            a, wt.astype(a.dtype), window_strides=(1,) * ndims, padding=tpad,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=int(groups))
+        if b:
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = -1
+            out = out + b[0].astype(out.dtype).reshape(shape)
+        return out
+
+    out = _apply(f, x, weight, *( [bias] if bias is not None else [] ), op_name=op_name)
+    if output_size is not None:
+        # crop/verify to requested spatial size
+        target = _tuple(output_size, ndims)
+        sl = [np.s_[:], np.s_[:]] + [np.s_[:t] for t in target]
+        if channel_last:
+            sl = [np.s_[:]] + [np.s_[:t] for t in target] + [np.s_[:]]
+        out = out[tuple(sl)]
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    df = "NCW" if data_format.upper() in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, df, 1, output_size, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, output_size,
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, output_size,
+                           "conv3d_transpose")

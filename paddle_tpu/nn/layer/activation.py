@@ -1,0 +1,89 @@
+"""Activation layers (ref: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from ..layer_base import Layer
+from .. import functional as F
+
+
+def _simple(fname, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = dict(defaults)
+            names = list(defaults)
+            for i, a in enumerate(args):
+                self._kwargs[names[i]] = a
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fname)(x, **self._kwargs)
+    _Act.__name__ = "".join(w.capitalize() for w in fname.split("_"))
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+Sigmoid = _simple("sigmoid")
+Tanh = _simple("tanh")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+GELU = _simple("gelu", approximate=False)
+LeakyReLU = _simple("leaky_relu", negative_slope=0.01)
+ELU = _simple("elu", alpha=1.0)
+CELU = _simple("celu", alpha=1.0)
+SELU = _simple("selu", scale=1.0507009873554805, alpha=1.6732632423543772)
+Hardswish = _simple("hardswish")
+Hardsigmoid = _simple("hardsigmoid")
+Hardtanh = _simple("hardtanh", min=-1.0, max=1.0)
+Hardshrink = _simple("hardshrink", threshold=0.5)
+Softshrink = _simple("softshrink", threshold=0.5)
+Softplus = _simple("softplus", beta=1.0, threshold=20.0)
+Softsign = _simple("softsign")
+Tanhshrink = _simple("tanhshrink")
+ThresholdedReLU = _simple("thresholded_relu", threshold=1.0)
+LogSigmoid = _simple("sigmoid")  # replaced below
+Maxout = _simple("maxout", groups=2, axis=1)
+GLU = _simple("glu", axis=-1)
+RReLU = _simple("rrelu", lower=1.0 / 8.0, upper=1.0 / 3.0)
+
+
+class LogSigmoid(Layer):  # noqa: F811
+    def forward(self, x):
+        import jax
+        from ...dispatch import apply
+        return apply(jax.nn.log_sigmoid, x, op_name="log_sigmoid")
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr, dtype=self._dtype,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self.data_format)

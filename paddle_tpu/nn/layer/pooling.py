@@ -1,0 +1,102 @@
+"""Pooling layers (ref: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from ..layer_base import Layer
+from .. import functional as F
+
+
+def _make_pool(fname, ndims, default_df):
+    class _Pool(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                     return_mask=False, exclusive=True, divisor_override=None,
+                     data_format=default_df, name=None):
+            super().__init__()
+            self.kernel_size = kernel_size
+            self.stride = stride
+            self.padding = padding
+            self.ceil_mode = ceil_mode
+            self.return_mask = return_mask
+            self.exclusive = exclusive
+            self.divisor_override = divisor_override
+            self.data_format = data_format
+
+        def forward(self, x):
+            fn = getattr(F, fname)
+            if fname.startswith("max"):
+                return fn(x, self.kernel_size, self.stride, self.padding,
+                          self.return_mask, self.ceil_mode, self.data_format)
+            if fname == "avg_pool1d":
+                return fn(x, self.kernel_size, self.stride, self.padding,
+                          self.exclusive, self.ceil_mode, self.data_format)
+            return fn(x, self.kernel_size, self.stride, self.padding,
+                      self.ceil_mode, self.exclusive, self.divisor_override,
+                      self.data_format)
+    _Pool.__name__ = "".join(w.capitalize() for w in fname.split("_"))
+    return _Pool
+
+
+MaxPool1D = _make_pool("max_pool1d", 1, "NCL")
+MaxPool2D = _make_pool("max_pool2d", 2, "NCHW")
+MaxPool3D = _make_pool("max_pool3d", 3, "NCDHW")
+AvgPool1D = _make_pool("avg_pool1d", 1, "NCL")
+AvgPool2D = _make_pool("avg_pool2d", 2, "NCHW")
+AvgPool3D = _make_pool("avg_pool3d", 3, "NCDHW")
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size, self.data_format)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size, self.return_mask)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size, self.return_mask)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size, self.return_mask)

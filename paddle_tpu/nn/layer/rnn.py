@@ -1,0 +1,320 @@
+"""Recurrent layers (ref: python/paddle/nn/layer/rnn.py).
+
+The reference runs cuDNN RNN kernels; TPU-native design runs the time loop as
+`lax.scan` inside one dispatched op, so XLA compiles a single fused loop (and
+the tape stores one pullback for the whole sequence).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from .. import initializer as I
+from ...dispatch import apply as _apply
+from ...tensor_impl import Tensor
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        return Tensor(jnp.full((batch, self.hidden_size), init_value,
+                               dtype or jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out, out
+        return _apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh, op_name="rnn_cell")
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+            states = (h, c)
+        h, c = states
+
+        def f(x, h_, c_, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h_ @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            i, fgt, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fgt), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = fgt * c_ + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, (h_new, c_new)
+        return _apply(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh, op_name="lstm_cell")
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            r_i, z_i, n_i = jnp.split(gi, 3, axis=-1)
+            r_h, z_h, n_h = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(r_i + r_h)
+            z = jax.nn.sigmoid(z_i + z_h)
+            n = jnp.tanh(n_i + r * n_h)
+            out = (1 - z) * n + z * h
+            return out, out
+        return _apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh, op_name="gru_cell")
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+def _cell_step(mode):
+    """Pure per-timestep function (x, state, params) -> (out, new_state)."""
+    if mode == "LSTM":
+        def step(x, state, wi, wh, bi, bh):
+            h_, c_ = state
+            gates = x @ wi.T + bi + h_ @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = f * c_ + i * g
+            h = o * jnp.tanh(c)
+            return h, (h, c)
+    elif mode == "GRU":
+        def step(x, state, wi, wh, bi, bh):
+            h = state
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            r_i, z_i, n_i = jnp.split(gi, 3, axis=-1)
+            r_h, z_h, n_h = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(r_i + r_h)
+            z = jax.nn.sigmoid(z_i + z_h)
+            n = jnp.tanh(n_i + r * n_h)
+            out = (1 - z) * n + z * h
+            return out, out
+    else:
+        act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+        def step(x, state, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + state @ wh.T + bh)
+            return out, out
+    return step
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        gate_mult = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._param_names = []
+        for layer in range(num_layers):
+            for direction in range(self.num_directions):
+                in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+                suffix = "_reverse" if direction else ""
+                names = [f"weight_ih_l{layer}{suffix}", f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}", f"bias_hh_l{layer}{suffix}"]
+                shapes = [[gate_mult * hidden_size, in_sz],
+                          [gate_mult * hidden_size, hidden_size],
+                          [gate_mult * hidden_size], [gate_mult * hidden_size]]
+                for n, s in zip(names, shapes):
+                    self.add_parameter(n, self.create_parameter(
+                        s, None, is_bias=("bias" in n), default_initializer=u))
+                self._param_names.append(names)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.mode == "LSTM"
+        step = _cell_step(self.mode)
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        param_tensors = []
+        for names in self._param_names:
+            param_tensors.extend(self._parameters[n] for n in names)
+
+        def f(x, *flat_params):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, C]
+            B = x.shape[1]
+            h_finals, c_finals = [], []
+            layer_in = x
+            for layer in range(L):
+                outs = []
+                for d in range(D):
+                    k = (layer * D + d) * 4
+                    wi, wh, bi, bh = flat_params[k:k + 4]
+                    seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
+                    h0 = jnp.zeros((B, H), x.dtype)
+                    init = (h0, h0) if is_lstm else h0
+
+                    def scan_fn(state, xt):
+                        out, new_state = step(xt, state, wi, wh, bi, bh)
+                        return new_state, out
+
+                    final_state, ys = jax.lax.scan(scan_fn, init, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs.append(ys)
+                    if is_lstm:
+                        h_finals.append(final_state[0])
+                        c_finals.append(final_state[1])
+                    else:
+                        h_finals.append(final_state)
+                layer_in = jnp.concatenate(outs, axis=-1) if D == 2 else outs[0]
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(h_finals, 0)
+            if is_lstm:
+                return out, h_stack, jnp.stack(c_finals, 0)
+            return out, h_stack
+
+        res = _apply(f, inputs, *param_tensors, op_name="rnn")
+        if is_lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class RNN(Layer):
+    """Wrapper running a cell over time (ref nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        axis = 0 if self.time_major else 1
+        T = inputs.shape[axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = []
+        state = initial_states
+        from ...tensor import manipulation as M
+        for t in steps:
+            xt = inputs[t] if self.time_major else inputs[:, t]
+            out, state = self.cell(xt, state)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = M.stack(outs, axis=axis)
+        return out, state
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import manipulation as M
+        states = initial_states or (None, None)
+        out_fw, st_fw = self.rnn_fw(inputs, states[0])
+        out_bw, st_bw = self.rnn_bw(inputs, states[1])
+        return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

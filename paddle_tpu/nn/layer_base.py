@@ -1,0 +1,349 @@
+"""nn.Layer — module base class.
+
+Re-design of the reference dygraph Layer (ref: python/paddle/fluid/dygraph/
+layers.py in older trees; python/paddle/nn/layer/layers.py here). Parameters
+are Parameter tensors registered by attribute assignment; the whole layer tree
+flattens to a name->array pytree for the functional/jit path
+(paddle_tpu.jit.functional_call).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, Parameter
+from ..framework.state import get_default_dtype, to_jnp_dtype
+from . import initializer as I
+
+
+class ParamAttr:
+    """ref: python/paddle/fluid/param_attr.py"""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"Unsupported param attr {attr!r}")
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype=None):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        self._non_persistable_buffer_names_set = set()
+        self.training = True
+        self._dtype = to_jnp_dtype(dtype) or get_default_dtype()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._casted_by_pure_fp16 = False
+
+    # -- attribute routing ---------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            for d in (layers, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            for d in (params, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                del params[name]
+            elif isinstance(value, Tensor):
+                params[name].set_value(value)
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+        elif buffers is not None and name in buffers:
+            if value is None:
+                del buffers[name]
+            else:
+                buffers[name] = value if isinstance(value, Tensor) else Tensor(value)
+        elif layers is not None and name in layers and value is None:
+            del layers[name]
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._sub_layers) + list(self._buffers)
+
+    # -- construction helpers ------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = to_jnp_dtype(dtype) or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            if is_bias:
+                init = I._global_bias_init or I.Constant(0.0)
+            else:
+                # reference default: Xavier (uniform) via LayerHelper
+                init = I._global_weight_init or I.XavierUniform()
+        data = init(shape, dtype)
+        p = Parameter(data, name=attr.name, trainable=attr.trainable,
+                      regularizer=attr.regularizer, need_clip=attr.need_clip)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        return tensor
+
+    # -- traversal -----------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+            if not include_sublayers:
+                break
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        stack = [(prefix, self)]
+        first = True
+        while stack:
+            name, layer = stack.pop(0)
+            if not first or include_self:
+                yield name, layer
+            first = False
+            for sub_name, sub in layer._sub_layers.items():
+                if sub is None:
+                    continue
+                stack.append((f"{name}.{sub_name}" if name else sub_name, sub))
+
+    def children(self):
+        return [l for l in self._sub_layers.values() if l is not None]
+
+    def named_children(self):
+        return [(n, l) for n, l in self._sub_layers.items() if l is not None]
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- modes ---------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix):
+            short = name.rsplit(".", 1)[-1]
+            owner = self._locate(name)
+            if owner is not None and short in owner._non_persistable_buffer_names_set:
+                continue
+            dest[name] = b
+        return dest
+
+    def _locate(self, qualified_name):
+        parts = qualified_name.split(".")[:-1]
+        layer = self
+        for p in parts:
+            try:
+                layer = layer._sub_layers[p]
+            except KeyError:
+                return None
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, value in state_dict.items():
+            if name not in own:
+                unexpected.append(name)
+                continue
+            target = own[name]
+            arr = value._data if isinstance(value, Tensor) else jnp.asarray(np.asarray(value))
+            if tuple(arr.shape) != tuple(target._data.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: {tuple(arr.shape)} vs "
+                    f"{tuple(target._data.shape)}")
+            target._data = arr.astype(target._data.dtype)
+        for name in own:
+            if name not in state_dict:
+                missing.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- dtype / device casts ------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_to(to_jnp_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast_to(to_jnp_dtype(dtype))
+        return self
+
+    def float(self):
+        return self.astype(jnp.float32)
+
+    def half(self):
+        return self.astype(jnp.float16)
+
+    def bfloat16(self):
+        return self.astype(jnp.bfloat16)
+
+    def _cast_to(self, dtype):
+        for _, p in self.named_parameters():
+            if jnp.issubdtype(p._data.dtype, jnp.floating):
+                p._data = p._data.astype(dtype)
+        for _, b in self.named_buffers():
+            if jnp.issubdtype(b._data.dtype, jnp.floating):
+                b._data = b._data.astype(dtype)
+        for layer in self.sublayers(include_self=True):
+            layer._dtype = dtype
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            rep = repr(sub).split("\n")
+            rep = [rep[0]] + ["  " + r for r in rep[1:]]
+            lines.append(f"  ({name}): " + "\n".join(rep))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, collection):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._collection = collection
+
+    def remove(self):
+        self._collection.pop(self.id, None)

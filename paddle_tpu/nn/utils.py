@@ -1,0 +1,64 @@
+"""nn.utils (ref: python/paddle/nn/utils/*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor
+from .clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    from ..tensor import manipulation as M
+    return M.concat([M.reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(Tensor(vec._data[offset:offset + n].reshape(p._data.shape)))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (ref nn/utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    from ..tensor_impl import Parameter
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    norm = jnp.sqrt(jnp.sum(jnp.square(w._data), axis=axes, keepdims=True))
+    g = Parameter(norm.reshape([w.shape[dim]]), name=f"{name}_g")
+    v = Parameter(w._data, name=f"{name}_v")
+    del layer._parameters[name]
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+
+    def pre_hook(l, inputs):
+        from ..dispatch import apply
+        def f(g_, v_):
+            n = jnp.sqrt(jnp.sum(jnp.square(v_), axis=axes, keepdims=True))
+            shape = [1] * v_.ndim
+            shape[dim] = -1
+            return g_.reshape(shape) * v_ / n
+        w_new = apply(f, g, v, op_name="weight_norm")
+        object.__setattr__(l, "_weight_norm_cache", w_new)
+        l._buffers[name] = w_new
+        return None
+
+    layer.register_forward_pre_hook(pre_hook)
+    # seed buffer so attribute resolves before first forward
+    layer._buffers[name] = Tensor(w._data)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight", dim=0):
+    g = layer._parameters.pop(f"{name}_g")
+    v = layer._parameters.pop(f"{name}_v")
+    layer._buffers.pop(name, None)
+    from ..tensor_impl import Parameter
+    axes = tuple(i for i in range(v._data.ndim) if i != dim)
+    norm = jnp.sqrt(jnp.sum(jnp.square(v._data), axis=axes, keepdims=True))
+    shape = [1] * v._data.ndim
+    shape[dim] = -1
+    w = Parameter(v._data / norm * g._data.reshape(shape), name=name)
+    layer.add_parameter(name, w)
+    return layer
