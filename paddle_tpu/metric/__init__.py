@@ -1,0 +1,141 @@
+"""Metrics (ref: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor_impl import Tensor, as_tensor_data
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        pred_np = np.asarray(as_tensor_data(pred))
+        label_np = np.asarray(as_tensor_data(label))
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
+            label_np = label_np[..., 0]
+        top = np.argsort(-pred_np, axis=-1)[..., :self.maxk]
+        correct = top == label_np[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        c = np.asarray(as_tensor_data(correct))
+        n = c.shape[0]
+        for i, k in enumerate(self.topk):
+            self.total[i] += float(c[..., :k].sum())
+            self.count[i] += n
+        res = [t / max(cnt, 1) for t, cnt in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(as_tensor_data(preds)).round().astype(int).reshape(-1)
+        l = np.asarray(as_tensor_data(labels)).astype(int).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(as_tensor_data(preds)).round().astype(int).reshape(-1)
+        l = np.asarray(as_tensor_data(labels)).astype(int).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(as_tensor_data(preds))
+        if p.ndim == 2:
+            p = p[:, -1]
+        l = np.asarray(as_tensor_data(labels)).reshape(-1)
+        idx = np.clip((p * self.num_thresholds).astype(int), 0, self.num_thresholds)
+        for i, lab in zip(idx, l):
+            if lab:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if not tot_pos or not tot_neg:
+            return 0.0
+        # trapezoid over thresholds descending
+        pos_cum = np.cumsum(self._stat_pos[::-1])
+        neg_cum = np.cumsum(self._stat_neg[::-1])
+        tpr = pos_cum / tot_pos
+        fpr = neg_cum / tot_neg
+        return float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name

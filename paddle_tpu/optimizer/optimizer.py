@@ -1,0 +1,201 @@
+"""Optimizer base (ref: python/paddle/optimizer/optimizer.py).
+
+Each optimizer defines a *pure* per-parameter rule `_update(p, g, slots, lr,
+step)` used by both paths:
+  * eager `.step()` — walks parameters, applies the rule on arrays;
+  * functional `init_state()` / `apply_gradients()` — pytree form for the
+    jit'd TrainStep, where opt slots can be sharded (ZeRO) and the whole
+    update fuses into the step's XLA program (donated buffers, no host sync).
+
+multi_precision keeps fp32 master weights for low-precision params
+(ref: the reference's multi_precision master-weight machinery in
+python/paddle/optimizer/optimizer.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, Parameter
+from ..framework.state import no_grad
+from .lr import LRScheduler
+
+_LOW_PRECISION = (jnp.float16, jnp.bfloat16)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._step_count = 0
+        self._accumulators = {}  # id(param) -> slots dict
+        if isinstance(weight_decay, (int, float)) or weight_decay is None:
+            self._coupled_wd = float(weight_decay or 0.0)
+        else:  # L1/L2Decay object from regularizer module
+            self._coupled_wd = weight_decay
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- eager path ----------------------------------------------------------
+    @no_grad()
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        params_grads = [(p, p._grad) for p in params
+                        if isinstance(p, Parameter) and p.trainable]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._step_count += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            garr = g._data
+            garr = self._apply_decay_eager(p, garr)
+            slots = self._accumulators.get(id(p))
+            if slots is None:
+                slots = self._create_slots(p._data)
+                if self._multi_precision and p._data.dtype in _LOW_PRECISION:
+                    slots["master"] = p._data.astype(jnp.float32)
+                self._accumulators[id(p)] = slots
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            decay_on = self._decay_for(p)
+            if "master" in slots:
+                master = slots.pop("master")
+                new_master, slots = self._update(master, garr.astype(jnp.float32),
+                                                 slots, plr, self._step_count,
+                                                 decay_on=decay_on)
+                slots["master"] = new_master
+                p._data = new_master.astype(p._data.dtype)
+            else:
+                new_p, slots = self._update(p._data, garr, slots, plr,
+                                            self._step_count, decay_on=decay_on)
+                p._data = new_p
+            self._accumulators[id(p)] = slots
+
+    def _decay_for(self, p):
+        """Whether weight decay applies to this param (AdamW's filter fn)."""
+        return True
+
+    def _apply_decay_eager(self, p, garr):
+        """Coupled (L2-into-grad) decay; AdamW overrides for decoupled."""
+        wd = self._effective_wd(p)
+        if wd:
+            garr = garr + wd * p._data.astype(garr.dtype)
+        return garr
+
+    def _effective_wd(self, p):
+        if getattr(p, "regularizer", None) is not None:
+            return float(p.regularizer._coeff)
+        wd = self._coupled_wd
+        if not isinstance(wd, (int, float)):
+            wd = float(getattr(wd, "_coeff", 0.0))
+        return wd
+
+    def clear_grad(self, set_to_zero=True):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_gradient()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- functional path -----------------------------------------------------
+    def init_state(self, params):
+        """params: dict[name -> array]. Returns state pytree (dict of dicts)."""
+        state = {"step": jnp.zeros((), jnp.int32), "slots": {}}
+        for name, arr in params.items():
+            slots = self._create_slots(arr)
+            if self._multi_precision and arr.dtype in _LOW_PRECISION:
+                slots["master"] = arr.astype(jnp.float32)
+            state["slots"][name] = slots
+        return state
+
+    def apply_gradients(self, params, grads, state, lr=None, wd_mask=None):
+        """Pure update. params/grads: dict[name -> array]; returns new dicts.
+        wd_mask: optional dict[name -> bool] controlling weight decay."""
+        lr = self.get_lr() if lr is None else lr
+        step = state["step"] + 1
+        new_params, new_slots = {}, {}
+        for name, p in params.items():
+            g = grads[name]
+            if g is None:
+                new_params[name] = p
+                new_slots[name] = state["slots"][name]
+                continue
+            slots = dict(state["slots"][name])
+            decay_on = wd_mask.get(name, True) if wd_mask else True
+            g = self._apply_decay_functional(p, g, decay_on)
+            if "master" in slots:
+                master = slots.pop("master")
+                new_master, slots = self._update(master, g.astype(jnp.float32),
+                                                 slots, lr, step,
+                                                 decay_on=decay_on)
+                slots["master"] = new_master
+                new_params[name] = new_master.astype(p.dtype)
+            else:
+                new_params[name], slots = self._update(p, g, slots, lr, step,
+                                                       decay_on=decay_on)
+            new_slots[name] = slots
+        return new_params, {"step": step, "slots": new_slots}
+
+    def _apply_decay_functional(self, p, g, decay_on):
+        wd = self._coupled_wd
+        if not isinstance(wd, (int, float)):
+            wd = float(getattr(wd, "_coeff", 0.0))
+        if wd and decay_on:
+            g = g + wd * p.astype(g.dtype)
+        return g
+
+    # -- to be implemented by subclasses ------------------------------------
+    def _create_slots(self, arr):
+        return {}
+
+    def _update(self, p, g, slots, lr, step, decay_on=True):
+        raise NotImplementedError
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count}
+        if self._parameter_list:
+            for p in self._parameter_list:
+                slots = self._accumulators.get(id(p))
+                if slots:
+                    for k, v in slots.items():
+                        out[f"{p.name}.{k}"] = Tensor(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("step", 0))
+        if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state:
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        if self._parameter_list:
+            for p in self._parameter_list:
+                slots = {}
+                for key, v in state.items():
+                    if key.startswith(p.name + "."):
+                        slots[key[len(p.name) + 1:]] = (
+                            v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                if slots:
+                    self._accumulators[id(p)] = slots

@@ -1,0 +1,20 @@
+"""Weight-decay regularizers (ref: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay:
+    """L1 decay: applied eagerly as sign(p)*coeff added to grads."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"
