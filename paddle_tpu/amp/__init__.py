@@ -1,0 +1,155 @@
+"""AMP (ref: python/paddle/amp/auto_cast.py, grad_scaler.py).
+
+auto_cast sets a dtype policy consulted by op dispatch (white ops run in
+bf16/fp16 feeding the MXU, black ops in fp32). On TPU the native mixed
+precision dtype is bfloat16 — no loss scaling needed — but GradScaler
+implements the full fp16 algebra for parity.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework import state as _st
+from ..framework.state import to_jnp_dtype
+from ..tensor_impl import Tensor, Parameter
+from ..dispatch import WHITE_OPS, BLACK_OPS
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    st = _st._state
+    prev = (st.amp_level, st.amp_dtype, st.amp_custom_white, st.amp_custom_black)
+    if enable:
+        st.amp_level = level
+        st.amp_dtype = to_jnp_dtype(dtype)
+        st.amp_custom_white = set(custom_white_list or ())
+        st.amp_custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.amp_level, st.amp_dtype, st.amp_custom_white, st.amp_custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the amp dtype; optimizer gets master weights
+    (ref amp.decorate)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        d = to_jnp_dtype(dtype)
+        for m in model_list:
+            for _, p in m.named_parameters():
+                if jnp.issubdtype(p._data.dtype, jnp.floating):
+                    p._data = p._data.astype(d)
+        if optimizers is not None:
+            opt_list = [optimizers] if not isinstance(optimizers, (list, tuple)) \
+                else list(optimizers)
+            for o in opt_list:
+                o._multi_precision = True if master_weight is None else master_weight
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (ref: python/paddle/amp/grad_scaler.py)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._parameter_list or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p._grad is None:
+                continue
+            g = p._grad._data.astype(jnp.float32) * inv
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            found = found or not finite
+            p._grad._data = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+# white/black list introspection parity
+def white_list():
+    return {"float16": {"O1": sorted(WHITE_OPS)}, "bfloat16": {"O1": sorted(WHITE_OPS)}}
+
+
+def black_list():
+    return {"float16": {"O1": sorted(BLACK_OPS)}, "bfloat16": {"O1": sorted(BLACK_OPS)}}

@@ -1,0 +1,13 @@
+"""paddle_tpu.jit (ref: python/paddle/jit/__init__.py)."""
+from .api import (  # noqa: F401
+    to_static, not_to_static, ignore_module, save, load, StaticFunction,
+    TranslatedLayer,
+)
+from .functional import (  # noqa: F401
+    functional_call, functional_fn_call, capture_params, capture_buffers,
+)
+from .train_step import TrainStep  # noqa: F401
+
+
+def enable_to_static(flag=True):
+    pass

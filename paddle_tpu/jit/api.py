@@ -1,0 +1,167 @@
+"""jit.to_static / save / load (ref: python/paddle/jit/api.py).
+
+`to_static` compiles a Layer or function to one XLA executable per
+(input-shape, train-mode) signature — the reference's Program +
+StandaloneExecutor pipeline collapses into `jax.jit`.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax
+
+from ..tensor_impl import Tensor
+from ..nn.layer_base import Layer
+from ..framework.random import next_key
+from .functional import (
+    capture_params, capture_buffers, functional_call, functional_fn_call, _wrap,
+)
+
+
+class StaticFunction:
+    def __init__(self, target, input_spec=None, build_strategy=None, backend=None,
+                 full_graph=True):
+        self._target = target
+        self._input_spec = input_spec
+        self._is_layer = isinstance(target, Layer)
+        # capture the un-compiled forward BEFORE to_static rebinds it
+        self._orig_forward = target.forward if self._is_layer else None
+        self._cache = {}  # training-mode -> jitted fn
+        self._last_lowered = None
+
+    @property
+    def parameters(self):
+        return self._target.parameters() if self._is_layer else []
+
+    def _get_jitted(self, training):
+        fn = self._cache.get(training)
+        if fn is not None:
+            return fn
+        if self._is_layer:
+            layer = self._target
+            fwd = self._orig_forward
+
+            def pure(params, buffers, key, arg_arrays, kwarg_arrays):
+                out, new_buffers = functional_call(layer, params, buffers,
+                                                  arg_arrays, kwarg_arrays, key,
+                                                  forward_fn=fwd)
+                return out, new_buffers
+        else:
+            f = self._target
+
+            def pure(params, buffers, key, arg_arrays, kwarg_arrays):
+                return functional_fn_call(f, arg_arrays, kwarg_arrays, key), {}
+
+        fn = jax.jit(pure)
+        self._cache[training] = fn
+        return fn
+
+    def __call__(self, *args, **kwargs):
+        arg_arrays = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, args,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        kwarg_arrays = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, kwargs,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        if self._is_layer:
+            params = capture_params(self._target)
+            buffers = capture_buffers(self._target)
+            training = self._target.training
+        else:
+            params, buffers, training = {}, {}, False
+        jitted = self._get_jitted(training)
+        out, new_buffers = jitted(params, buffers, next_key(), arg_arrays,
+                                  kwarg_arrays)
+        if self._is_layer and new_buffers:
+            named_b = dict(self._target.named_buffers())
+            for n, arr in new_buffers.items():
+                if n in named_b:
+                    named_b[n]._data = arr
+        return _wrap(out)
+
+    # introspection: the XLA program replaces the reference's Program
+    def get_concrete_program(self, *args, **kwargs):
+        arg_arrays = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, args,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        params = capture_params(self._target) if self._is_layer else {}
+        buffers = capture_buffers(self._target) if self._is_layer else {}
+        jitted = self._get_jitted(self._target.training if self._is_layer else False)
+        lowered = jitted.lower(params, buffers, next_key(), arg_arrays, {})
+        self._last_lowered = lowered
+        return lowered
+
+    def hlo(self, *args, **kwargs):
+        return self.get_concrete_program(*args, **kwargs).as_text()
+
+    def forward(self, *args, **kwargs):
+        return self(*args, **kwargs)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    def decorate(target):
+        if isinstance(target, Layer):
+            # attach compiled forward while keeping Layer interface
+            target.forward = StaticFunction(target, input_spec)
+            return target
+        return StaticFunction(target, input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+_EXTRA_SUFFIX = ".pdiparams"
+_MODEL_SUFFIX = ".pdmodel"
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save: persist state_dict + layer pickle (ref jit/api.py save).
+    The XLA executable itself is cached by jax's compilation cache; what we
+    persist is enough to rebuild and re-jit on load."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {}
+    target = layer._target if isinstance(layer, StaticFunction) else layer
+    if isinstance(target, Layer):
+        for name, t in target.state_dict().items():
+            state[name] = np.asarray(t._data)
+    with open(path + _EXTRA_SUFFIX, "wb") as f:
+        pickle.dump(state, f)
+    try:
+        blob = pickle.dumps(target)
+    except Exception:
+        blob = None  # layer not picklable (closures etc.) — params alone still loadable
+    if blob is not None:
+        with open(path + _MODEL_SUFFIX, "wb") as f:
+            f.write(blob)
+
+
+def load(path, **configs):
+    model_file = path + _MODEL_SUFFIX
+    params_file = path + _EXTRA_SUFFIX
+    layer = None
+    if os.path.exists(model_file):
+        with open(model_file, "rb") as f:
+            layer = pickle.load(f)
+    with open(params_file, "rb") as f:
+        state = pickle.load(f)
+    if layer is not None:
+        sd = {k: Tensor(v) for k, v in state.items()}
+        layer.set_state_dict(sd)
+        return layer
+    return {k: Tensor(v) for k, v in state.items()}
+
+
+class TranslatedLayer(Layer):
+    """Parity alias: loaded layers behave as normal Layers."""

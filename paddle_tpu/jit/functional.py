@@ -1,0 +1,98 @@
+"""Functionalization of Layers.
+
+The reference converts dygraph code to a static Program via AST transforms
+(ref: python/paddle/jit/dy2static/program_translator.py). The TPU-native
+equivalent is simpler and stronger: a Layer's forward *is already traceable* —
+our eager ops are jax calls on `Tensor._data` — so we temporarily swap traced
+arrays into the layer's parameters/buffers and trace the call with jax. XLA
+then plays the role of ProgramDesc + executor + pass pipeline.
+
+Buffers (e.g. BN running stats) are functionalized: their post-forward values
+are returned as outputs and written back by the caller.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..tensor_impl import Tensor
+from ..framework import state as _st
+from ..framework.random import fork_rng
+
+
+def capture_params(layer):
+    """Current parameter arrays as a dict pytree {qualified_name: array}."""
+    return {name: p._data for name, p in layer.named_parameters()}
+
+
+def capture_buffers(layer):
+    return {name: b._data for name, b in layer.named_buffers()}
+
+
+def param_specs(layer):
+    """PartitionSpecs per param (set by parallel layers; None = replicated)."""
+    return {name: getattr(p, "dist_spec", None)
+            for name, p in layer.named_parameters()}
+
+
+@contextlib.contextmanager
+def _swapped(layer, params, buffers):
+    named_p = dict(layer.named_parameters())
+    named_b = dict(layer.named_buffers())
+    old_p = {n: t._data for n, t in named_p.items()}
+    old_b = {n: t._data for n, t in named_b.items()}
+    try:
+        for n, arr in params.items():
+            if n in named_p:
+                named_p[n]._data = arr
+        for n, arr in (buffers or {}).items():
+            if n in named_b:
+                named_b[n]._data = arr
+        yield named_b
+    finally:
+        for n, t in named_p.items():
+            t._data = old_p[n]
+        for n, t in named_b.items():
+            t._data = old_b[n]
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._data if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x) if not isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def functional_call(layer, params, buffers, args, kwargs=None, rng_key=None,
+                    forward_fn=None):
+    """Pure call: (params, buffers, inputs) -> (outputs, new_buffers).
+    All arrays (possibly tracers); outputs are arrays. `forward_fn` overrides
+    the callable (used by to_static to bypass its own compiled forward)."""
+    kwargs = kwargs or {}
+    call = forward_fn if forward_fn is not None else layer
+    wrapped_args = jax.tree_util.tree_map(
+        lambda x: Tensor(x) if not isinstance(x, Tensor) and hasattr(x, "dtype") else x,
+        args)
+    ctx = fork_rng(rng_key) if rng_key is not None else contextlib.nullcontext()
+    with _st.functional_trace(), ctx, _swapped(layer, params, buffers) as named_b:
+        out = call(*wrapped_args, **kwargs)
+        new_buffers = {n: t._data for n, t in named_b.items()}
+    return _unwrap(out), new_buffers
+
+
+def functional_fn_call(fn, args, kwargs=None, rng_key=None):
+    """Pure call of a free function written against the eager API."""
+    kwargs = kwargs or {}
+    wrapped_args = jax.tree_util.tree_map(
+        lambda x: Tensor(x) if not isinstance(x, Tensor) and hasattr(x, "dtype") else x,
+        args)
+    ctx = fork_rng(rng_key) if rng_key is not None else contextlib.nullcontext()
+    with _st.functional_trace(), ctx:
+        out = fn(*wrapped_args, **kwargs)
+    return _unwrap(out)

@@ -1,0 +1,182 @@
+"""TrainStep — compiled training step.
+
+The reference runs training as: dygraph forward -> C++ backward engine ->
+optimizer op kernels, or via Fleet's distributed graph passes. The TPU-native
+design compiles ONE pure XLA program per step:
+
+    (params, opt_state, lr, key, batch) -> (loss, new_params, new_opt_state)
+
+with `jax.value_and_grad` for the backward, the optimizer's functional rule
+fused in, buffers donated (in-place param update in HBM), and GSPMD shardings
+from each Parameter's `dist_spec` (set by fleet/parallel layers). XLA inserts
+all collectives (dp grad allreduce, tp activation collectives, ZeRO
+gather/scatter) from the sharding annotations — the ProcessGroupNCCL layer of
+the reference has no analog here because the compiler emits it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..tensor_impl import Tensor
+from ..framework.random import next_key
+from .functional import capture_params, capture_buffers, param_specs, functional_call
+
+
+class TrainStep:
+    def __init__(self, model, loss_fn, optimizer, mesh=None, donate=True,
+                 remat=False, batch_spec=None, loss_has_model_kw=False,
+                 extra_loss_args=0):
+        """loss_fn(outputs, *labels) -> scalar Tensor (written in eager API)."""
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.donate = donate
+        self.remat = remat
+        self.batch_spec = batch_spec
+        self._params = capture_params(model)
+        self._buffers = capture_buffers(model)
+        self._specs = param_specs(model)
+        self._opt_state = optimizer.init_state(self._params)
+        self._jitted = None
+        self._step = 0
+
+    # -- sharding helpers ----------------------------------------------------
+    def _sharding_for(self, spec):
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, spec if spec is not None else P())
+
+    def _param_shardings(self):
+        return {n: self._sharding_for(self._specs.get(n)) for n in self._params}
+
+    def _opt_shardings(self):
+        # slots mirror param shapes -> same sharding; scalars replicated
+        p_sh = self._param_shardings()
+
+        def slot_sharding(name, slots):
+            return {k: (self._sharding_for(P()) if jnp.ndim(v) == 0 else p_sh[name])
+                    for k, v in slots.items()}
+        return {"step": self._sharding_for(P()),
+                "slots": {n: slot_sharding(n, s)
+                          for n, s in self._opt_state["slots"].items()}}
+
+    def shard_params(self):
+        """Place current params/opt state onto the mesh per spec."""
+        if self.mesh is None:
+            return
+        p_sh = self._param_shardings()
+        self._params = {n: jax.device_put(a, p_sh[n]) for n, a in self._params.items()}
+        o_sh = self._opt_shardings()
+        self._opt_state = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, s), self._opt_state, o_sh,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+
+    # -- compiled step -------------------------------------------------------
+    def _build(self, batch_treedef, n_inputs):
+        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+        grad_clip = getattr(optimizer, "_grad_clip", None)
+        mesh = self.mesh
+        remat = self.remat
+
+        def loss_from(params, buffers, key, inputs, labels):
+            out, new_buffers = functional_call(model, params, buffers, inputs,
+                                               rng_key=key)
+            from ..framework import state as _st
+            with _st.functional_trace():
+                wrapped = jax.tree_util.tree_map(Tensor, out)
+                wrapped_labels = jax.tree_util.tree_map(
+                    lambda x: Tensor(x) if hasattr(x, "dtype") else x, labels)
+                loss_t = loss_fn(wrapped, *wrapped_labels) if isinstance(
+                    wrapped_labels, (list, tuple)) else loss_fn(wrapped, wrapped_labels)
+            loss = loss_t._data if isinstance(loss_t, Tensor) else loss_t
+            return loss.astype(jnp.float32), new_buffers
+
+        if remat:
+            loss_from = jax.checkpoint(loss_from, static_argnums=())
+
+        def step_fn(params, opt_state, buffers, lr, key, inputs, labels):
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_from, has_aux=True)(params, buffers, key, inputs, labels)
+            if grad_clip is not None:
+                names = list(grads)
+                clipped = grad_clip.apply_arrays([grads[n] for n in names])
+                grads = dict(zip(names, clipped))
+            new_params, new_opt = optimizer.apply_gradients(params, grads,
+                                                            opt_state, lr)
+            return loss, new_params, new_opt, new_buffers
+
+        donate = (0, 1) if self.donate else ()
+        if mesh is not None:
+            p_sh = self._param_shardings()
+            o_sh = self._opt_shardings()
+            rep = NamedSharding(mesh, P())
+            b_sh = {n: rep for n in self._buffers}
+            dp_axes = tuple(a for a in ("dp", "sdp") if a in mesh.axis_names)
+            data_spec = P(dp_axes if dp_axes else None)
+            data_sh = NamedSharding(mesh, data_spec)
+            in_shardings = (p_sh, o_sh, b_sh, rep, rep,
+                            jax.tree_util.tree_map(lambda _: data_sh,
+                                                   self._sample_inputs),
+                            jax.tree_util.tree_map(lambda _: data_sh,
+                                                   self._sample_labels))
+            out_shardings = (rep, p_sh, o_sh, b_sh)
+            return jax.jit(step_fn, donate_argnums=donate,
+                           in_shardings=in_shardings, out_shardings=out_shardings)
+        return jax.jit(step_fn, donate_argnums=donate)
+
+    def __call__(self, inputs, labels):
+        """inputs: Tensor or tuple of Tensors fed to model; labels likewise."""
+        if not isinstance(inputs, (list, tuple)):
+            inputs = (inputs,)
+        if not isinstance(labels, (list, tuple)):
+            labels = (labels,)
+        in_arrays = tuple(x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                          for x in inputs)
+        lab_arrays = tuple(x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                           for x in labels)
+        if self._jitted is None:
+            self._sample_inputs = in_arrays
+            self._sample_labels = lab_arrays
+            if self.mesh is not None:
+                self.shard_params()
+            self._jitted = self._build(None, len(in_arrays))
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss, self._params, self._opt_state, self._buffers = self._jitted(
+            self._params, self._opt_state, self._buffers, lr, next_key(),
+            in_arrays, lab_arrays)
+        self._step += 1
+        self.optimizer._step_count = self._step
+        return Tensor(loss)
+
+    def sync_to_model(self):
+        """Write the device-resident params/buffers back into the Layer tensors."""
+        named = dict(self.model.named_parameters())
+        for n, arr in self._params.items():
+            if n in named:
+                named[n]._data = arr
+        named_b = dict(self.model.named_buffers())
+        for n, arr in self._buffers.items():
+            if n in named_b:
+                named_b[n]._data = arr
+
+    @property
+    def params(self):
+        return self._params
+
+    @property
+    def opt_state(self):
+        return self._opt_state
+
+    def state_for_checkpoint(self):
+        return {"params": self._params, "opt_state": self._opt_state,
+                "buffers": self._buffers, "step": self._step}
+
+    def restore_from_checkpoint(self, state):
+        self._params = state["params"]
+        self._opt_state = state["opt_state"]
+        self._buffers = state["buffers"]
+        self._step = int(state["step"])
+        self.sync_to_model()

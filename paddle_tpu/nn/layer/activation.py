@@ -5,56 +5,225 @@ from ..layer_base import Layer
 from .. import functional as F
 
 
-def _simple(fname, **defaults):
-    class _Act(Layer):
-        def __init__(self, *args, **kwargs):
-            super().__init__()
-            self._kwargs = dict(defaults)
-            names = list(defaults)
-            for i, a in enumerate(args):
-                self._kwargs[names[i]] = a
-            for k, v in kwargs.items():
-                if k != "name":
-                    self._kwargs[k] = v
+class ReLU(Layer):
+    def __init__(self, name=None):
+        super().__init__()
 
-        def forward(self, x):
-            return getattr(F, fname)(x, **self._kwargs)
-    _Act.__name__ = "".join(w.capitalize() for w in fname.split("_"))
-    return _Act
+    def forward(self, x):
+        return F.relu(x)
 
 
-ReLU = _simple("relu")
-ReLU6 = _simple("relu6")
-Sigmoid = _simple("sigmoid")
-Tanh = _simple("tanh")
-Silu = _simple("silu")
-Swish = _simple("swish")
-Mish = _simple("mish")
-GELU = _simple("gelu", approximate=False)
-LeakyReLU = _simple("leaky_relu", negative_slope=0.01)
-ELU = _simple("elu", alpha=1.0)
-CELU = _simple("celu", alpha=1.0)
-SELU = _simple("selu", scale=1.0507009873554805, alpha=1.6732632423543772)
-Hardswish = _simple("hardswish")
-Hardsigmoid = _simple("hardsigmoid")
-Hardtanh = _simple("hardtanh", min=-1.0, max=1.0)
-Hardshrink = _simple("hardshrink", threshold=0.5)
-Softshrink = _simple("softshrink", threshold=0.5)
-Softplus = _simple("softplus", beta=1.0, threshold=20.0)
-Softsign = _simple("softsign")
-Tanhshrink = _simple("tanhshrink")
-ThresholdedReLU = _simple("thresholded_relu", threshold=1.0)
-LogSigmoid = _simple("sigmoid")  # replaced below
-Maxout = _simple("maxout", groups=2, axis=1)
-GLU = _simple("glu", axis=-1)
-RReLU = _simple("rrelu", lower=1.0 / 8.0, upper=1.0 / 3.0)
+class ReLU6(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.relu6(x)
 
 
-class LogSigmoid(Layer):  # noqa: F811
+class Sigmoid(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.sigmoid(x)
+
+
+class Tanh(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.tanh(x)
+
+
+class Silu(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Swish(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.swish(x)
+
+
+class Mish(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.mish(x)
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False, name=None):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F.gelu(x, self.approximate)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class ELU(Layer):
+    def __init__(self, alpha=1.0, name=None):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F.elu(x, self.alpha)
+
+
+class CELU(Layer):
+    def __init__(self, alpha=1.0, name=None):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F.celu(x, self.alpha)
+
+
+class SELU(Layer):
+    def __init__(self, scale=1.0507009873554805, alpha=1.6732632423543772,
+                 name=None):
+        super().__init__()
+        self.scale = scale
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F.selu(x, self.scale, self.alpha)
+
+
+class Hardswish(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.hardswish(x)
+
+
+class Hardsigmoid(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.hardsigmoid(x)
+
+
+class Hardtanh(Layer):
+    def __init__(self, min=-1.0, max=1.0, name=None):
+        super().__init__()
+        self.min = min
+        self.max = max
+
+    def forward(self, x):
+        return F.hardtanh(x, self.min, self.max)
+
+
+class Hardshrink(Layer):
+    def __init__(self, threshold=0.5, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.hardshrink(x, self.threshold)
+
+
+class Softshrink(Layer):
+    def __init__(self, threshold=0.5, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.softshrink(x, self.threshold)
+
+
+class Softplus(Layer):
+    def __init__(self, beta=1.0, threshold=20.0, name=None):
+        super().__init__()
+        self.beta = beta
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.softplus(x, self.beta, self.threshold)
+
+
+class Softsign(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.softsign(x)
+
+
+class Tanhshrink(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.tanhshrink(x)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self.threshold)
+
+
+class LogSigmoid(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
     def forward(self, x):
         import jax
         from ...dispatch import apply
         return apply(jax.nn.log_sigmoid, x, op_name="log_sigmoid")
+
+
+class Maxout(Layer):
+    def __init__(self, groups=2, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, self.axis)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, self.training)
 
 
 class Softmax(Layer):

@@ -5,42 +5,80 @@ from ..layer_base import Layer
 from .. import functional as F
 
 
-def _make_pool(fname, ndims, default_df):
-    class _Pool(Layer):
-        def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
-                     return_mask=False, exclusive=True, divisor_override=None,
-                     data_format=default_df, name=None):
-            super().__init__()
-            self.kernel_size = kernel_size
-            self.stride = stride
-            self.padding = padding
-            self.ceil_mode = ceil_mode
-            self.return_mask = return_mask
-            self.exclusive = exclusive
-            self.divisor_override = divisor_override
-            self.data_format = data_format
-
-        def forward(self, x):
-            fn = getattr(F, fname)
-            if fname.startswith("max"):
-                return fn(x, self.kernel_size, self.stride, self.padding,
-                          self.return_mask, self.ceil_mode, self.data_format)
-            if fname == "avg_pool1d":
-                return fn(x, self.kernel_size, self.stride, self.padding,
-                          self.exclusive, self.ceil_mode, self.data_format)
-            return fn(x, self.kernel_size, self.stride, self.padding,
-                      self.ceil_mode, self.exclusive, self.divisor_override,
-                      self.data_format)
-    _Pool.__name__ = "".join(w.capitalize() for w in fname.split("_"))
-    return _Pool
+class _MaxPoolNd(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.return_mask = return_mask
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format or self._default_df
 
 
-MaxPool1D = _make_pool("max_pool1d", 1, "NCL")
-MaxPool2D = _make_pool("max_pool2d", 2, "NCHW")
-MaxPool3D = _make_pool("max_pool3d", 3, "NCDHW")
-AvgPool1D = _make_pool("avg_pool1d", 1, "NCL")
-AvgPool2D = _make_pool("avg_pool2d", 2, "NCHW")
-AvgPool3D = _make_pool("avg_pool3d", 3, "NCDHW")
+class MaxPool1D(_MaxPoolNd):
+    _default_df = "NCL"
+
+    def forward(self, x):
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            self.return_mask, self.ceil_mode, self.data_format)
+
+
+class MaxPool2D(_MaxPoolNd):
+    _default_df = "NCHW"
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.return_mask, self.ceil_mode, self.data_format)
+
+
+class MaxPool3D(_MaxPoolNd):
+    _default_df = "NCDHW"
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.return_mask, self.ceil_mode, self.data_format)
+
+
+class _AvgPoolNd(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format=None,
+                 name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.exclusive = exclusive
+        self.divisor_override = divisor_override
+        self.data_format = data_format or self._default_df
+
+
+class AvgPool1D(_AvgPoolNd):
+    _default_df = "NCL"
+
+    def forward(self, x):
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            self.exclusive, self.ceil_mode, self.data_format)
+
+
+class AvgPool2D(_AvgPoolNd):
+    _default_df = "NCHW"
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.ceil_mode, self.exclusive, self.divisor_override,
+                            self.data_format)
+
+
+class AvgPool3D(_AvgPoolNd):
+    _default_df = "NCDHW"
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.ceil_mode, self.exclusive, self.divisor_override,
+                            self.data_format)
 
 
 class AdaptiveAvgPool1D(Layer):

@@ -1,0 +1,36 @@
+"""paddle_tpu.static — InputSpec + minimal static-graph parity surface.
+
+The reference's static graph Program/Executor stack maps to XLA compilation;
+`paddle_tpu.jit.to_static` is the supported route. InputSpec is kept since the
+dygraph API uses it for signature declaration (ref: python/paddle/static/input.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.state import to_jnp_dtype
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = tuple(shape)
+        self.dtype = to_jnp_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), tensor.dtype, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(self.shape[1:], self.dtype, self.name)
