@@ -49,7 +49,7 @@ from . import optimizer  # noqa: E402,F401
 from . import amp  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import static  # noqa: E402,F401
-# PENDING from . import distributed  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
 # PENDING from . import vision  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 # PENDING from . import models  # noqa: E402,F401
@@ -57,7 +57,7 @@ from . import framework  # noqa: E402,F401
 # PENDING from . import profiler  # noqa: E402,F401
 # PENDING from . import distribution  # noqa: E402,F401
 # PENDING from . import sparse  # noqa: E402,F401
-# PENDING save/load
+from .framework.io import save, load  # noqa: E402,F401
 # PENDING from .hapi import Model, summary  # noqa: E402,F401
 # PENDING from . import callbacks  # noqa: E402,F401
 

@@ -1,0 +1,43 @@
+"""paddle_tpu.distributed (ref: python/paddle/distributed/__init__.py)."""
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, world_size, ParallelEnv,
+    set_mesh, get_mesh, create_hybrid_mesh, HYBRID_AXES,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    all_gather_object, reduce_scatter, broadcast, scatter, alltoall,
+    alltoall_single, send, recv, p2p_shift, barrier, wait, reduce,
+)
+from . import fleet  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, reshard, shard_layer,
+    shard_optimizer, dtensor_from_fn,
+)
+from .pipeline import pipeline_spmd, run_pipeline, PipelineLayer, LayerDesc  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_spmd, ulysses_attention, ulysses_attention_spmd,
+)
+from .recompute import recompute  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .fleet.mp_layers import split  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Single-controller SPMD: all devices are driven by this process, so spawn
+    degenerates to a direct call (ref: distributed/spawn.py launches N procs)."""
+    func(*args)
+
+
+def launch():
+    raise NotImplementedError("use `python your_script.py` — single-controller "
+                              "SPMD drives all TPU chips from one process")
+
+
+def get_backend():
+    return "xla"
+
+
+def is_initialized():
+    from . import env as _env
+    return _env.is_initialized()

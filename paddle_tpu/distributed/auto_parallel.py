@@ -1,0 +1,114 @@
+"""Semi-automatic parallelism (ref: python/paddle/distributed/auto_parallel/).
+
+The reference's shard_tensor annotations + partitioner + reshard pipeline maps
+almost one-to-one onto GSPMD: `shard_tensor` attaches a PartitionSpec, the XLA
+partitioner propagates shardings and inserts resharding collectives. ProcessMesh
+wraps jax.sharding.Mesh.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tensor_impl import Tensor, Parameter
+from . import env
+
+
+class ProcessMesh:
+    """ref: auto_parallel/process_mesh.py."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.arange(int(np.prod(shape))).reshape(shape)
+        self.shape = list(arr.shape)
+        self.process_ids = arr.reshape(-1).tolist()
+        self.dim_names = list(dim_names) if dim_names else \
+            [f"d{i}" for i in range(arr.ndim)]
+        devices = np.asarray(jax.devices())[arr.reshape(-1)].reshape(arr.shape)
+        self._jax_mesh = Mesh(devices, tuple(self.dim_names))
+        env.set_mesh(self._jax_mesh)
+
+    @property
+    def mesh(self):
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and self.shape == other.shape
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+class Shard:
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate:
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial:
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+
+def _placements_to_spec(placements, ndim, mesh):
+    spec = [None] * ndim
+    for axis_i, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            spec[pl.dim] = mesh.dim_names[axis_i]
+    return P(*spec)
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None, stop_gradient=None):
+    """Attach a distribution annotation and place the data (ref:
+    auto_parallel/api.py shard_tensor)."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    spec = _placements_to_spec(placements, t._data.ndim, mesh)
+    sharding = NamedSharding(mesh.mesh, spec)
+    t._data = jax.device_put(t._data, sharding)
+    if isinstance(t, Parameter) or hasattr(t, "dist_spec"):
+        t.dist_spec = spec
+    else:
+        t._placeholder = spec
+    return t
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x, mesh: ProcessMesh, placements):
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    spec = _placements_to_spec(placements, t._data.ndim, mesh)
+    t2 = Tensor(jax.device_put(t._data, NamedSharding(mesh.mesh, spec)),
+                stop_gradient=t.stop_gradient)
+    return t2
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=None):
+    """Annotate a layer's params via shard_fn(name, layer, mesh) or replicate."""
+    for name, sub in layer.named_sublayers(include_self=True):
+        if shard_fn is not None:
+            shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    optimizer._shard_opt_states_axis = getattr(optimizer, "_shard_opt_states_axis",
+                                               None)
+    return optimizer
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    raise NotImplementedError(
+        "auto_parallel.to_static: use paddle_tpu.jit.TrainStep with a mesh; "
+        "GSPMD performs the partitioning that the reference's planner does.")

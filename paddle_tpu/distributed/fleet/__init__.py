@@ -1,0 +1,196 @@
+"""Fleet — hybrid-parallel orchestration.
+
+Re-design of python/paddle/distributed/fleet (fleet.py, meta_parallel/*):
+`fleet.init` builds the hybrid device mesh (pp × dp × sharding × sp × mp) from
+DistributedStrategy.hybrid_configs; `distributed_model` annotates parameter
+PartitionSpecs (ZeRO weight sharding) and returns the model;
+`distributed_optimizer` tags the optimizer with the sharding stage so
+TrainStep shards optimizer slots over the 'sharding' axis. The actual
+communication is emitted by XLA from these annotations — there is no runtime
+process-group layer to manage.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import env
+from ..env import create_hybrid_mesh, get_mesh
+from . import mp_layers  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from ..pipeline import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: F401
+
+
+class DistributedStrategy:
+    """ref: python/paddle/distributed/fleet/base/distributed_strategy.py."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "degree": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+
+
+class _HybridCommunicateGroup:
+    """Topology info accessor (ref: fleet/base/topology.py)."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def get_model_parallel_world_size(self):
+        return self._mesh.shape.get("mp", 1)
+
+    def get_data_parallel_world_size(self):
+        return self._mesh.shape.get("dp", 1)
+
+    def get_pipe_parallel_world_size(self):
+        return self._mesh.shape.get("pp", 1)
+
+    def get_sharding_parallel_world_size(self):
+        return self._mesh.shape.get("sharding", 1)
+
+    def get_model_parallel_group(self):
+        from ..collective import Group
+        return Group("mp")
+
+    def get_data_parallel_group(self):
+        from ..collective import Group
+        return Group("dp")
+
+    def get_pipe_parallel_group(self):
+        from ..collective import Group
+        return Group("pp")
+
+    def get_sharding_parallel_group(self):
+        from ..collective import Group
+        return Group("sharding")
+
+    # single-controller: rank-style accessors report coordinate 0 views
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._zero_stage = 0
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level=0):
+        self._strategy = strategy or DistributedStrategy()
+        env.init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        n = jax.device_count()
+        mp = hc.get("mp_degree", 1)
+        pp = hc.get("pp_degree", 1)
+        sh = hc.get("sharding_degree", 1)
+        sp = hc.get("sep_degree", 1)
+        dp = hc.get("dp_degree", 1)
+        if mp * pp * sh * sp * dp != n:
+            dp = -1  # absorb the remainder into dp, reference does the same
+        mesh = create_hybrid_mesh(dp=dp, mp=mp, pp=pp, sharding=sh, sp=sp)
+        self._hcg = _HybridCommunicateGroup(mesh)
+        if self._strategy.sharding:
+            self._zero_stage = int(self._strategy.sharding_configs.get("stage", 1))
+        return self
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def is_first_worker(self):
+        return jax.process_index() == 0
+
+    def barrier_worker(self):
+        pass
+
+    def distributed_model(self, model):
+        """Annotate params for the active parallel axes. TP layers already
+        carry specs; ZeRO stage-3 additionally shards every remaining param's
+        largest dim over 'sharding'."""
+        mesh = get_mesh()
+        if mesh is None:
+            return model
+        if self._zero_stage >= 3 and mesh.shape.get("sharding", 1) > 1:
+            for _, p in model.named_parameters():
+                if p.dist_spec is not None:
+                    continue
+                shape = tuple(p.shape)
+                if not shape:
+                    continue
+                axis = max(range(len(shape)), key=lambda i: shape[i])
+                if shape[axis] % mesh.shape["sharding"] == 0:
+                    spec = [None] * len(shape)
+                    spec[axis] = "sharding"
+                    p.dist_spec = P(*spec)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        optimizer._zero_stage = self._zero_stage
+        optimizer._shard_opt_states_axis = (
+            "sharding" if self._zero_stage >= 1 and
+            (get_mesh() and get_mesh().shape.get("sharding", 1) > 1) else None)
+        return optimizer
+
+
+_fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level=0):
+    return _fleet.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return _fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group():
+    return _fleet.get_hybrid_communicate_group()
+
+
+def worker_num():
+    return _fleet.worker_num()
+
+
+def worker_index():
+    return _fleet.worker_index()
+
+
+def is_first_worker():
+    return _fleet.is_first_worker()
+
+
+fleet = _fleet

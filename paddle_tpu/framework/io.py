@@ -1,0 +1,57 @@
+"""paddle.save / paddle.load (ref: python/paddle/framework/io.py).
+
+Pickle-based state persistence. Tensors serialize as numpy arrays; nested
+dicts/lists/state_dicts round-trip. Distributed arrays are fetched to host
+(fully replicated view) before saving — sharded/async checkpointing lives in
+paddle_tpu.incubate.checkpoint.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax
+
+from ..tensor_impl import Tensor
+
+
+def _to_savable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(jax.device_get(obj._data)),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, jax.Array):
+        return {"__tensor__": True, "data": np.asarray(jax.device_get(obj)),
+                "stop_gradient": True, "name": None}
+    if isinstance(obj, dict):
+        return {k: _to_savable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_savable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_savable(obj):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name")
+            return t
+        return {k: _from_savable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_savable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_savable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _from_savable(pickle.load(f))

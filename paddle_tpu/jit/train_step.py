@@ -53,12 +53,27 @@ class TrainStep:
         return {n: self._sharding_for(self._specs.get(n)) for n in self._params}
 
     def _opt_shardings(self):
-        # slots mirror param shapes -> same sharding; scalars replicated
+        # slots mirror param shapes -> same sharding; scalars replicated.
+        # ZeRO stage>=1 (fleet sharding): slots of replicated params shard
+        # over the 'sharding' axis (ref: fleet sharding stage1/2 optimizer
+        # state partitioning) — XLA gathers shards during the fused update.
         p_sh = self._param_shardings()
+        zero_axis = getattr(self.optimizer, "_shard_opt_states_axis", None)
+        zero_n = self.mesh.shape.get(zero_axis, 1) if (
+            self.mesh is not None and zero_axis) else 1
 
         def slot_sharding(name, slots):
-            return {k: (self._sharding_for(P()) if jnp.ndim(v) == 0 else p_sh[name])
-                    for k, v in slots.items()}
+            out = {}
+            for k, v in slots.items():
+                if jnp.ndim(v) == 0:
+                    out[k] = self._sharding_for(P())
+                elif (zero_n > 1 and self._specs.get(name) is None
+                      and v.shape[0] % zero_n == 0):
+                    out[k] = self._sharding_for(
+                        P(zero_axis, *([None] * (v.ndim - 1))))
+                else:
+                    out[k] = p_sh[name]
+            return out
         return {"step": self._sharding_for(P()),
                 "slots": {n: slot_sharding(n, s)
                           for n, s in self._opt_state["slots"].items()}}
