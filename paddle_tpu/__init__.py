@@ -45,12 +45,12 @@ from . import autograd  # noqa: E402,F401
 from .autograd import grad  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
-# PENDING from . import io  # noqa: E402,F401
+from . import io  # noqa: E402,F401
 from . import amp  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
-# PENDING from . import vision  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 # PENDING from . import models  # noqa: E402,F401
 from . import framework  # noqa: E402,F401

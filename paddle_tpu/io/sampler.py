@@ -1,0 +1,156 @@
+"""Samplers (ref: python/paddle/io/dataloader/sampler.py, batch_sampler.py,
+distributed_sampler → DistributedBatchSampler)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.random import next_key
+
+
+def _perm(n):
+    import jax
+    return np.asarray(jax.random.permutation(next_key(), n))
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            import jax
+            idx = np.asarray(jax.random.randint(next_key(), (self.num_samples,), 0, n))
+            return iter(idx.tolist())
+        return iter(_perm(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    def __init__(self, indices, generator=None):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        return iter(np.asarray(self.indices)[_perm(len(self.indices))].tolist())
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        import jax
+        key = next_key()
+        idx = np.asarray(jax.random.choice(
+            key, len(self.weights), (self.num_samples,),
+            replace=self.replacement, p=p))
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
+                 drop_last=False):
+        assert dataset is not None or sampler is not None
+        if sampler is None:
+            sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """ref: io/dataloader/distributed_sampler — per-host shard of the global
+    index stream. On single-controller TPU, the global batch is fed whole and
+    GSPMD shards it over 'dp'; this sampler exists for multi-HOST input
+    pipelines, where each host loads 1/num_replicas of the data."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        import jax
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.nranks = num_replicas if num_replicas is not None else \
+            jax.process_count()
+        self.local_rank = rank if rank is not None else jax.process_index()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            import jax
+            key = jax.random.key(self.epoch)
+            indices = np.asarray(jax.random.permutation(key, n))
+            self.epoch += 1
+        indices = np.concatenate([indices, indices[:self.total_size - n]])
+        local = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in local.tolist():
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch

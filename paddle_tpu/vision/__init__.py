@@ -1,0 +1,13 @@
+"""paddle_tpu.vision (ref: python/paddle/vision/__init__.py)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import ops  # noqa: F401
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,165 @@
+"""Vision datasets (ref: python/paddle/vision/datasets/*).
+
+Zero-egress environment: the loaders read the reference's on-disk formats when
+files are present (MNIST idx-gzip, Cifar pickle-tar) and otherwise fall back to
+a deterministic synthetic dataset with the right shapes/classes, so training
+examples and tests run anywhere.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic labeled images (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx % 2 ** 31)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = idx % self.num_classes
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+
+class MNIST(Dataset):
+    """ref: python/paddle/vision/datasets/mnist.py — idx/gzip reader with
+    synthetic fallback."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.images, self.labels = self._load(image_path, label_path)
+
+    def _load(self, image_path, label_path):
+        if image_path and os.path.exists(image_path) and label_path and \
+                os.path.exists(label_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8)
+            return images.astype(np.float32)[..., None], labels.astype(np.int64)
+        # synthetic fallback: blob-per-class images, learnable by LeNet
+        n = 2048 if self.mode == "train" else 512
+        rng = np.random.RandomState(42 if self.mode == "train" else 7)
+        labels = rng.randint(0, 10, n).astype(np.int64)
+        images = np.zeros((n, 28, 28, 1), np.float32)
+        for i, lab in enumerate(labels):
+            r, c = 4 + (lab // 5) * 10, 4 + (lab % 5) * 4
+            images[i, r:r + 8, c:c + 4, 0] = 1.0
+            images[i] += rng.randn(28, 28, 1).astype(np.float32) * 0.1
+        return images, labels
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.images, self.labels = self._load(data_file)
+
+    def _load(self, data_file):
+        if data_file and os.path.exists(data_file):
+            images, labels = [], []
+            with tarfile.open(data_file) as tf:
+                names = [m for m in tf.getnames()
+                         if ("data_batch" in m if self.mode == "train"
+                             else "test_batch" in m)]
+                for name in sorted(names):
+                    d = pickle.load(tf.extractfile(name), encoding="bytes")
+                    images.append(d[b"data"].reshape(-1, 3, 32, 32))
+                    labels.extend(d[b"labels"])
+            return (np.concatenate(images).transpose(0, 2, 3, 1).astype(np.float32),
+                    np.asarray(labels, np.int64))
+        n = 2048 if self.mode == "train" else 512
+        rng = np.random.RandomState(0 if self.mode == "train" else 1)
+        labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+        images = rng.rand(n, 32, 32, 3).astype(np.float32)
+        for i, lab in enumerate(labels):
+            images[i, :, :, lab % 3] += lab / self.NUM_CLASSES
+        return images, labels
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class DatasetFolder(Dataset):
+    """ref: vision/datasets/folder.py — directory-of-class-folders images."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.extensions = extensions or (".npy",)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.endswith(tuple(self.extensions)):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = np.load(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+
+ImageFolder = DatasetFolder

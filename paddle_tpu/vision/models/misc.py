@@ -1,0 +1,321 @@
+"""SqueezeNet, ShuffleNetV2, DenseNet, GoogLeNet, InceptionV3
+(ref: python/paddle/vision/models/{squeezenet,shufflenetv2,densenet,googlenet,
+inceptionv3}.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import manipulation as M
+
+
+# -- SqueezeNet --------------------------------------------------------------
+class Fire(nn.Layer):
+    def __init__(self, in_c, squeeze_c, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze_c, 1)
+        self.e1 = nn.Conv2D(squeeze_c, e1, 1)
+        self.e3 = nn.Conv2D(squeeze_c, e3, 3, padding=1)
+
+    def forward(self, x):
+        x = nn.functional.relu(self.squeeze(x))
+        return M.concat([nn.functional.relu(self.e1(x)),
+                         nn.functional.relu(self.e3(x))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64), nn.MaxPool2D(3, 2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.classifier(x)
+        return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)
+
+
+# -- ShuffleNetV2 ------------------------------------------------------------
+def channel_shuffle(x, groups):
+    return nn.functional.channel_shuffle(x, groups)
+
+
+class InvertedResidualSF(nn.Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch = oup // 2
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride, 1, groups=inp, bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU())
+        in2 = inp if stride > 1 else branch
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in2, branch, 1, bias_attr=False), nn.BatchNorm2D(branch),
+            nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride, 1, groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False), nn.BatchNorm2D(branch),
+            nn.ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = M.split(x, 2, axis=1)
+            out = M.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = M.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        stage_repeats = [4, 8, 4]
+        cfg = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+               0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+               1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048]}
+        out_channels = cfg[scale]
+        self.num_classes = num_classes
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, out_channels[0], 3, 2, 1, bias_attr=False),
+            nn.BatchNorm2D(out_channels[0]), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, 2, 1)
+        in_c = out_channels[0]
+        stages = []
+        for i, reps in enumerate(stage_repeats):
+            out_c = out_channels[i + 1]
+            seq = [InvertedResidualSF(in_c, out_c, 2)]
+            for _ in range(reps - 1):
+                seq.append(InvertedResidualSF(out_c, out_c, 1))
+            stages.append(nn.Sequential(*seq))
+            in_c = out_c
+        self.stages = nn.LayerList(stages)
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(in_c, out_channels[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(out_channels[-1]), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(out_channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.conv5(x)
+        x = self.pool(x)
+        return self.fc(x.flatten(1))
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.5, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.25, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(2.0, **kwargs)
+
+
+# -- DenseNet ----------------------------------------------------------------
+class DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                               bias_attr=False)
+        self.drop_rate = drop_rate
+
+    def forward(self, x):
+        out = self.conv1(nn.functional.relu(self.bn1(x)))
+        out = self.conv2(nn.functional.relu(self.bn2(out)))
+        if self.drop_rate > 0:
+            out = nn.functional.dropout(out, self.drop_rate, training=self.training)
+        return M.concat([x, out], axis=1)
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        cfg = {121: (32, [6, 12, 24, 16]), 161: (48, [6, 12, 36, 24]),
+               169: (32, [6, 12, 32, 32]), 201: (32, [6, 12, 48, 32]),
+               264: (32, [6, 12, 64, 48])}
+        growth, block_cfg = cfg[layers]
+        num_init = 2 * growth
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, num_init, 7, 2, 3, bias_attr=False),
+            nn.BatchNorm2D(num_init), nn.ReLU(), nn.MaxPool2D(3, 2, 1))
+        blocks = []
+        c = num_init
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if i != len(block_cfg) - 1:
+                blocks.append(nn.Sequential(
+                    nn.BatchNorm2D(c), nn.ReLU(),
+                    nn.Conv2D(c, c // 2, 1, bias_attr=False), nn.AvgPool2D(2, 2)))
+                c //= 2
+        self.features = nn.Sequential(*blocks)
+        self.bn_final = nn.BatchNorm2D(c)
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        x = self.features(x)
+        x = nn.functional.relu(self.bn_final(x))
+        x = self.pool(x)
+        return self.fc(x.flatten(1))
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(264, **kwargs)
+
+
+# -- GoogLeNet ---------------------------------------------------------------
+class Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(in_c, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(in_c, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, 1),
+                                nn.Conv2D(in_c, proj, 1), nn.ReLU())
+
+    def forward(self, x):
+        return M.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, 2, 3), nn.ReLU(), nn.MaxPool2D(3, 2, 1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(), nn.MaxPool2D(3, 2, 1))
+        self.i3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, 1)
+        self.i4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, 1)
+        self.i5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        self.pool5 = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.4)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
+        x = self.i5b(self.i5a(x))
+        x = self.pool5(x).flatten(1)
+        out = self.fc(self.dropout(x))
+        # reference returns (out, aux1, aux2); aux heads are train-time only
+        return out, out, out
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)
+
+
+# -- InceptionV3 (compact faithful topology) ---------------------------------
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+
+        def conv_bn(i, o, k, s=1, p=0):
+            return nn.Sequential(nn.Conv2D(i, o, k, s, p, bias_attr=False),
+                                 nn.BatchNorm2D(o), nn.ReLU())
+        self.stem = nn.Sequential(
+            conv_bn(3, 32, 3, 2), conv_bn(32, 32, 3), conv_bn(32, 64, 3, 1, 1),
+            nn.MaxPool2D(3, 2), conv_bn(64, 80, 1), conv_bn(80, 192, 3),
+            nn.MaxPool2D(3, 2))
+        self.mixed = nn.Sequential(
+            Inception(192, 64, 48, 64, 64, 96, 32),
+            Inception(256, 64, 48, 64, 64, 96, 64),
+            Inception(288, 64, 48, 64, 64, 96, 64),
+            nn.MaxPool2D(3, 2, 1),
+            Inception(288, 192, 128, 192, 128, 192, 192),
+            Inception(768, 192, 160, 192, 160, 192, 192),
+            nn.MaxPool2D(3, 2, 1),
+            Inception(768, 320, 192, 384, 192, 384, 192),
+        )
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.5)
+        self.fc = nn.Linear(1280, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.mixed(x)
+        x = self.pool(x).flatten(1)
+        return self.fc(self.dropout(x))
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

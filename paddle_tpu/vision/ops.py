@@ -1,0 +1,85 @@
+"""Vision ops (ref: python/paddle/vision/ops.py) — detection-support subset."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatch import apply as _apply
+from ..tensor_impl import Tensor, as_tensor_data
+
+
+def box_area(boxes):
+    return _apply(lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]),
+                  boxes, op_name="box_area")
+
+
+def box_iou(boxes1, boxes2):
+    def f(b1, b2):
+        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+    return _apply(f, boxes1, boxes2, op_name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    """Host-side NMS (dynamic output size — eager only, like reference dygraph)."""
+    b = np.asarray(as_tensor_data(boxes))
+    s = np.asarray(as_tensor_data(scores)) if scores is not None else \
+        np.arange(len(b), 0, -1).astype(np.float32)
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """Simplified RoIAlign via bilinear grid sampling."""
+    from ..nn.functional.common import grid_sample
+
+    def f(feat, bx):
+        oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+            else output_size
+        n = bx.shape[0]
+        x1, y1, x2, y2 = [bx[:, i] * spatial_scale for i in range(4)]
+        H, W = feat.shape[2], feat.shape[3]
+        ys = jnp.linspace(0, 1, oh)
+        xs = jnp.linspace(0, 1, ow)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        cy = y1[:, None, None] + gy[None] * (y2 - y1)[:, None, None]
+        cx = x1[:, None, None] + gx[None] * (x2 - x1)[:, None, None]
+        # normalize to [-1, 1] for grid_sample
+        ny = cy / (H - 1) * 2 - 1
+        nx = cx / (W - 1) * 2 - 1
+        grid = jnp.stack([nx, ny], axis=-1)
+        # one roi per batch-0 feature (single-image simplification)
+        feats = jnp.broadcast_to(feat[0:1], (n,) + feat.shape[1:])
+        from ..nn.functional.common import grid_sample as _gs
+        return _gs(Tensor(feats), Tensor(grid))._data
+    return _apply(f, x, boxes, op_name="roi_align")
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError("deform_conv2d: planned (gather-based impl)")

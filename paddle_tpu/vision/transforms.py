@@ -1,0 +1,263 @@
+"""Vision transforms (ref: python/paddle/vision/transforms/transforms.py).
+
+Numpy/host-side preprocessing (HWC uint8 images in, CHW float tensors out) —
+the device never sees un-batched images.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..tensor_impl import Tensor
+from ..framework.random import next_key
+
+
+def _rand():
+    import jax
+    return float(jax.random.uniform(next_key(), ()))
+
+
+def _to_numpy(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._data)
+    return np.asarray(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        if arr.dtype == np.uint8 or arr.max() > 1.5:
+            arr = arr / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return Tensor(arr)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        out = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def _resize_np(arr, size, interpolation="bilinear"):
+    """arr HWC float/uint8 -> resized via jax.image (host small arrays)."""
+    import jax
+    import jax.numpy as jnp
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            new = (size, int(w * size / h))
+        else:
+            new = (int(h * size / w), size)
+    else:
+        new = tuple(size)
+    method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}.get(
+        interpolation, "linear")
+    out = jax.image.resize(jnp.asarray(arr, jnp.float32),
+                           new + tuple(arr.shape[2:]), method=method)
+    return np.asarray(out)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return _resize_np(_to_numpy(img), self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else \
+                [self.padding] * 4
+            arr = np.pad(arr, ((p[1], p[3]), (p[0], p[2])) + ((0, 0),) * (arr.ndim - 2))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = int(_rand() * max(h - th, 0))
+        j = int(_rand() * max(w - tw, 0))
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if _rand() < self.prob:
+            return _to_numpy(img)[:, ::-1].copy()
+        return _to_numpy(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if _rand() < self.prob:
+            return _to_numpy(img)[::-1].copy()
+        return _to_numpy(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * (self.scale[0] + _rand() * (self.scale[1] - self.scale[0]))
+            logr = np.log(self.ratio[0]) + _rand() * (
+                np.log(self.ratio[1]) - np.log(self.ratio[0]))
+            ar = np.exp(logr)
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = int(_rand() * (h - th + 1))
+                j = int(_rand() * (w - tw + 1))
+                return _resize_np(arr[i:i + th, j:j + tw], self.size,
+                                  self.interpolation)
+        return _resize_np(CenterCrop(min(h, w))._apply_image(arr), self.size,
+                          self.interpolation)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        f = 1 + (2 * _rand() - 1) * self.value
+        return np.clip(arr * f, 0, 255 if arr.max() > 1.5 else 1.0)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        f = 1 + (2 * _rand() - 1) * self.value
+        mean = arr.mean()
+        return np.clip((arr - mean) * f + mean, 0, 255 if arr.max() > 1.5 else 1.0)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False, center=None,
+                 fill=0, keys=None):
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) \
+            else tuple(degrees)
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        deg = self.degrees[0] + _rand() * (self.degrees[1] - self.degrees[0])
+        k = int(round(deg / 90.0)) % 4  # coarse rotation (host-side, no scipy)
+        return np.rot90(arr, k=k, axes=(0, 1)).copy()
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding if isinstance(padding, (list, tuple)) else \
+            [padding] * 4
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        p = self.padding
+        if len(p) == 2:
+            p = [p[0], p[1], p[0], p[1]]
+        return np.pad(arr, ((p[1], p[3]), (p[0], p[2])) + ((0, 0),) * (arr.ndim - 2),
+                      constant_values=self.fill)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    return _to_numpy(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _to_numpy(img)[::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def crop(img, top, left, height, width):
+    return _to_numpy(img)[top:top + height, left:left + width]
