@@ -1,0 +1,61 @@
+"""Blockwise (flash-style) attention in XLA.
+
+Memory-efficient attention: lax.scan over KV blocks with online-softmax
+accumulators (fp32), so the S×S score matrix is never materialized — O(S·Bk)
+working set instead. Fully differentiable (scan transposes cleanly), so this is
+the TRAINING path; the pallas kernel (pallas_kernels/flash_attention.py) uses
+it as the reference/backward.
+
+Layout [batch, seq, heads, head_dim] matching the reference's flash_attention
+API (ref: python/paddle/incubate/nn/functional flash_attention).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_k"))
+def blockwise_attention(q, k, v, causal=True, block_k=512):
+    """q,k,v: [B, S, H, D] -> [B, S, H, D]."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    block_k = min(block_k, Sk)
+    nk = Sk // block_k
+    assert Sk % block_k == 0, f"seq {Sk} % block {block_k} != 0"
+    scale = D ** -0.5
+
+    qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # [B,H,Sq,D]
+    kf = k.astype(jnp.float32).transpose(0, 2, 1, 3)
+    vf = v.astype(jnp.float32).transpose(0, 2, 1, 3)
+    kblocks = kf.reshape(B, H, nk, block_k, D).transpose(2, 0, 1, 3, 4)
+    vblocks = vf.reshape(B, H, nk, block_k, D).transpose(2, 0, 1, 3, 4)
+
+    q_pos = jnp.arange(Sq)
+
+    def body(carry, blk):
+        m, l, acc = carry
+        kb, vb, kidx = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb)
+        if causal:
+            k_pos = kidx * block_k + jnp.arange(block_k)
+            mask = k_pos[None, :] <= q_pos[:, None]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0), (kblocks, vblocks, jnp.arange(nk)))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -1,0 +1,135 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Replaces the reference's fused CUDA flash_attention (ref: paddle/phi/kernels/
+gpu/flash_attn_kernel.cu capability) with a TPU-native kernel: the grid walks
+(batch·head, q-block, k-block); per q-block online-softmax state (m, l, acc)
+lives in VMEM scratch across the k-block sweep, scores are computed on the MXU
+in fp32, and causal q<k blocks are skipped entirely (predicated grid steps).
+
+Backward: custom_vjp recomputes via the differentiable blockwise XLA path
+(ops/blockwise_attention.py) — flash-style memory behavior in both directions.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..blockwise_attention import blockwise_attention
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                causal, nk, bq, bk, scale):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    scale32 = jnp.float32(scale)
+    neg_inf = jnp.float32(_NEG_INF)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, jnp.float32(_NEG_INF))
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = (ki <= qi) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, :, :].astype(jnp.float32)      # [bq, D]
+        k = k_ref[0, :, :].astype(jnp.float32)      # [bk, D]
+        v = v_ref[0, :, :].astype(jnp.float32)      # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale32  # [bq, bk]
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(k_pos <= q_pos, s, neg_inf)
+        # m/l live lane-broadcast in (bq, 128) scratch (TPU tiling needs
+        # lane dim 128); all 128 lanes hold the same value.
+        m_prev = jnp.max(m_scr[:, :], axis=1, keepdims=True)     # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                       # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)               # [bq, 1]
+        l_prev = jnp.max(l_scr[:, :], axis=1, keepdims=True)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:, :] = acc_scr[:, :] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:, :] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:, :] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(jnp.max(l_scr[:, :], axis=1, keepdims=True),
+                        jnp.float32(1e-30))
+        o_ref[0, :, :] = (acc_scr[:, :] / l).astype(o_ref.dtype)
+
+
+def _pallas_forward(q, k, v, causal, block_q=256, block_k=256):
+    """q,k,v: [B, S, H, D] -> [B, S, H, D]. Head dim padded to a lane (128)
+    multiple — zero columns don't change scores or outputs."""
+    D0 = q.shape[-1]
+    if D0 % 128 != 0:
+        pad = 128 - D0 % 128
+        q, k, v = (jnp.pad(t, ((0, 0), (0, 0), (0, 0), (0, pad)))
+                   for t in (q, k, v))
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    assert Sq % block_q == 0 and Sk % block_k == 0
+    nq, nk = Sq // block_q, Sk // block_k
+    scale = D0 ** -0.5
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    grid = (B * H, nq, nk)
+    kernel = functools.partial(_fwd_kernel, causal=causal, nk=nk, bq=block_q,
+                               bk=block_k, scale=scale)
+    # Mosaic rejects x64-typed index math; the framework enables x64 globally
+    # for dtype parity, so pin 32-bit types inside the kernel trace.
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, D), jnp.float32),
+            ],
+        )(qb, kb, vb)
+    out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    return out[..., :D0] if D0 != D else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention_bshd(q, k, v, causal=True):
+    return _pallas_forward(q, k, v, causal)
+
+
+def _vjp_fwd(q, k, v, causal):
+    return _pallas_forward(q, k, v, causal), (q, k, v)
+
+
+def _vjp_bwd(causal, residuals, g):
+    q, k, v = residuals
+    _, pullback = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal), q, k, v)
+    return pullback(g)
+
+
+flash_attention_bshd.defvjp(_vjp_fwd, _vjp_bwd)
