@@ -52,7 +52,7 @@ from . import static  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
-# PENDING from . import models  # noqa: E402,F401
+from . import models  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
 # PENDING from . import profiler  # noqa: E402,F401
 # PENDING from . import distribution  # noqa: E402,F401

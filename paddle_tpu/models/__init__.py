@@ -1,0 +1,13 @@
+"""paddle_tpu.models — NLP model families (capability parity with the
+reference's Fleet/PaddleNLP benchmark stack; see BASELINE.json configs)."""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPT_CONFIGS, GPTModel, GPTForCausalLM, GPTBlock, gpt_loss_fn,
+    gpt_block_fn, stack_block_params,
+)
+from .bert import (  # noqa: F401
+    BertConfig, BERT_CONFIGS, BertModel, BertForPretraining,
+    BertForSequenceClassification,
+)
+from .ernie import (  # noqa: F401
+    ErnieConfig, ERNIE_CONFIGS, ErnieModel, ErnieForMaskedLM,
+)

@@ -1,0 +1,59 @@
+"""ERNIE-style encoder (capability target: ERNIE-3.0 auto_parallel benchmark
+config in BASELINE — a BERT-family encoder with task-specific heads; the
+knowledge-masking objectives live in data prep, not the architecture)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn.layer_base import Layer
+from .bert import BertConfig, BertModel
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    vocab_size: int = 40000
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+
+
+ERNIE_CONFIGS = {
+    "ernie-base": ErnieConfig(),
+    "ernie-3.0-10B": ErnieConfig(hidden_size=4096, num_hidden_layers=48,
+                                 num_attention_heads=64, intermediate_size=16384),
+}
+
+
+class ErnieModel(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.config = cfg
+        self.encoder_model = BertModel(cfg)
+        if cfg.use_task_id:
+            self.task_embedding = nn.Embedding(cfg.task_type_vocab_size,
+                                               cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, task_ids=None,
+                attention_mask=None):
+        # task-type embedding folds into the shared embedding sum
+        if task_ids is not None and self.config.use_task_id:
+            emb_layer = self.encoder_model.embeddings
+            base = emb_layer(input_ids, token_type_ids)
+            base = base + self.task_embedding(task_ids)
+            seq = self.encoder_model.encoder(base, attention_mask)
+            import paddle_tpu.nn.functional as F
+            pooled = F.tanh(self.encoder_model.pooler(seq[:, 0]))
+            return seq, pooled
+        return self.encoder_model(input_ids, token_type_ids, attention_mask)
+
+
+class ErnieForMaskedLM(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.head = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, task_ids=None,
+                attention_mask=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, task_ids, attention_mask)
+        return self.head(seq)

@@ -1,0 +1,241 @@
+"""GPT — flagship decoder-only LM.
+
+Capability target: the reference's Fleet GPT-3 pretraining stack
+(PaddleNLP GPT + fleet hybrid parallel; ref distributed surface:
+python/paddle/distributed/fleet/meta_parallel). Design is TPU-first:
+
+  * pre-LN transformer blocks; QKV fused column-parallel matmul, row-parallel
+    output/down projections (GSPMD 'mp' specs from fleet.mp_layers);
+  * attention via the pallas flash kernel on TPU (blockwise XLA elsewhere);
+  * weights created in fp32, compute dtype bf16 via a config switch (MXU path);
+  * `gpt_block_fn` exposes the block as a pure (params, x) function so the
+    same weights drive eager, jit, and the pipeline/scan hybrid path.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer_base import Layer
+from ..nn import functional as F
+from ..tensor_impl import Tensor
+from ..tensor import manipulation as M
+from ..dispatch import apply as _apply
+from ..distributed.fleet.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+from ..ops.blockwise_attention import blockwise_attention
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    max_seq_len: int = 2048
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_flash: bool = True
+    compute_dtype: str = "bfloat16"
+    remat: bool = True
+    tie_embeddings: bool = False
+
+
+# headline model family (GPT-3 sizes; ref benchmark configs)
+GPT_CONFIGS = {
+    "gpt3-125M": GPTConfig(hidden_size=768, num_layers=12, num_heads=12),
+    "gpt3-345M": GPTConfig(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt3-760M": GPTConfig(hidden_size=1536, num_layers=24, num_heads=16),
+    "gpt3-1.3B": GPTConfig(hidden_size=2048, num_layers=24, num_heads=16),
+    "gpt3-2.7B": GPTConfig(hidden_size=2560, num_layers=32, num_heads=32),
+    "gpt3-6.7B": GPTConfig(hidden_size=4096, num_layers=32, num_heads=32),
+    "gpt3-13B": GPTConfig(hidden_size=5120, num_layers=40, num_heads=40),
+}
+
+
+def _attention(q, k, v, use_flash, causal=True):
+    """q,k,v arrays [B,S,H,D] -> [B,S,H,D]."""
+    if use_flash and jax.default_backend() == "tpu" and q.shape[1] % 256 == 0:
+        from ..ops.pallas_kernels.flash_attention import flash_attention_bshd
+        return flash_attention_bshd(q, k, v, causal)
+    return blockwise_attention(q, k, v, causal=causal)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.cfg = config
+        h = config.hidden_size
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+
+    def forward(self, x):
+        cfg = self.cfg
+        B, S, Hd = x.shape
+        nh = cfg.num_heads
+        d = Hd // nh
+        qkv = self.qkv_proj(x)
+        use_flash = cfg.use_flash
+
+        def attn(qkv_arr):
+            q, k, v = jnp.split(qkv_arr.reshape(B, S, 3, nh, d), 3, axis=2)
+            out = _attention(q[:, :, 0], k[:, :, 0], v[:, :, 0], use_flash)
+            return out.reshape(B, S, nh * d)
+
+        ctx = _apply(attn, qkv, op_name="flash_attention")
+        return self.out_proj(ctx)
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        inner = config.ffn_mult * h
+        self.up_proj = ColumnParallelLinear(h, inner, gather_output=False)
+        self.down_proj = RowParallelLinear(inner, h, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.gelu(self.up_proj(x), approximate=True))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.wte = VocabParallelEmbedding(config.vocab_size, config.hidden_size,
+                                          weight_attr=nn.ParamAttr(initializer=init))
+        self.wpe = nn.Embedding(config.max_seq_len, config.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.drop = nn.Dropout(config.dropout)
+        self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        B, S = input_ids.shape
+        pos = Tensor(jnp.arange(S, dtype=jnp.int32)[None, :])
+        x = self.wte(input_ids) + self.wpe(pos)
+        cd = self.config.compute_dtype
+        if cd:
+            x = x.astype(cd)
+        x = self.drop(x)
+        from ..distributed import recompute as _rc
+        for block in self.h:
+            if self.config.remat:
+                x = _rc.recompute(block, x, policy="dots_no_batch")
+            else:
+                x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_embeddings:
+            self.lm_head = None
+        else:
+            init = nn.initializer.Normal(0.0, config.initializer_range)
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False,
+                weight_attr=nn.ParamAttr(initializer=init))
+
+    def forward(self, input_ids):
+        hidden = self.gpt(input_ids)
+        hidden = hidden.astype("float32")
+        if self.lm_head is None:  # tied embeddings
+            return F.linear(hidden, M.transpose(self.gpt.wte.weight, [1, 0]))
+        return self.lm_head(hidden)
+
+    def loss(self, logits, labels):
+        """Next-token LM loss; logits [B,S,V], labels [B,S]."""
+        V = logits.shape[-1]
+        lg = M.reshape(logits[:, :-1, :], [-1, V])
+        lb = M.reshape(labels[:, 1:], [-1])
+        return F.cross_entropy(lg, lb)
+
+    def num_params(self):
+        return sum(p.size for p in self.parameters())
+
+
+def gpt_loss_fn(logits, labels):
+    V = logits.shape[-1]
+    lg = M.reshape(logits[:, :-1, :], [-1, V])
+    lb = M.reshape(labels[:, 1:], [-1])
+    return F.cross_entropy(lg, lb)
+
+
+# ---------------------------------------------------------------------------
+# Pure-pytree block function for the pipeline/scan hybrid path: the same math
+# as GPTBlock.forward over a {name: array} dict with full logical shapes.
+def gpt_block_fn(config: GPTConfig):
+    nh = config.num_heads
+    eps = config.layer_norm_epsilon
+
+    def ln(x, g, b):
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.var(xf, -1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g.astype(
+            x.dtype) + b.astype(x.dtype)
+
+    def block(p, x):
+        B, S, H = x.shape
+        d = H // nh
+        h1 = ln(x, p["ln1_g"], p["ln1_b"])
+        qkv = h1 @ p["qkv_w"].astype(x.dtype) + p["qkv_b"].astype(x.dtype)
+        q, k, v = jnp.split(qkv.reshape(B, S, 3, nh, d), 3, axis=2)
+        ctx = _attention(q[:, :, 0], k[:, :, 0], v[:, :, 0], config.use_flash)
+        attn_out = ctx.reshape(B, S, H) @ p["out_w"].astype(x.dtype) + \
+            p["out_b"].astype(x.dtype)
+        x = x + attn_out
+        h2 = ln(x, p["ln2_g"], p["ln2_b"])
+        up = h2 @ p["up_w"].astype(x.dtype) + p["up_b"].astype(x.dtype)
+        up = jax.nn.gelu(up, approximate=True)
+        down = up @ p["down_w"].astype(x.dtype) + p["down_b"].astype(x.dtype)
+        return x + down
+
+    return block
+
+
+def stack_block_params(model: GPTForCausalLM):
+    """Collect per-block weights from a GPTForCausalLM into stacked arrays
+    [L, ...] for the pipeline path."""
+    blocks = list(model.gpt.h)
+    names = {
+        "ln1_g": lambda b: b.ln_1.weight, "ln1_b": lambda b: b.ln_1.bias,
+        "qkv_w": lambda b: b.attn.qkv_proj.weight,
+        "qkv_b": lambda b: b.attn.qkv_proj.bias,
+        "out_w": lambda b: b.attn.out_proj.weight,
+        "out_b": lambda b: b.attn.out_proj.bias,
+        "ln2_g": lambda b: b.ln_2.weight, "ln2_b": lambda b: b.ln_2.bias,
+        "up_w": lambda b: b.mlp.up_proj.weight, "up_b": lambda b: b.mlp.up_proj.bias,
+        "down_w": lambda b: b.mlp.down_proj.weight,
+        "down_b": lambda b: b.mlp.down_proj.bias,
+    }
+    return {k: jnp.stack([fn(b)._data for b in blocks]) for k, fn in names.items()}

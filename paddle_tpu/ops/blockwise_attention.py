@@ -52,9 +52,11 @@ def blockwise_attention(q, k, v, causal=True, block_k=512):
         acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
         return (m_new, l_new, acc_new), None
 
-    m0 = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, Sq), jnp.float32)
-    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    # derive carries from qf (not fresh constants) so device-varying manual-axis
+    # types propagate when running inside shard_map regions (pipeline/sp)
+    l0 = jnp.zeros_like(qf[..., 0])
+    m0 = l0 + _NEG_INF
+    acc0 = jnp.zeros_like(qf)
     (m, l, acc), _ = jax.lax.scan(
         body, (m0, l0, acc0), (kblocks, vblocks, jnp.arange(nk)))
     out = acc / jnp.maximum(l[..., None], 1e-30)
