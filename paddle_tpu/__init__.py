@@ -54,12 +54,13 @@ from . import vision  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
-# PENDING from . import profiler  # noqa: E402,F401
-# PENDING from . import distribution  # noqa: E402,F401
-# PENDING from . import sparse  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
-# PENDING from .hapi import Model, summary  # noqa: E402,F401
-# PENDING from . import callbacks  # noqa: E402,F401
+from .hapi import Model, summary  # noqa: E402,F401
+from .hapi import callbacks  # noqa: E402,F401
 
 from .framework.device import (  # noqa: E402,F401
     set_device, get_device, is_compiled_with_cuda,

@@ -12,8 +12,6 @@ support, e.g. grad-of-grad).
 """
 from __future__ import annotations
 
-import weakref
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -21,15 +19,17 @@ import numpy as np
 from ..tensor_impl import Tensor
 from ..framework import state as _st
 
-_leaf_hooks = weakref.WeakKeyDictionary()  # Tensor -> [hook]
-
-
 def register_tensor_hook(t: Tensor, hook):
-    """paddle Tensor.register_hook parity. Hook: grad_tensor -> grad_tensor|None."""
+    """paddle Tensor.register_hook parity. Hook: grad_tensor -> grad_tensor|None.
+
+    Leaf hooks live on the tensor object itself (Tensor.__eq__ is elementwise,
+    so Tensors cannot key a dict)."""
     if t._node is not None:
         t._node.add_hook(t._out_idx, hook)
     else:
-        _leaf_hooks.setdefault(t, []).append(hook)
+        if not hasattr(t, "_leaf_hooks"):
+            t._leaf_hooks = []
+        t._leaf_hooks.append(hook)
 
     class _Handle:
         def remove(self_inner):
@@ -37,8 +37,8 @@ def register_tensor_hook(t: Tensor, hook):
                 hooks = t._node.hooks.get(t._out_idx, [])
                 if hook in hooks:
                     hooks.remove(hook)
-            elif t in _leaf_hooks and hook in _leaf_hooks[t]:
-                _leaf_hooks[t].remove(hook)
+            elif hook in getattr(t, "_leaf_hooks", []):
+                t._leaf_hooks.remove(hook)
 
     return _Handle()
 
@@ -236,7 +236,7 @@ def _walk(roots, seeds, retain_graph, create_graph, inputs, accumulate):
                 slot[parent._out_idx] = _acc(slot.get(parent._out_idx), g)
 
     for t, g in leaf_grads.values():
-        for h in _leaf_hooks.get(t, []):
+        for h in getattr(t, "_leaf_hooks", []):
             out = h(g)
             if out is not None:
                 g = out if isinstance(out, Tensor) else Tensor(out)

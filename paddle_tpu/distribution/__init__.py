@@ -1,0 +1,203 @@
+"""Probability distributions (ref: python/paddle/distribution/*).
+
+Distribution/Normal/Uniform/Categorical/Bernoulli + kl_divergence, built on
+jax.random with the framework's global seeded key stream (framework.random),
+so `paddle.seed` controls sampling determinism.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_impl import Tensor, as_tensor_data, wrap
+from ..framework.random import next_key
+
+
+def _arr(x):
+    if isinstance(x, (int, float)):
+        return jnp.asarray(x, jnp.float32)
+    return jnp.asarray(as_tensor_data(x))
+
+
+class Distribution:
+    """Base class (ref distribution/distribution.py)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return wrap(jnp.exp(as_tensor_data(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    """Gaussian (ref distribution/normal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        shape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return wrap(jnp.broadcast_to(self.scale**2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(next_key(), shape, jnp.float32)
+        return wrap(self.loc + eps * self.scale)
+
+    def log_prob(self, value):
+        v = as_tensor_data(value)
+        var = self.scale**2
+        return wrap(-((v - self.loc) ** 2) / (2 * var)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return wrap(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Normal)
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Uniform(Distribution):
+    """U[low, high) (ref distribution/uniform.py)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape, jnp.float32)
+        return wrap(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = as_tensor_data(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return wrap(jnp.broadcast_to(jnp.log(self.high - self.low), self.batch_shape))
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Uniform)
+        return wrap(jnp.log((other.high - other.low) / (self.high - self.low)))
+
+
+class Categorical(Distribution):
+    """Categorical over last axis of logits (ref distribution/categorical.py)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = as_tensor_data(logits).astype(jnp.float32)
+        else:
+            self.logits = jnp.log(as_tensor_data(probs).astype(jnp.float32) + 1e-30)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return wrap(jax.nn.softmax(self.logits, axis=-1))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return wrap(jax.random.categorical(next_key(), self.logits, shape=shape))
+
+    def log_prob(self, value):
+        v = as_tensor_data(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return wrap(jnp.take_along_axis(logp, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(logp)
+        return wrap(-(p * logp).sum(-1))
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Categorical)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        logq = jax.nn.log_softmax(other.logits, axis=-1)
+        return wrap((jnp.exp(logp) * (logp - logq)).sum(-1))
+
+
+class Bernoulli(Distribution):
+    """Bernoulli(probs) (ref distribution/bernoulli.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = _arr(probs)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return wrap(self.probs_)
+
+    @property
+    def variance(self):
+        return wrap(self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape, jnp.float32)
+        return wrap((u < self.probs_).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = as_tensor_data(value).astype(jnp.float32)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Bernoulli)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        q = jnp.clip(other.probs_, 1e-7, 1 - 1e-7)
+        return wrap(p * (jnp.log(p) - jnp.log(q))
+                    + (1 - p) * (jnp.log1p(-p) - jnp.log1p(-q)))
+
+
+def kl_divergence(p, q):
+    """Dispatch KL(p||q) (ref distribution/kl.py)."""
+    return p.kl_divergence(q)

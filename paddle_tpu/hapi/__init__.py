@@ -1,0 +1,12 @@
+"""High-level API: paddle.Model with fit/evaluate/predict + callbacks.
+
+Re-design of the reference hapi (ref: python/paddle/hapi/model.py,
+python/paddle/hapi/callbacks.py). The reference routes through dygraph or a
+static-graph Executor; here the train step is the eager tape path (simple,
+debuggable) with an optional jit'd fused step for throughput.
+"""
+from .model import Model, summary  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping,
+)

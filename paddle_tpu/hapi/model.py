@@ -1,0 +1,234 @@
+"""paddle.Model high-level training loop (ref: python/paddle/hapi/model.py).
+
+The reference dispatches to DynamicGraphAdapter/StaticGraphAdapter; TPU-native
+there is one path: eager tape training (XLA-compiled per-op), with
+`Model.prepare(..., jit=True)` switching to a fused jit'd train step
+(jax.value_and_grad + optimizer update in one XLA program).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .. import optimizer as opt_mod
+from ..tensor_impl import Tensor
+from ..io import DataLoader, Dataset
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+class Model:
+    """Train/eval/predict harness around an nn.Layer."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # -- configuration ----------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, jit=False):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        self._jit = jit
+        self._train_step = None
+        if optimizer is not None and getattr(optimizer, "_parameter_list", None) is None:
+            optimizer._parameter_list = list(self.network.parameters())
+        if jit and optimizer is not None and loss is not None:
+            from ..jit.train_step import TrainStep
+            self._train_step = TrainStep(self.network, loss, optimizer)
+        return self
+
+    def parameters(self):
+        return list(self.network.parameters())
+
+    # -- single-batch ops (public parity: train_batch/eval_batch/predict_batch)
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = [_as_tensor(x) for x in _to_list(inputs)]
+        labels = [_as_tensor(x) for x in _to_list(labels)]
+        if self._train_step is not None:
+            loss = self._train_step(inputs[0] if len(inputs) == 1 else inputs,
+                                    labels[0] if len(labels) == 1 else labels)
+            self._train_step.sync_to_model()
+            return [float(loss)], self._metric_logs()
+        self._optimizer.clear_grad()
+        outputs = self.network(*inputs)
+        loss = self._loss(outputs, *labels) if labels else self._loss(outputs)
+        loss.backward()
+        self._optimizer.step()
+        self._update_metrics(outputs, labels)
+        return [float(loss)], self._metric_logs()
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = [_as_tensor(x) for x in _to_list(inputs)]
+        labels = [_as_tensor(x) for x in _to_list(labels)]
+        outputs = self.network(*inputs)
+        loss = self._loss(outputs, *labels) if self._loss and labels else None
+        self._update_metrics(outputs, labels)
+        return ([float(loss)] if loss is not None else []), self._metric_logs()
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [_as_tensor(x) for x in _to_list(inputs)]
+        out = self.network(*inputs)
+        return [o.numpy() for o in _to_list(out)]
+
+    def _update_metrics(self, outputs, labels):
+        for m in self._metrics:
+            try:
+                res = m.compute(outputs, *labels) if labels else m.compute(outputs)
+                m.update(res)
+            except Exception:
+                pass
+
+    def _metric_logs(self):
+        logs = {}
+        for m in self._metrics:
+            try:
+                name = m.name() if callable(getattr(m, "name", None)) else type(m).__name__
+                acc = m.accumulate()
+                if isinstance(name, (list, tuple)):
+                    logs.update(dict(zip(name, _to_list(acc))))
+                else:
+                    logs[name] = acc
+            except Exception:
+                pass
+        return logs
+
+    # -- loops -------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset) or hasattr(data, "__getitem__"):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return data  # generator of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = self._loader(train_data, batch_size, shuffle)
+        cbks = config_callbacks(callbacks, self, epochs=epochs, verbose=verbose,
+                                log_freq=log_freq, save_freq=save_freq,
+                                save_dir=save_dir, metrics=self._metrics)
+        self.stop_training = False
+        cbks.call("on_train_begin")
+        logs = {}
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for m in self._metrics:
+                m.reset()
+            cbks.call("on_epoch_begin", epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                batch = _to_list(batch)
+                ins, labs = batch[:-1] or batch, batch[-1:]
+                cbks.call("on_train_batch_begin", step)
+                losses, metrics = self.train_batch(ins, labs)
+                logs = {"loss": losses[0] if losses else None, **metrics}
+                cbks.call("on_train_batch_end", step, logs)
+            cbks.call("on_epoch_end", epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=0,
+                              callbacks=cbks.callbacks, _nested=True)
+        cbks.call("on_train_end", logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _nested=False):
+        loader = self._loader(eval_data, batch_size, False)
+        cbks = config_callbacks(callbacks if not _nested else None, self,
+                                verbose=verbose, metrics=self._metrics) \
+            if not _nested else None
+        for m in self._metrics:
+            m.reset()
+        if cbks:
+            cbks.call("on_eval_begin")
+        logs = {}
+        total_loss, n = 0.0, 0
+        for step, batch in enumerate(loader):
+            batch = _to_list(batch)
+            ins, labs = batch[:-1] or batch, batch[-1:]
+            losses, metrics = self.eval_batch(ins, labs)
+            if losses:
+                total_loss += losses[0]
+                n += 1
+            logs = {**({"loss": total_loss / max(n, 1)} if n else {}), **metrics}
+        if cbks:
+            cbks.call("on_eval_end", logs)
+        elif _nested:
+            for c in (callbacks or []):
+                c.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        loader = self._loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            batch = _to_list(batch)
+            outs.append(self.predict_batch(batch[:1]))
+        if stack_outputs and outs:
+            k = len(outs[0])
+            return [np.concatenate([o[i] for o in outs], axis=0) for i in range(k)]
+        return outs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        from ..framework.io import save as psave
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            state = getattr(self._optimizer, "state_dict", lambda: {})()
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump(_host_tree(state), f)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as pload
+        self.network.set_state_dict(pload(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            with open(opt_path, "rb") as f:
+                state = pickle.load(f)
+            if hasattr(self._optimizer, "set_state_dict"):
+                self._optimizer.set_state_dict(state)
+
+
+def _host_tree(tree):
+    import jax
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(a) if hasattr(a, "shape") else a, tree)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print + return layer/param summary (ref hapi.summary)."""
+    rows = []
+    total = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        rows.append((name, tuple(p.shape), n))
+    width = max([len(r[0]) for r in rows], default=10) + 2
+    print(f"{'Param':<{width}}{'Shape':<20}{'#':>12}")
+    for name, shape, n in rows:
+        print(f"{name:<{width}}{str(shape):<20}{n:>12,}")
+    print(f"Total params: {total:,}")
+    return {"total_params": total, "trainable_params": total}

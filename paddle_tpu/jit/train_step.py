@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..tensor_impl import Tensor
@@ -186,12 +187,19 @@ class TrainStep:
         return self._opt_state
 
     def state_for_checkpoint(self):
-        return {"params": self._params, "opt_state": self._opt_state,
-                "buffers": self._buffers, "step": self._step}
+        # Host copies: live device buffers would be donated (deleted) by the
+        # next step, leaving the checkpoint pointing at freed memory.
+        snap = jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a)),
+                                      (self._params, self._opt_state, self._buffers))
+        return {"params": snap[0], "opt_state": snap[1], "buffers": snap[2],
+                "step": self._step}
 
     def restore_from_checkpoint(self, state):
-        self._params = state["params"]
-        self._opt_state = state["opt_state"]
-        self._buffers = state["buffers"]
+        put = lambda tree: jax.tree_util.tree_map(jnp.asarray, tree)
+        self._params = put(state["params"])
+        self._opt_state = put(state["opt_state"])
+        self._buffers = put(state["buffers"])
         self._step = int(state["step"])
+        if self.mesh is not None:
+            self.shard_params()
         self.sync_to_model()

@@ -142,10 +142,10 @@ class GPTModel(Layer):
         if cd:
             x = x.astype(cd)
         x = self.drop(x)
-        from ..distributed import recompute as _rc
+        from ..distributed.recompute import recompute as _recompute
         for block in self.h:
             if self.config.remat:
-                x = _rc.recompute(block, x, policy="dots_no_batch")
+                x = _recompute(block, x, policy="dots_no_batch")
             else:
                 x = block(x)
         return self.ln_f(x)

@@ -56,8 +56,10 @@ class Optimizer:
         params = self._parameter_list
         if params is None:
             raise ValueError("optimizer constructed without parameters")
+        # Any trainable tensor may be optimized (paddle allows plain Tensors
+        # with stop_gradient=False in the parameter list, not just Parameter).
         params_grads = [(p, p._grad) for p in params
-                        if isinstance(p, Parameter) and p.trainable]
+                        if getattr(p, "trainable", not p.stop_gradient)]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
@@ -73,7 +75,7 @@ class Optimizer:
                 if self._multi_precision and p._data.dtype in _LOW_PRECISION:
                     slots["master"] = p._data.astype(jnp.float32)
                 self._accumulators[id(p)] = slots
-            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             decay_on = self._decay_for(p)
             if "master" in slots:
                 master = slots.pop("master")

@@ -1,0 +1,215 @@
+"""paddle.profiler parity on top of jax.profiler (ref: python/paddle/profiler).
+
+The reference collects host/device events into its own timeline; on TPU the
+source of truth is XLA's xplane trace. Profiler here drives
+jax.profiler.start_trace/stop_trace (viewable in TensorBoard / Perfetto) and
+keeps a host-side RecordEvent timeline exported as chrome tracing JSON.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """Step-state scheduler (ref profiler/utils.py make_scheduler)."""
+
+    def schedule(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        cycle = closed + ready + record
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+_host_events = []
+_events_lock = threading.Lock()
+
+
+class RecordEvent:
+    """Context/annotation for a named host-side region; also forwards to
+    jax.profiler.TraceAnnotation so it appears in the xplane trace."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._jax_ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        self._jax_ann.__enter__()
+
+    def end(self):
+        self._jax_ann.__exit__(None, None, None)
+        with _events_lock:
+            _host_events.append(
+                {"name": self.name, "ph": "X", "pid": os.getpid(),
+                 "tid": threading.get_ident(),
+                 "ts": self._t0 / 1000.0,
+                 "dur": (time.perf_counter_ns() - self._t0) / 1000.0})
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """Returns an on_trace_ready callback writing chrome tracing JSON."""
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        path = os.path.join(dir_name, f"{worker_name or 'worker'}.json")
+        with _events_lock:
+            events = list(_host_events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        prof._chrome_trace_path = path
+
+    return handler
+
+
+class Profiler:
+    """paddle.profiler.Profiler parity: scheduler-driven trace capture."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 trace_dir=None):
+        self.scheduler = (make_scheduler(closed=0, ready=0, record=scheduler[1] - scheduler[0],
+                                         skip_first=scheduler[0])
+                          if isinstance(scheduler, (tuple, list)) else scheduler)
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.trace_dir = trace_dir or "/tmp/paddle_tpu_profile"
+        self._step = 0
+        self._tracing = False
+        self._step_times = []
+        self._t_last = None
+
+    def start(self):
+        self._t_last = time.perf_counter()
+        if not self.timer_only:
+            state = self.scheduler(self._step) if self.scheduler else ProfilerState.RECORD
+            if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                self._start_trace()
+
+    def _start_trace(self):
+        if not self._tracing:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self.trace_dir)
+                self._tracing = True
+            except Exception:
+                self._tracing = False
+
+    def _stop_trace(self):
+        if self._tracing:
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._tracing = False
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self._step_times.append(now - self._t_last)
+        self._t_last = now
+        self._step += 1
+        if self.timer_only or self.scheduler is None:
+            return
+        state = self.scheduler(self._step)
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._start_trace()
+        else:
+            if self._tracing:
+                self._stop_trace()
+                if state == ProfilerState.CLOSED and self.on_trace_ready:
+                    self.on_trace_ready(self)
+        if state == ProfilerState.RECORD_AND_RETURN and self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def stop(self):
+        self._stop_trace()
+        if self.on_trace_ready and not self.timer_only:
+            self.on_trace_ready(self)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        ts = np.asarray(self._step_times) * 1e3
+        return (f"steps: {len(ts)}  avg: {ts.mean():.2f}ms  p50: "
+                f"{np.percentile(ts, 50):.2f}ms  p99: {np.percentile(ts, 99):.2f}ms")
+
+
+def benchmark():
+    """Step-timer handle (ref profiler.utils.benchmark)."""
+    return _Benchmark()
+
+
+class _Benchmark:
+    def __init__(self):
+        self._times = []
+        self._t = None
+
+    def begin(self):
+        self._t = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t is not None:
+            self._times.append(now - self._t)
+        self._t = now
+
+    def end(self):
+        pass
+
+    def step_info(self, unit="ms"):
+        import numpy as np
+        if not self._times:
+            return "n/a"
+        return f"avg {np.mean(self._times) * 1e3:.3f} ms/step"
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)

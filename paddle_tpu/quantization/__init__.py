@@ -1,0 +1,109 @@
+"""Quantization subset (ref: python/paddle/quantization/*).
+
+Weight-only int8 PTQ for TPU serving: per-channel symmetric int8 weights with
+fp dequant-scale fused into the matmul epilogue by XLA. Also fake-quant
+QAT modules (quant in forward, straight-through grad).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_impl import Tensor, as_tensor_data, wrap
+from .. import nn
+from ..nn.layer_base import Layer
+
+
+def abs_max_scale(w, axis=None):
+    """Per-tensor or per-channel absmax scale → int8 range."""
+    a = jnp.abs(as_tensor_data(w))
+    amax = a.max() if axis is None else a.max(axis=axis, keepdims=True)
+    return jnp.maximum(amax, 1e-8) / 127.0
+
+
+def quantize_weight(w, axis=0):
+    """Returns (int8 weight, fp32 scale); per-out-channel symmetric."""
+    arr = as_tensor_data(w).astype(jnp.float32)
+    reduce_axis = tuple(i for i in range(arr.ndim) if i != axis)
+    scale = jnp.maximum(jnp.abs(arr).max(axis=reduce_axis, keepdims=True), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(arr / scale), -128, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_weight(q, scale):
+    return q.astype(jnp.float32) * scale
+
+
+def fake_quant(x, bits=8):
+    """Fake-quant with straight-through estimator (QAT forward):
+    forward sees quantized values, gradient passes through unchanged."""
+    import jax
+    arr = as_tensor_data(x)
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.abs(arr).max(), 1e-8) / qmax
+    q = jnp.clip(jnp.round(arr / scale), -qmax - 1, qmax) * scale
+    return wrap(arr + jax.lax.stop_gradient(q - arr))
+
+
+class QuantizedLinear(Layer):
+    """Weight-only int8 linear for inference (ref incubate weight_only_linear).
+
+    Stores int8 weight + per-channel scale; dequantizes in-graph so XLA fuses
+    the scale multiply into the MXU matmul epilogue."""
+
+    def __init__(self, linear_or_in, out_features=None):
+        super().__init__()
+        if isinstance(linear_or_in, Layer):
+            lin = linear_or_in
+            w = lin.weight._data
+            self.bias = lin.bias
+        else:
+            w = jnp.zeros((linear_or_in, out_features), jnp.float32)
+            self.bias = None
+        q, scale = quantize_weight(w, axis=1)  # per-out-channel on [in, out]
+        self.qweight = q
+        self.scale = scale
+
+    def forward(self, x):
+        w = dequantize_weight(self.qweight, self.scale)
+        arr = as_tensor_data(x)
+        out = arr @ w.astype(arr.dtype)
+        if self.bias is not None:
+            out = out + as_tensor_data(self.bias)
+        return wrap(out)
+
+
+class QAT:
+    """Quantization-aware-training wrapper: replaces Linear forwards with
+    fake-quant weights (ref quantization/qat.py capability)."""
+
+    def __init__(self, config=None):
+        self.config = config
+
+    def quantize(self, model):
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, nn.Linear):
+                orig = layer.forward
+
+                def fq_forward(x, _orig=orig, _layer=layer):
+                    w = _layer.weight
+                    _layer.weight = type(w)(as_tensor_data(fake_quant(w)))
+                    try:
+                        return _orig(x)
+                    finally:
+                        _layer.weight = w
+                layer.forward = fq_forward
+        return model
+
+
+def quanted_model_size_bytes(model):
+    """Report quantized parameter footprint."""
+    total = 0
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, QuantizedLinear):
+            total += int(np.prod(layer.qweight.shape))
+            total += int(np.prod(layer.scale.shape)) * 4
+        else:
+            for p in layer.parameters(include_sublayers=False):
+                total += int(np.prod(p.shape)) * 4
+    return total

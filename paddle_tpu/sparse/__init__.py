@@ -1,0 +1,93 @@
+"""paddle.sparse subset: COO tensors (ref: python/paddle/sparse/*).
+
+TPU/XLA has no native sparse kernels; COO ops lower to dense gathers/scatters
+(segment_sum), which XLA tiles well for the moderate-nnz cases the reference's
+sparse API targets. Layout: indices [ndim, nnz] int64 + values [nnz, ...].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_impl import Tensor, as_tensor_data, wrap
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices = jnp.asarray(as_tensor_data(indices)).astype(jnp.int64)
+        self.values = jnp.asarray(as_tensor_data(values))
+        self.shape = list(shape)
+
+    @property
+    def nnz(self):
+        return int(self.indices.shape[1])
+
+    def to_dense(self):
+        dense = jnp.zeros(tuple(self.shape), self.values.dtype)
+        idx = tuple(self.indices[i] for i in range(self.indices.shape[0]))
+        return wrap(dense.at[idx].add(self.values))
+
+    def numpy(self):
+        return np.asarray(as_tensor_data(self.to_dense()))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.values.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, stop_gradient=True):
+    ind = jnp.asarray(as_tensor_data(indices))
+    val = jnp.asarray(as_tensor_data(values))
+    if dtype is not None:
+        val = val.astype(dtype)
+    if shape is None:
+        shape = [int(x) + 1 for x in np.asarray(ind.max(axis=1))]
+    return SparseCooTensor(ind, val, shape)
+
+
+def to_dense(sp):
+    return sp.to_dense() if isinstance(sp, SparseCooTensor) else sp
+
+
+def from_dense(x, name=None):
+    arr = as_tensor_data(x)
+    nz = jnp.nonzero(arr)  # host-side (eager only), like reference to_sparse_coo
+    indices = jnp.stack(nz, axis=0)
+    values = arr[nz]
+    return SparseCooTensor(indices, values, arr.shape)
+
+
+to_sparse_coo = from_dense
+
+
+def matmul(a, b):
+    """sparse @ dense → dense (ref sparse/binary.py matmul)."""
+    bd = as_tensor_data(b) if not isinstance(b, SparseCooTensor) else as_tensor_data(b.to_dense())
+    if isinstance(a, SparseCooTensor):
+        assert a.indices.shape[0] == 2, "sparse matmul supports 2-D lhs"
+        rows, cols = a.indices[0], a.indices[1]
+        # gather rhs rows at col indices, scale, segment-sum into output rows
+        contrib = a.values[:, None] * bd[cols]  # [nnz, n]
+        out = jax.ops.segment_sum(contrib, rows, num_segments=a.shape[0])
+        return wrap(out.astype(bd.dtype))
+    return wrap(as_tensor_data(a) @ bd)
+
+
+def add(a, b):
+    if isinstance(a, SparseCooTensor) and isinstance(b, SparseCooTensor):
+        assert a.shape == b.shape
+        indices = jnp.concatenate([a.indices, b.indices], axis=1)
+        values = jnp.concatenate([a.values, b.values], axis=0)
+        return SparseCooTensor(indices, values, a.shape)
+    return wrap(as_tensor_data(to_dense(a)) + as_tensor_data(to_dense(b)))
+
+
+def multiply(a, b):
+    return wrap(as_tensor_data(to_dense(a)) * as_tensor_data(to_dense(b)))
+
+
+def relu(a):
+    if isinstance(a, SparseCooTensor):
+        return SparseCooTensor(a.indices, jnp.maximum(a.values, 0), a.shape)
+    return wrap(jnp.maximum(as_tensor_data(a), 0))

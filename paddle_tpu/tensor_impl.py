@@ -22,8 +22,10 @@ from .framework import state as _st
 class Tensor:
     __slots__ = (
         "_data", "stop_gradient", "_grad", "_node", "_out_idx", "name",
-        "persistable", "_placeholder", "__weakref__",
+        "persistable", "_placeholder", "_leaf_hooks", "__weakref__",
     )
+
+    _name_counter = 0
 
     def __init__(self, data, stop_gradient=True, name=None):
         if isinstance(data, Tensor):
@@ -35,6 +37,11 @@ class Tensor:
         self._grad = None
         self._node = None
         self._out_idx = 0
+        # Auto-generated unique names match paddle's generated_tensor_N
+        # convention and keep optimizer state_dict keys collision-free.
+        if name is None:
+            name = f"generated_tensor_{Tensor._name_counter}"
+            Tensor._name_counter += 1
         self.name = name
         self.persistable = False
         self._placeholder = None

@@ -1,0 +1,27 @@
+"""Test harness config: force an 8-virtual-device CPU mesh.
+
+NOTE: jax may already be imported at interpreter startup (platform plugin
+.pth hook), so setting JAX_PLATFORMS via os.environ is too late — we use
+jax.config.update before the first backend initialization instead.
+"""
+import os
+
+# XLA_FLAGS is read at first backend init, which has not happened yet.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual cpu devices, got {len(devs)}"
+    return devs

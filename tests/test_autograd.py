@@ -1,0 +1,121 @@
+"""Tape autograd: backward, gradcheck, PyLayer, higher-order (ref paddle/autograd)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def finite_diff(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-6)
+
+    def test_matmul_grad_fd(self):
+        a = np.random.RandomState(0).randn(3, 3).astype(np.float64)
+
+        def f(v):
+            return float((v @ v).sum())
+
+        x = paddle.to_tensor(a, stop_gradient=False)
+        ((x @ x).sum()).backward()
+        np.testing.assert_allclose(x.grad.numpy(), finite_diff(f, a), rtol=1e-3, atol=1e-4)
+
+    def test_broadcast_grad(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+        b = paddle.to_tensor([10.0, 20.0], stop_gradient=False)
+        ((x + b) * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 2.0))
+        np.testing.assert_allclose(b.grad.numpy(), [4.0, 4.0])
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=True)
+        y = paddle.to_tensor([2.0], stop_gradient=False)
+        (x * y).sum().backward()
+        assert x.grad is None
+        np.testing.assert_allclose(y.grad.numpy(), [1.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_detach(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        d = x.detach()
+        assert d.stop_gradient
+        np.testing.assert_allclose(d.numpy(), x.numpy())
+
+    def test_nonlinear_fd(self):
+        a = np.random.RandomState(1).rand(4).astype(np.float64) + 0.5
+
+        def f(v):
+            return float(np.sum(np.log(v) * np.tanh(v) + np.exp(-v)))
+
+        x = paddle.to_tensor(a, stop_gradient=False)
+        (paddle.log(x) * paddle.tanh(x) + paddle.exp(-x)).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), finite_diff(f, a), rtol=1e-3, atol=1e-5)
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-6)
+
+    def test_higher_order(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x * x
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        (g2,) = paddle.grad(g1, x)
+        np.testing.assert_allclose(g2.numpy(), [12.0], rtol=1e-6)  # d2/dx2 x^3 = 6x
+
+
+class TestPyLayer:
+    def test_custom_vjp(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 2
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+class TestHooks:
+    def test_register_hook(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        x.register_hook(lambda g: g * 10)
+        (x * 1).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [10.0, 10.0])

@@ -1,0 +1,138 @@
+"""to_static/jit, TrainStep, amp auto_cast + GradScaler (ref test/dygraph_to_static, test/amp)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestToStatic:
+    def test_fn_matches_eager(self):
+        def f(x):
+            return paddle.tanh(x) * 2 + 1
+
+        sf = paddle.jit.to_static(f)
+        x = paddle.randn([4, 4])
+        np.testing.assert_allclose(sf(x).numpy(), f(x).numpy(), rtol=1e-6)
+
+    def test_layer_matches_eager(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.randn([3, 4])
+        eager = m(x).numpy()
+        sm = paddle.jit.to_static(m)
+        np.testing.assert_allclose(sm(x).numpy(), eager, rtol=1e-5)
+
+    def test_input_spec(self):
+        from paddle_tpu.static import InputSpec
+        def f(x):
+            return x * 2
+        sf = paddle.jit.to_static(f, input_spec=[InputSpec([None, 4], "float32")])
+        out = sf(paddle.ones([2, 4]))
+        np.testing.assert_allclose(out.numpy(), np.full((2, 4), 2.0))
+
+    def test_hlo_introspection(self):
+        def f(x):
+            return x + 1
+        sf = paddle.jit.to_static(f)
+        txt = sf.hlo(paddle.ones([2]))
+        assert isinstance(txt, str) and len(txt) > 0
+
+    def test_save_load_roundtrip(self):
+        m = nn.Linear(4, 2)
+        x = paddle.randn([3, 4])
+        ref = m(x).numpy()
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "model")
+            paddle.jit.save(m, path, input_spec=[paddle.static.InputSpec([None, 4], "float32")])
+            loaded = paddle.jit.load(path)
+            np.testing.assert_allclose(loaded(x).numpy(), ref, rtol=1e-5)
+
+
+class TestTrainStep:
+    def test_fused_train_step(self):
+        m = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+        opt = paddle.optimizer.AdamW(learning_rate=0.05)
+        loss_fn = nn.MSELoss()
+        step = paddle.jit.TrainStep(m, loss_fn, opt)
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 4).astype(np.float32)
+        Y = (X @ rng.randn(4, 1)).astype(np.float32)
+        losses = [float(step(paddle.to_tensor(X), paddle.to_tensor(Y))) for _ in range(60)]
+        assert losses[-1] < 0.2 * losses[0], f"no convergence: {losses[0]} -> {losses[-1]}"
+
+    def test_sync_to_model(self):
+        m = nn.Linear(4, 1)
+        step = paddle.jit.TrainStep(m, nn.MSELoss(), paddle.optimizer.SGD(learning_rate=0.1))
+        w_before = m.weight.numpy().copy()
+        step(paddle.randn([8, 4]), paddle.randn([8, 1]))
+        step.sync_to_model()
+        assert not np.allclose(m.weight.numpy(), w_before)
+
+    def test_checkpoint_roundtrip(self):
+        m = nn.Linear(4, 1)
+        step = paddle.jit.TrainStep(m, nn.MSELoss(), paddle.optimizer.Adam(learning_rate=0.01))
+        x, y = paddle.randn([8, 4]), paddle.randn([8, 1])
+        step(x, y)
+        state = step.state_for_checkpoint()
+        l1 = float(step(x, y))
+        step.restore_from_checkpoint(state)
+        l2 = float(step(x, y))
+        np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+
+class TestAmp:
+    def test_auto_cast_dtype(self):
+        m = nn.Linear(8, 8)
+        x = paddle.randn([2, 8])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = m(x)
+        assert "bfloat16" in str(out.dtype)
+        out2 = m(x)
+        assert "float32" in str(out2.dtype)
+
+    def test_black_list_stays_fp32(self):
+        x = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            s = paddle.nn.functional.softmax(x)
+        # softmax is in the black list → fp32 accumulation path
+        assert np.isfinite(s.numpy()).all()
+
+    def test_grad_scaler_scale_unscale(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0**10)
+        w = paddle.to_tensor(np.array([1.0], dtype=np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        loss = (w * w).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-5)  # unscaled grad = 2
+
+    def test_grad_scaler_skips_on_inf(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0**10)
+        w = paddle.to_tensor(np.array([1.0], dtype=np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        loss = (w * float("inf")).sum()
+        scaler.scale(loss).backward()
+        scale_before = float(scaler._scale if hasattr(scaler, "_scale") else scaler.state_dict()["scale"])
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
+        scale_after = float(scaler._scale if hasattr(scaler, "_scale") else scaler.state_dict()["scale"])
+        assert scale_after < scale_before
+
+
+class TestSaveLoad:
+    def test_paddle_save_load_state_dict(self):
+        m = nn.Linear(4, 2)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "lin.pdparams")
+            paddle.save(m.state_dict(), p)
+            sd = paddle.load(p)
+        m2 = nn.Linear(4, 2)
+        m2.set_state_dict(sd)
+        x = paddle.randn([2, 4])
+        np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)

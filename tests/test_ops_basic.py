@@ -1,0 +1,207 @@
+"""Numeric parity of tensor ops vs numpy (ref test/legacy_test per-op tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.shape == [2, 2]
+        np.testing.assert_allclose(_np(x), [[1, 2], [3, 4]])
+
+    def test_zeros_ones_full(self):
+        assert _np(paddle.zeros([2, 3])).sum() == 0
+        assert _np(paddle.ones([2, 3])).sum() == 6
+        np.testing.assert_allclose(_np(paddle.full([2, 2], 7.0)), np.full((2, 2), 7.0))
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_allclose(_np(paddle.arange(5)), np.arange(5))
+        np.testing.assert_allclose(_np(paddle.linspace(0, 1, 5)), np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.eye(3)), np.eye(3))
+
+    def test_like_variants(self):
+        x = paddle.ones([2, 3])
+        assert _np(paddle.zeros_like(x)).sum() == 0
+        assert _np(paddle.ones_like(x)).sum() == 6
+        assert _np(paddle.full_like(x, 2.0)).sum() == 12
+
+    def test_tril_triu_diag(self):
+        a = np.arange(9, dtype=np.float32).reshape(3, 3)
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.tril(x)), np.tril(a))
+        np.testing.assert_allclose(_np(paddle.triu(x)), np.triu(a))
+
+    def test_random_shapes_and_seed(self):
+        paddle.seed(42)
+        a = _np(paddle.randn([4, 4]))
+        paddle.seed(42)
+        b = _np(paddle.randn([4, 4]))
+        np.testing.assert_array_equal(a, b)
+        assert _np(paddle.rand([3])).shape == (3,)
+        r = _np(paddle.randint(0, 10, [100]))
+        assert r.min() >= 0 and r.max() < 10
+
+
+class TestMath:
+    def setup_method(self):
+        self.a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        self.b = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        self.x = paddle.to_tensor(self.a)
+        self.y = paddle.to_tensor(self.b)
+
+    def test_arith(self):
+        np.testing.assert_allclose(_np(self.x + self.y), self.a + self.b, rtol=1e-6)
+        np.testing.assert_allclose(_np(self.x - self.y), self.a - self.b, rtol=1e-6)
+        np.testing.assert_allclose(_np(self.x * self.y), self.a * self.b, rtol=1e-6)
+        np.testing.assert_allclose(_np(self.x / self.y), self.a / self.b, rtol=1e-5)
+        np.testing.assert_allclose(_np(self.x**2), self.a**2, rtol=1e-6)
+
+    def test_unary(self):
+        np.testing.assert_allclose(_np(paddle.exp(self.x)), np.exp(self.a), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.abs(self.x)), np.abs(self.a), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.sqrt(paddle.abs(self.x))), np.sqrt(np.abs(self.a)), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.tanh(self.x)), np.tanh(self.a), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.sigmoid(self.x)), 1 / (1 + np.exp(-self.a)), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.floor(self.x)), np.floor(self.a))
+        np.testing.assert_allclose(_np(paddle.sign(self.x)), np.sign(self.a))
+
+    def test_reductions(self):
+        np.testing.assert_allclose(_np(paddle.sum(self.x)), self.a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.mean(self.x, axis=1)), self.a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.max(self.x, axis=0)), self.a.max(0), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.cumsum(self.x, axis=1)), self.a.cumsum(1), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.prod(self.x, axis=1)), self.a.prod(1), rtol=1e-5)
+
+    def test_argops_sort_topk(self):
+        np.testing.assert_array_equal(_np(paddle.argmax(self.x, axis=1)), self.a.argmax(1))
+        np.testing.assert_array_equal(_np(paddle.argmin(self.x, axis=0)), self.a.argmin(0))
+        vals, idx = paddle.topk(self.x, k=2, axis=1)
+        ref = np.sort(self.a, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(_np(vals), ref, rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.sort(self.x, axis=1)), np.sort(self.a, 1), rtol=1e-6)
+
+    def test_clip_minmax(self):
+        np.testing.assert_allclose(_np(paddle.clip(self.x, -0.5, 0.5)), np.clip(self.a, -0.5, 0.5))
+        np.testing.assert_allclose(_np(paddle.maximum(self.x, self.y)), np.maximum(self.a, self.b))
+        np.testing.assert_allclose(_np(paddle.minimum(self.x, self.y)), np.minimum(self.a, self.b))
+
+    def test_isnan_isinf(self):
+        z = paddle.to_tensor([1.0, float("nan"), float("inf")])
+        np.testing.assert_array_equal(_np(paddle.isnan(z)), [False, True, False])
+        np.testing.assert_array_equal(_np(paddle.isinf(z)), [False, False, True])
+        np.testing.assert_array_equal(_np(paddle.isfinite(z)), [True, False, False])
+
+
+class TestManipulation:
+    def setup_method(self):
+        self.a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        self.x = paddle.to_tensor(self.a)
+
+    def test_reshape_transpose(self):
+        np.testing.assert_allclose(_np(paddle.reshape(self.x, [6, 4])), self.a.reshape(6, 4))
+        np.testing.assert_allclose(_np(paddle.transpose(self.x, [2, 0, 1])), self.a.transpose(2, 0, 1))
+
+    def test_concat_stack_split(self):
+        y = paddle.concat([self.x, self.x], axis=0)
+        assert y.shape == [4, 3, 4]
+        s = paddle.stack([self.x, self.x], axis=0)
+        assert s.shape == [2, 2, 3, 4]
+        parts = paddle.split(self.x, 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        y = paddle.unsqueeze(self.x, axis=0)
+        assert y.shape == [1, 2, 3, 4]
+        assert paddle.squeeze(y, axis=0).shape == [2, 3, 4]
+        assert paddle.flatten(self.x, start_axis=1).shape == [2, 12]
+
+    def test_tile_expand(self):
+        assert paddle.tile(paddle.ones([2, 2]), [2, 3]).shape == [4, 6]
+        assert paddle.expand(paddle.ones([1, 3]), [4, 3]).shape == [4, 3]
+
+    def test_gather_scatter_where(self):
+        idx = paddle.to_tensor(np.array([0, 1], dtype=np.int64))
+        g = paddle.gather(self.x, idx, axis=1)
+        np.testing.assert_allclose(_np(g), self.a[:, [0, 1], :])
+        cond = paddle.to_tensor(self.a > 10)
+        np.testing.assert_allclose(_np(paddle.where(cond, self.x, -self.x)), np.where(self.a > 10, self.a, -self.a))
+
+    def test_roll_flip_pad(self):
+        np.testing.assert_allclose(_np(paddle.roll(self.x, 1, axis=1)), np.roll(self.a, 1, 1))
+        np.testing.assert_allclose(_np(paddle.flip(self.x, axis=[2])), self.a[:, :, ::-1])
+
+    def test_indexing_slicing(self):
+        np.testing.assert_allclose(_np(self.x[0]), self.a[0])
+        np.testing.assert_allclose(_np(self.x[:, 1:3]), self.a[:, 1:3])
+        np.testing.assert_allclose(_np(self.x[..., -1]), self.a[..., -1])
+
+    def test_cast(self):
+        y = paddle.cast(self.x, "int32")
+        assert "int32" in str(y.dtype)
+
+    def test_masked_select_unbind(self):
+        m = paddle.masked_select(self.x, paddle.to_tensor(self.a > 20))
+        np.testing.assert_allclose(_np(m), self.a[self.a > 20])
+        u = paddle.unbind(self.x, axis=0)
+        assert len(u) == 2
+
+
+class TestLinalg:
+    def test_matmul_bmm_dot(self):
+        rng = np.random.RandomState(0)
+        a, b = rng.randn(3, 4).astype(np.float32), rng.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(_np(paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))), a @ b, rtol=1e-5)
+        ba, bb = rng.randn(2, 3, 4).astype(np.float32), rng.randn(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(_np(paddle.bmm(paddle.to_tensor(ba), paddle.to_tensor(bb))), ba @ bb, rtol=1e-5)
+        v = rng.randn(4).astype(np.float32)
+        np.testing.assert_allclose(_np(paddle.dot(paddle.to_tensor(v), paddle.to_tensor(v))), v @ v, rtol=1e-5)
+
+    def test_norm_einsum(self):
+        a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(_np(paddle.linalg.norm(paddle.to_tensor(a))), np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.einsum("ij,kj->ik", paddle.to_tensor(a), paddle.to_tensor(a))), a @ a.T, rtol=1e-5)
+
+    def test_decompositions(self):
+        a = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        L = _np(paddle.linalg.cholesky(paddle.to_tensor(spd)))
+        np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+        inv = _np(paddle.linalg.inv(paddle.to_tensor(spd)))
+        np.testing.assert_allclose(inv @ spd, np.eye(4), atol=1e-4)
+
+
+class TestLogic:
+    def test_compare_and_reduce(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        y = paddle.to_tensor([1.0, 0.0, 3.0])
+        np.testing.assert_array_equal(_np(paddle.equal(x, y)), [True, False, True])
+        np.testing.assert_array_equal(_np(paddle.greater_than(x, y)), [False, True, False])
+        assert bool(paddle.any(paddle.equal(x, y)))
+        assert not bool(paddle.all(paddle.equal(x, y)))
+        assert bool(paddle.allclose(x, x))
+
+
+class TestStat:
+    def test_stats(self):
+        a = np.random.RandomState(0).randn(100).astype(np.float32)
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.std(x)), a.std(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.var(x)), a.var(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.median(x)), np.median(a), rtol=1e-5)
+
+    def test_unique_bincount(self):
+        x = paddle.to_tensor(np.array([3, 1, 2, 1, 3], dtype=np.int64))
+        np.testing.assert_array_equal(_np(paddle.unique(x)), [1, 2, 3])
+        np.testing.assert_array_equal(_np(paddle.bincount(x)), np.bincount([3, 1, 2, 1, 3]))
+
+    def test_nonzero(self):
+        x = paddle.to_tensor([0.0, 1.0, 0.0, 2.0])
+        nz = _np(paddle.nonzero(x))
+        np.testing.assert_array_equal(nz.ravel(), [1, 3])

@@ -1,0 +1,182 @@
+"""Optimizer parity vs hand-rolled numpy; LR schedulers; grad clip (ref test/legacy_test/test_*_op)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def quad_setup():
+    w = paddle.to_tensor(np.array([1.0, -2.0, 3.0], dtype=np.float32), stop_gradient=False)
+    w0 = w.numpy().copy()
+    return w, w0
+
+
+class TestSGD:
+    def test_step_parity(self):
+        w, w0 = quad_setup()
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        (w * w).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), w0 - 0.1 * 2 * w0, rtol=1e-5)
+
+    def test_momentum(self):
+        w, w0 = quad_setup()
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=[w])
+        v = np.zeros_like(w0)
+        cur = w0.copy()
+        for _ in range(3):
+            opt.clear_grad()
+            (w * w).sum().backward()
+            opt.step()
+            g = 2 * cur
+            v = 0.9 * v + g
+            cur = cur - 0.1 * v
+        np.testing.assert_allclose(w.numpy(), cur, rtol=1e-4)
+
+
+class TestAdam:
+    def test_adam_parity(self):
+        w, w0 = quad_setup()
+        lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+        opt = paddle.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps, parameters=[w])
+        m = np.zeros_like(w0)
+        v = np.zeros_like(w0)
+        cur = w0.copy()
+        for t in range(1, 4):
+            opt.clear_grad()
+            (w * w).sum().backward()
+            opt.step()
+            g = 2 * cur
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mh, vh = m / (1 - b1**t), v / (1 - b2**t)
+            cur = cur - lr * mh / (np.sqrt(vh) + eps)
+        np.testing.assert_allclose(w.numpy(), cur, rtol=1e-4, atol=1e-6)
+
+    def test_adamw_decoupled_decay(self):
+        w, w0 = quad_setup()
+        opt = paddle.optimizer.AdamW(learning_rate=0.01, weight_decay=0.1, parameters=[w])
+        opt.clear_grad()
+        (w * w).sum().backward()
+        opt.step()
+        # decoupled: w -= lr*wd*w in addition to adam step
+        assert not np.allclose(w.numpy(), w0)
+
+    def test_rmsprop_adagrad_run(self):
+        for cls in [paddle.optimizer.RMSProp, paddle.optimizer.Adagrad,
+                    paddle.optimizer.Adadelta, paddle.optimizer.Adamax,
+                    paddle.optimizer.Lamb]:
+            w, w0 = quad_setup()
+            kw = {}
+            opt = cls(learning_rate=0.01, parameters=[w], **kw)
+            opt.clear_grad()
+            (w * w).sum().backward()
+            opt.step()
+            assert np.isfinite(w.numpy()).all()
+            assert not np.allclose(w.numpy(), w0)
+
+
+class TestTraining:
+    def test_linear_regression_converges(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 3).astype(np.float32)
+        true_w = np.array([[1.0], [-2.0], [0.5]], dtype=np.float32)
+        Y = X @ true_w
+        m = nn.Linear(3, 1)
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=m.parameters())
+        xt, yt = paddle.to_tensor(X), paddle.to_tensor(Y)
+        loss0 = None
+        for i in range(100):
+            opt.clear_grad()
+            loss = ((m(xt) - yt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            if i == 0:
+                loss0 = float(loss)
+        assert float(loss) < 0.05 * loss0
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sch = paddle.optimizer.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(sch.get_lr() if hasattr(sch, "get_lr") else sch())
+            sch.step()
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.5, 0.5, 0.25], rtol=1e-6)
+
+    def test_cosine_warmup_piecewise(self):
+        c = paddle.optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        first = c.get_lr()
+        for _ in range(10):
+            c.step()
+        assert c.get_lr() < first
+        w = paddle.optimizer.lr.LinearWarmup(
+            paddle.optimizer.lr.PiecewiseDecay([5, 10], [1.0, 0.5, 0.1]),
+            warmup_steps=3, start_lr=0.0, end_lr=1.0)
+        assert w.get_lr() == 0.0
+        w.step()
+        assert 0 < w.get_lr() <= 1.0
+
+    def test_noam_onecycle(self):
+        n = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+        v0 = n.get_lr()
+        n.step()
+        assert n.get_lr() != v0 or v0 >= 0
+        for cls, kw in [(paddle.optimizer.lr.ExponentialDecay, dict(learning_rate=1.0, gamma=0.9)),
+                        (paddle.optimizer.lr.PolynomialDecay, dict(learning_rate=1.0, decay_steps=10)),
+                        (paddle.optimizer.lr.MultiStepDecay, dict(learning_rate=1.0, milestones=[2, 4])),
+                        (paddle.optimizer.lr.LambdaDecay, dict(learning_rate=1.0, lr_lambda=lambda e: 0.9**e))]:
+            s = cls(**kw)
+            s.step()
+            assert np.isfinite(s.get_lr())
+
+    def test_reduce_on_plateau(self):
+        s = paddle.optimizer.lr.ReduceOnPlateau(learning_rate=1.0, factor=0.5, patience=1)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            s.step(loss)
+        assert s.get_lr() < 1.0
+
+    def test_scheduler_with_optimizer(self):
+        w, _ = quad_setup()
+        sch = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sch, parameters=[w])
+        (w * w).sum().backward()
+        opt.step()
+        sch.step()
+        opt.clear_grad()
+        (w * w).sum().backward()
+        opt.step()
+        assert np.isfinite(w.numpy()).all()
+
+
+class TestGradClip:
+    def test_global_norm_clip(self):
+        w = paddle.to_tensor(np.array([10.0, 10.0], dtype=np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w],
+                                   grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        (w * w).sum().backward()  # grad = [20, 20], norm ~28.3
+        w_before = w.numpy().copy()
+        opt.step()
+        delta = np.abs(w.numpy() - w_before)
+        np.testing.assert_allclose(np.sqrt((delta**2).sum()), 1.0, rtol=1e-4)
+
+    def test_clip_by_value(self):
+        w = paddle.to_tensor(np.array([5.0], dtype=np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w],
+                                   grad_clip=nn.ClipGradByValue(1.0))
+        (w * w).sum().backward()  # grad = 10 -> clipped to 1
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [4.0], rtol=1e-5)
+
+
+class TestMetric:
+    def test_accuracy(self):
+        m = paddle.metric.Accuracy()
+        pred = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], dtype=np.float32))
+        label = paddle.to_tensor(np.array([[0], [0]], dtype=np.int64))
+        m.update(m.compute(pred, label)) if hasattr(m, "compute") else None
+        correct = m.compute(pred, label)
+        m.update(correct)
+        assert abs(m.accumulate() - 0.5) < 1e-6
