@@ -1,0 +1,301 @@
+// paddle_tpu native data pipeline core.
+//
+// Host-side tokenized-corpus sampler mirroring the reference's C++ DataLoader
+// workers / fleet data_generator (ref: paddle/fluid/operators/reader/*,
+// python/paddle/distributed/fleet/data_generator) — redesigned for the TPU
+// training loop:
+//
+//   * corpus = flat binary file of tokens (u16/u32/i64), mmap'd read-only
+//   * sample order = stateless pseudo-random permutation (Feistel network with
+//     cycle-walking) over non-overlapping seq_len windows -> no O(N) shuffle
+//     buffer, O(1) checkpoint state (a single sample counter), seekable,
+//     infinite multi-epoch stream (epoch e reshuffles by keying on e)
+//   * worker threads claim batch indices and assemble [batch, seq_len+1]
+//     int32 buffers in parallel; consumer emits batches strictly in order so
+//     the stream is deterministic regardless of thread count
+//
+// Exposed as a plain C ABI consumed via ctypes (paddle_tpu/io/native.py).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// splitmix64 — the round-function mixer. Must match the Python fallback in
+// paddle_tpu/io/native.py bit-for-bit.
+// ---------------------------------------------------------------------------
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// 4-round balanced Feistel permutation over [0, n) with cycle-walking.
+// Stateless: perm(i) depends only on (i, n, key).
+static inline uint64_t feistel_permute(uint64_t idx, uint64_t n, uint64_t key) {
+  if (n <= 1) return 0;
+  int bits = 0;
+  while ((1ULL << bits) < n) bits++;
+  int half = (bits + 1) / 2;
+  uint64_t mask = (1ULL << half) - 1;
+  uint64_t domain = 1ULL << (2 * half);
+  uint64_t x = idx;
+  do {
+    uint64_t l = x >> half, r = x & mask;
+    for (int round = 0; round < 4; round++) {
+      uint64_t f = splitmix64(r ^ splitmix64(key + (uint64_t)round)) & mask;
+      uint64_t nl = r, nr = l ^ f;
+      l = nl;
+      r = nr;
+    }
+    x = (l << half) | r;
+    (void)domain;
+  } while (x >= n);
+  return x;
+}
+
+struct Corpus {
+  int fd = -1;
+  const uint8_t* data = nullptr;
+  size_t filesize = 0;
+  int64_t ntokens = 0;
+  int token_bytes = 2;
+};
+
+static inline int32_t read_token(const Corpus* c, int64_t i) {
+  switch (c->token_bytes) {
+    case 2:
+      return (int32_t) * (const uint16_t*)(c->data + 2 * i);
+    case 4:
+      return (int32_t) * (const uint32_t*)(c->data + 4 * i);
+    case 8:
+      return (int32_t) * (const int64_t*)(c->data + 8 * i);
+    default:
+      return 0;
+  }
+}
+
+struct Slot {
+  std::vector<int32_t> buf;
+  int64_t batch_idx = -1;
+  uint64_t gen = 0;
+};
+
+struct Stream {
+  Corpus* corpus = nullptr;
+  int64_t seq_len = 0, batch = 0, nwindows = 0;
+  uint64_t seed = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  int64_t claim = 0;      // next absolute batch index a worker will take
+  int64_t next_emit = 0;  // next absolute batch index the consumer emits
+  uint64_t generation = 0;
+  bool stop = false;
+
+  std::vector<Slot> slots;
+  std::deque<int> free_slots;
+  std::vector<std::pair<int64_t, int>> ready;  // (batch_idx, slot_id)
+  std::vector<std::thread> workers;
+};
+
+// Map absolute sample index -> window index in the corpus.
+static inline int64_t sample_to_window(const Stream* s, int64_t sample) {
+  uint64_t epoch = (uint64_t)(sample / s->nwindows);
+  uint64_t in_epoch = (uint64_t)(sample % s->nwindows);
+  uint64_t key = splitmix64(s->seed ^ splitmix64(epoch));
+  return (int64_t)feistel_permute(in_epoch, (uint64_t)s->nwindows, key);
+}
+
+static void fill_batch(Stream* s, int64_t batch_idx, int32_t* out) {
+  const int64_t row = s->seq_len + 1;
+  for (int64_t j = 0; j < s->batch; j++) {
+    int64_t w = sample_to_window(s, batch_idx * s->batch + j);
+    int64_t base = w * s->seq_len;
+    int32_t* dst = out + j * row;
+    if (s->corpus->token_bytes == 4) {
+      memcpy(dst, s->corpus->data + 4 * base, (size_t)row * 4);
+    } else {
+      for (int64_t t = 0; t < row; t++) dst[t] = read_token(s->corpus, base + t);
+    }
+  }
+}
+
+static void worker_main(Stream* s) {
+  for (;;) {
+    int slot_id;
+    int64_t b;
+    uint64_t gen;
+    {
+      std::unique_lock<std::mutex> lk(s->mu);
+      s->cv_free.wait(lk, [&] { return s->stop || !s->free_slots.empty(); });
+      if (s->stop) return;
+      slot_id = s->free_slots.front();
+      s->free_slots.pop_front();
+      b = s->claim++;
+      gen = s->generation;
+    }
+    fill_batch(s, b, s->slots[slot_id].buf.data());
+    {
+      std::lock_guard<std::mutex> lk(s->mu);
+      if (gen == s->generation && !s->stop) {
+        s->slots[slot_id].batch_idx = b;
+        s->ready.emplace_back(b, slot_id);
+        s->cv_ready.notify_all();
+      } else {  // stale work from before a seek — recycle the slot
+        s->free_slots.push_back(slot_id);
+        s->cv_free.notify_one();
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dio_corpus_open(const char* path, int token_bytes) {
+  if (token_bytes != 2 && token_bytes != 4 && token_bytes != 8) return nullptr;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < token_bytes) {
+    close(fd);
+    return nullptr;
+  }
+  void* p = mmap(nullptr, (size_t)st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (p == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  madvise(p, (size_t)st.st_size, MADV_WILLNEED);
+  Corpus* c = new Corpus();
+  c->fd = fd;
+  c->data = (const uint8_t*)p;
+  c->filesize = (size_t)st.st_size;
+  c->token_bytes = token_bytes;
+  c->ntokens = (int64_t)(st.st_size / token_bytes);
+  return c;
+}
+
+long long dio_corpus_len(void* h) { return h ? ((Corpus*)h)->ntokens : 0; }
+
+void dio_corpus_close(void* h) {
+  if (!h) return;
+  Corpus* c = (Corpus*)h;
+  munmap((void*)c->data, c->filesize);
+  close(c->fd);
+  delete c;
+}
+
+// Deterministic infinite batch stream over a corpus.
+void* dio_stream_create(void* corpus, long long seq_len, long long batch,
+                        unsigned long long seed, int nthreads, int qdepth) {
+  Corpus* c = (Corpus*)corpus;
+  if (!c || seq_len <= 0 || batch <= 0) return nullptr;
+  int64_t nwindows = (c->ntokens - 1) / seq_len;
+  if (nwindows <= 0) return nullptr;
+  if (nthreads < 1) nthreads = 1;
+  if (qdepth < nthreads + 1) qdepth = nthreads + 1;
+  Stream* s = new Stream();
+  s->corpus = c;
+  s->seq_len = seq_len;
+  s->batch = batch;
+  s->nwindows = nwindows;
+  s->seed = seed;
+  s->slots.resize(qdepth);
+  for (int i = 0; i < qdepth; i++) {
+    s->slots[i].buf.resize((size_t)batch * (seq_len + 1));
+    s->free_slots.push_back(i);
+  }
+  for (int i = 0; i < nthreads; i++) s->workers.emplace_back(worker_main, s);
+  return s;
+}
+
+long long dio_stream_nwindows(void* h) { return h ? ((Stream*)h)->nwindows : 0; }
+
+// Blocking: fills out[batch * (seq_len+1)] (int32) with the next batch.
+int dio_stream_next(void* h, int32_t* out) {
+  Stream* s = (Stream*)h;
+  if (!s) return 0;
+  int slot_id = -1;
+  {
+    std::unique_lock<std::mutex> lk(s->mu);
+    for (;;) {
+      for (size_t i = 0; i < s->ready.size(); i++) {
+        if (s->ready[i].first == s->next_emit) {
+          slot_id = s->ready[i].second;
+          s->ready.erase(s->ready.begin() + (long)i);
+          break;
+        }
+      }
+      if (slot_id >= 0 || s->stop) break;
+      s->cv_ready.wait(lk);
+    }
+    if (slot_id < 0) return 0;
+    s->next_emit++;
+  }
+  memcpy(out, s->slots[slot_id].buf.data(),
+         (size_t)s->batch * (s->seq_len + 1) * sizeof(int32_t));
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->free_slots.push_back(slot_id);
+    s->cv_free.notify_one();
+  }
+  return 1;
+}
+
+// Checkpoint state: the absolute index of the next batch to be emitted.
+long long dio_stream_state(void* h) {
+  Stream* s = (Stream*)h;
+  if (!s) return 0;
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->next_emit;
+}
+
+// Resume: restart the stream at absolute batch index `batch_idx`.
+void dio_stream_seek(void* h, long long batch_idx) {
+  Stream* s = (Stream*)h;
+  if (!s) return;
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->generation++;
+  s->claim = batch_idx;
+  s->next_emit = batch_idx;
+  for (auto& pr : s->ready) {
+    s->free_slots.push_back(pr.second);
+  }
+  s->ready.clear();
+  s->cv_free.notify_all();
+}
+
+void dio_stream_destroy(void* h) {
+  Stream* s = (Stream*)h;
+  if (!s) return;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->stop = true;
+    s->cv_free.notify_all();
+    s->cv_ready.notify_all();
+  }
+  for (auto& t : s->workers) t.join();
+  delete s;
+}
+
+// Pure-function hook so tests can check permutation parity vs Python.
+long long dio_feistel(long long idx, long long n, unsigned long long key) {
+  return (long long)feistel_permute((uint64_t)idx, (uint64_t)n, key);
+}
+
+}  // extern "C"

@@ -18,6 +18,7 @@ from .dataset import (  # noqa: F401
     Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
     ConcatDataset, Subset, random_split,
 )
+from .native import TokenStream  # noqa: F401  (C++-backed corpus stream)
 from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
     BatchSampler, DistributedBatchSampler, SubsetRandomSampler,

@@ -1,0 +1,98 @@
+"""Native C++ data pipeline: build, determinism, parity, resume."""
+import numpy as np
+import pytest
+
+from paddle_tpu.io import native
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    path = tmp_path_factory.mktemp("corpus") / "tokens.bin"
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 50257, size=100_001, dtype=np.uint16)
+    native.write_token_file(path, toks)
+    return str(path), toks
+
+
+def test_library_builds():
+    assert native.load_library() is not None, "g++ build of native/dataio.cpp failed"
+
+
+def test_feistel_parity_cpp_vs_python():
+    lib = native.load_library()
+    assert lib is not None
+    for n in (1, 2, 7, 100, 1023, 1024, 99991):
+        for idx in range(0, n, max(1, n // 17)):
+            key = native.splitmix64(n * 7919 + idx)
+            assert lib.dio_feistel(idx, n, key) == native.feistel_permute(idx, n, key)
+
+
+def test_feistel_is_permutation():
+    n, key = 1000, 12345
+    out = {native.feistel_permute(i, n, key) for i in range(n)}
+    assert out == set(range(n))
+
+
+def test_stream_epoch_coverage_and_labels(corpus):
+    path, toks = corpus
+    seq, bs = 128, 4
+    s = native.TokenStream(path, seq, bs, seed=7, num_threads=3)
+    assert s.backend == "native"
+    seen = set()
+    for _ in range(s.batches_per_epoch):
+        x, y = s.next()
+        assert x.shape == (bs, seq) and y.shape == (bs, seq)
+        np.testing.assert_array_equal(x[:, 1:], y[:, :-1])  # shifted-by-one labels
+        for row in x:
+            # recover the window start from corpus content
+            seen.add(int(row[0]) * 100003 + int(row[1]))
+    # one epoch visits batches_per_epoch*bs distinct windows
+    assert len(seen) == s.batches_per_epoch * bs
+    s.close()
+
+
+def test_stream_native_python_parity(corpus):
+    path, _ = corpus
+    a = native.TokenStream(path, 64, 8, seed=42, num_threads=4)
+    b = native.TokenStream(path, 64, 8, seed=42, backend="python")
+    for _ in range(5):
+        xa, ya = a.next()
+        xb, yb = b.next()
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+    a.close(); b.close()
+
+
+def test_stream_determinism_across_thread_counts(corpus):
+    path, _ = corpus
+    a = native.TokenStream(path, 32, 4, seed=3, num_threads=1)
+    b = native.TokenStream(path, 32, 4, seed=3, num_threads=6)
+    for _ in range(10):
+        np.testing.assert_array_equal(a.next()[0], b.next()[0])
+    a.close(); b.close()
+
+
+def test_stream_checkpoint_resume(corpus):
+    path, _ = corpus
+    a = native.TokenStream(path, 32, 4, seed=9, num_threads=2)
+    for _ in range(7):
+        a.next()
+    state = a.state_dict()
+    assert state["cursor"] == 7
+    want = [a.next()[0] for _ in range(3)]
+    b = native.TokenStream(path, 32, 4, seed=9, num_threads=2)
+    b.set_state_dict(state)
+    for w in want:
+        np.testing.assert_array_equal(b.next()[0], w)
+    a.close(); b.close()
+
+
+def test_stream_multi_epoch_reshuffles(corpus):
+    path, _ = corpus
+    s = native.TokenStream(path, 512, 2, seed=1, backend="python")
+    e0 = [s.next()[0] for _ in range(s.batches_per_epoch)]
+    # jump exactly one epoch ahead
+    s.set_state_dict({"cursor": s.nwindows // 2})
+    e1_first = s.next()[0]
+    # different epoch key ⇒ (overwhelmingly likely) different first batch
+    assert not np.array_equal(e0[0], e1_first)
