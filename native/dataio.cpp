@@ -50,7 +50,6 @@ static inline uint64_t feistel_permute(uint64_t idx, uint64_t n, uint64_t key) {
   while ((1ULL << bits) < n) bits++;
   int half = (bits + 1) / 2;
   uint64_t mask = (1ULL << half) - 1;
-  uint64_t domain = 1ULL << (2 * half);
   uint64_t x = idx;
   do {
     uint64_t l = x >> half, r = x & mask;
@@ -61,7 +60,6 @@ static inline uint64_t feistel_permute(uint64_t idx, uint64_t n, uint64_t key) {
       r = nr;
     }
     x = (l << half) | r;
-    (void)domain;
   } while (x >= n);
   return x;
 }

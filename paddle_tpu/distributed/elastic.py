@@ -1,0 +1,184 @@
+"""Elastic training: failure detection + restart-from-checkpoint harness
+(ref: python/paddle/distributed/elastic.py and fleet elastic manager).
+
+The reference's elastic manager watches etcd heartbeats and relaunches ranks.
+The SPMD/TPU analog has no per-rank NCCL process to babysit — failure modes
+are (a) a host/process dying and (b) the numerics going non-finite. We cover
+both with host-local primitives:
+
+  * ``Heartbeat`` / ``HeartbeatMonitor`` — per-rank heartbeat files on shared
+    storage; a rank whose file goes stale past ``timeout`` is reported failed
+  * ``check_numerics`` / ``NanGuard`` — per-step finite check over a pytree
+    (jnp.isfinite reduction, one scalar fetched to host) raising
+    ``NonFiniteError``, the per-step guard promised in SURVEY §5
+  * ``ElasticAgent`` — runs a training function, and on failure restores the
+    latest checkpoint (``incubate.checkpoint.CheckpointManager``) and retries,
+    up to ``max_restarts``
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+class NonFiniteError(RuntimeError):
+    """Raised when a watched value contains NaN/Inf."""
+
+
+def check_numerics(tree, name="tensors"):
+    """Raise NonFiniteError if any leaf of ``tree`` has a NaN or Inf."""
+    leaves = [l._data if hasattr(l, "_data") else l for l in jax.tree_util.tree_leaves(tree)]
+    leaves = [l for l in leaves if hasattr(l, "dtype") and jnp.issubdtype(
+        jnp.asarray(l).dtype, jnp.inexact)]
+    if not leaves:
+        return
+    ok = True
+    for l in leaves:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(l)))
+    if not bool(ok):
+        raise NonFiniteError(f"non-finite value detected in {name}")
+
+
+class NanGuard:
+    """Context-free step guard: ``guard(loss, grads)`` every N steps."""
+
+    def __init__(self, every_n_steps=1):
+        self.every = max(1, int(every_n_steps))
+        self._step = 0
+
+    def __call__(self, *trees):
+        self._step += 1
+        if self._step % self.every == 0:
+            check_numerics(trees, name=f"step {self._step}")
+
+
+class Heartbeat:
+    """Writes ``{dir}/hb_{rank}.json`` every ``interval`` seconds."""
+
+    def __init__(self, directory, rank=0, interval=1.0):
+        self.directory = os.fspath(directory)
+        self.rank = int(rank)
+        self.interval = float(interval)
+        os.makedirs(self.directory, exist_ok=True)
+        self._path = os.path.join(self.directory, f"hb_{self.rank}.json")
+        self._step = 0
+        self._status = "running"
+        self._stop = threading.Event()
+        self._thread = None
+
+    def beat(self, step=None, status=None):
+        if step is not None:
+            self._step = int(step)
+        if status is not None:
+            self._status = status
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "rank": self.rank,
+                       "step": self._step, "status": self._status}, f)
+        os.replace(tmp, self._path)
+
+    def start(self):
+        self.beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self.beat()
+
+    def stop(self, status="stopped"):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self.beat(status=status)
+
+
+class HeartbeatMonitor:
+    """Watches heartbeat files for ``world_size`` ranks."""
+
+    def __init__(self, directory, world_size, timeout=10.0):
+        self.directory = os.fspath(directory)
+        self.world_size = int(world_size)
+        self.timeout = float(timeout)
+
+    def poll(self):
+        """Return {rank: info|None} — None means no heartbeat file yet."""
+        out = {}
+        for r in range(self.world_size):
+            path = os.path.join(self.directory, f"hb_{r}.json")
+            try:
+                with open(path) as f:
+                    info = json.load(f)
+                info["age"] = time.time() - info["ts"]
+                out[r] = info
+            except (OSError, ValueError):
+                out[r] = None
+        return out
+
+    def failed_ranks(self):
+        """Ranks that are missing, stale past timeout, or marked failed."""
+        bad = []
+        for r, info in self.poll().items():
+            if info is None or info["age"] > self.timeout or info["status"] == "failed":
+                bad.append(r)
+        return bad
+
+    def wait_alive(self, deadline=30.0):
+        """Block until every rank has a fresh heartbeat (startup barrier)."""
+        t0 = time.time()
+        while time.time() - t0 < deadline:
+            if not self.failed_ranks():
+                return True
+            time.sleep(0.05)
+        return False
+
+
+class ElasticAgent:
+    """Run ``train_fn(state, start_step) -> final_state`` with auto-restart.
+
+    On any exception from ``train_fn`` the agent restores the latest
+    checkpoint from ``ckpt`` and re-invokes it, up to ``max_restarts`` times.
+    ``train_fn`` receives the restored state pytree (or ``initial_state`` when
+    no checkpoint exists) and the step to resume from; it is responsible for
+    calling ``ckpt.save(step, state)`` periodically.
+    """
+
+    def __init__(self, train_fn, ckpt, initial_state=None, max_restarts=3,
+                 heartbeat=None, on_restart=None):
+        self.train_fn = train_fn
+        self.ckpt = ckpt
+        self.initial_state = initial_state
+        self.max_restarts = int(max_restarts)
+        self.heartbeat = heartbeat
+        self.on_restart = on_restart
+        self.restarts = 0
+
+    def run(self):
+        while True:
+            step = self.ckpt.latest_step()
+            state = self.ckpt.restore(step) if step is not None else self.initial_state
+            start_step = 0 if step is None else int(step)
+            try:
+                if self.heartbeat is not None:
+                    self.heartbeat.start()
+                result = self.train_fn(state, start_step)
+                if self.heartbeat is not None:
+                    self.heartbeat.stop(status="finished")
+                return result
+            except Exception as e:  # noqa: BLE001 — any training failure restarts
+                if self.heartbeat is not None:
+                    self.heartbeat.stop(status="failed")
+                self.ckpt.wait()
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    raise RuntimeError(
+                        f"elastic: giving up after {self.restarts - 1} restarts") from e
+                if self.on_restart is not None:
+                    self.on_restart(self.restarts, e)

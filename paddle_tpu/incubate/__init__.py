@@ -1,0 +1,5 @@
+"""paddle_tpu.incubate — experimental subsystems (ref: python/paddle/incubate).
+
+Currently: step-tagged async checkpointing (``incubate.checkpoint``).
+"""
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,130 @@
+"""Step-tagged checkpoint manager with async save and exact resume
+(ref: python/paddle/incubate/checkpoint/auto_checkpoint.py, framework/io.py).
+
+TPU-first design notes:
+  * the device→host snapshot happens synchronously (device buffers may be
+    donated by the very next jitted step), but the disk write runs on a
+    background thread so training overlaps with IO — the reference gets the
+    same overlap from its C++ checkpoint workers
+  * a checkpoint directory is made visible atomically (write to ``.tmp``,
+    ``os.rename``) so a crash mid-write can never produce a half checkpoint
+    that ``latest_step`` would pick up
+  * retention: ``keep_last_n`` prunes old steps after each successful save
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+
+import jax
+import numpy as np
+
+from ..framework import io as fio
+from ..tensor_impl import Tensor
+
+_STEP_PREFIX = "step_"
+
+
+class CheckpointManager:
+    def __init__(self, directory, keep_last_n=3, async_save=True):
+        self.directory = os.fspath(directory)
+        self.keep_last_n = int(keep_last_n)
+        self.async_save = bool(async_save)
+        os.makedirs(self.directory, exist_ok=True)
+        self._thread = None
+        self._error = None
+        self._lock = threading.Lock()
+
+    # -- querying ----------------------------------------------------------
+    def all_steps(self):
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith(_STEP_PREFIX) and not name.endswith(".tmp"):
+                try:
+                    steps.append(int(name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    pass
+        return sorted(steps)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _step_dir(self, step):
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
+
+    # -- saving ------------------------------------------------------------
+    def save(self, step, state, blocking=None):
+        """Checkpoint ``state`` (a pytree of Tensors/arrays/scalars) at ``step``.
+
+        Snapshots to host immediately; writes to disk on a background thread
+        unless ``blocking`` (or the manager was created with
+        ``async_save=False``).
+        """
+        self.wait()  # one in-flight save at a time; surfaces prior IO errors
+
+        def _snap(a):
+            if hasattr(a, "_data"):  # Tensor: host copy, keep wrapper type
+                t = Tensor(np.asarray(jax.device_get(a._data)),
+                           stop_gradient=a.stop_gradient)
+                t.name = a.name
+                return t
+            if isinstance(a, jax.Array):
+                return np.asarray(jax.device_get(a))
+            return a
+
+        snap = jax.tree_util.tree_map(_snap, state)
+        if blocking is None:
+            blocking = not self.async_save
+        if blocking:
+            self._write(int(step), snap)
+        else:
+            self._thread = threading.Thread(
+                target=self._write_guarded, args=(int(step), snap), daemon=True)
+            self._thread.start()
+
+    def _write_guarded(self, step, snap):
+        try:
+            self._write(step, snap)
+        except BaseException as e:  # surfaced on next save()/wait()
+            with self._lock:
+                self._error = e
+
+    def _write(self, step, snap):
+        final = self._step_dir(step)
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        fio.save(snap, os.path.join(tmp, "state.pdckpt"))
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)  # atomic publish
+        self._prune()
+
+    def _prune(self):
+        steps = self.all_steps()
+        for s in steps[: max(0, len(steps) - self.keep_last_n)]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def wait(self):
+        """Block until any in-flight async save has finished; re-raise IO errors."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        with self._lock:
+            if self._error is not None:
+                e, self._error = self._error, None
+                raise e
+
+    # -- restoring ---------------------------------------------------------
+    def restore(self, step=None):
+        """Load the checkpoint at ``step`` (default: latest). None if empty."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        path = os.path.join(self._step_dir(step), "state.pdckpt")
+        return fio.load(path)

@@ -58,11 +58,11 @@ def load_library(rebuild=False):
         if _build_error is not None and not rebuild:
             return None  # don't retry a known-broken toolchain every call
         try:
-            stale = (
-                rebuild
-                or not os.path.exists(_LIB_PATH)
-                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
-            )
+            have_lib = os.path.exists(_LIB_PATH)
+            have_src = os.path.exists(_SRC)
+            stale = rebuild or not have_lib or (
+                have_src and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+            )  # a prebuilt .so without the source tree is fine as-is
             if stale:
                 _compile_lib()
             lib = ctypes.CDLL(_LIB_PATH)

@@ -1,0 +1,169 @@
+"""Elastic subsystem: checkpoint manager, heartbeats, NaN guard, restart agent."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import elastic
+from paddle_tpu.incubate.checkpoint import CheckpointManager
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+def test_ckpt_roundtrip_and_latest(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last_n=2)
+    state = {"w": paddle.to_tensor(np.arange(6.0).reshape(2, 3)), "step": 1}
+    mgr.save(1, state, blocking=True)
+    mgr.save(5, {"w": paddle.to_tensor(np.ones((2, 3))), "step": 5}, blocking=True)
+    assert mgr.latest_step() == 5
+    got = mgr.restore(1)
+    np.testing.assert_allclose(np.asarray(got["w"]._data),
+                               np.arange(6.0).reshape(2, 3))
+    assert got["step"] == 1
+
+
+def test_ckpt_async_and_retention(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last_n=2, async_save=True)
+    for s in range(4):
+        mgr.save(s, {"x": np.full((4,), float(s))})
+    mgr.wait()
+    assert mgr.all_steps() == [2, 3]
+    np.testing.assert_allclose(mgr.restore()["x"], 3.0)
+
+
+def test_ckpt_no_partial_dirs_visible(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last_n=5)
+    mgr.save(7, {"x": np.zeros(3)}, blocking=True)
+    assert mgr.all_steps() == [7]
+    assert not any(p.name.endswith(".tmp") for p in tmp_path.iterdir())
+
+
+def test_ckpt_empty(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    assert mgr.latest_step() is None
+    assert mgr.restore() is None
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_monitor(tmp_path):
+    hb0 = elastic.Heartbeat(tmp_path, rank=0, interval=0.05).start()
+    hb1 = elastic.Heartbeat(tmp_path, rank=1, interval=0.05).start()
+    mon = elastic.HeartbeatMonitor(tmp_path, world_size=2, timeout=1.0)
+    assert mon.wait_alive(deadline=5.0)
+    assert mon.failed_ranks() == []
+    hb1.stop(status="failed")
+    assert mon.failed_ranks() == [1]
+    hb0.stop()
+    # stale detection: frozen clock file older than timeout
+    mon2 = elastic.HeartbeatMonitor(tmp_path, world_size=2, timeout=0.01)
+    time.sleep(0.05)
+    assert 0 in mon2.failed_ranks()
+
+
+# ---------------------------------------------------------------------------
+# NaN guard
+# ---------------------------------------------------------------------------
+
+def test_check_numerics():
+    elastic.check_numerics({"a": np.ones(3), "b": paddle.to_tensor([1.0, 2.0])})
+    with pytest.raises(elastic.NonFiniteError):
+        elastic.check_numerics([np.array([1.0, np.inf])])
+    guard = elastic.NanGuard(every_n_steps=2)
+    guard(np.array([np.nan]))  # step 1: not checked
+    with pytest.raises(elastic.NonFiniteError):
+        guard(np.array([np.nan]))  # step 2: checked
+
+
+# ---------------------------------------------------------------------------
+# ElasticAgent: crash mid-run, restart from checkpoint, exact resume
+# ---------------------------------------------------------------------------
+
+def _sgd_run(tmp_path, crash_at=None, total=10, ckpt_every=3):
+    """Deterministic toy training loop driven by the agent; returns final w."""
+    mgr = CheckpointManager(tmp_path, keep_last_n=2, async_save=False)
+    crashed = {"done": crash_at is None}
+
+    def train_fn(state, start_step):
+        w = np.asarray(state["w"]._data) if state else np.zeros(4)
+        w = w.copy()
+        for step in range(start_step, total):
+            if not crashed["done"] and crash_at is not None and step == crash_at:
+                crashed["done"] = True
+                raise RuntimeError("injected failure")
+            w = w + 0.1 * (step + 1)  # deterministic "gradient"
+            if (step + 1) % ckpt_every == 0:
+                mgr.save(step + 1, {"w": paddle.to_tensor(w)})
+        return w
+
+    agent = elastic.ElasticAgent(train_fn, mgr,
+                                 initial_state=None, max_restarts=2)
+    return agent.run(), agent.restarts
+
+
+def test_elastic_exact_resume(tmp_path):
+    w_clean, r0 = _sgd_run(tmp_path / "clean", crash_at=None)
+    w_crash, r1 = _sgd_run(tmp_path / "crash", crash_at=7)
+    assert r0 == 0 and r1 == 1
+    np.testing.assert_allclose(w_crash, w_clean)  # bitwise exact resume
+
+
+def test_elastic_gives_up(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+
+    def always_fail(state, start_step):
+        raise RuntimeError("boom")
+
+    agent = elastic.ElasticAgent(always_fail, mgr, max_restarts=2)
+    with pytest.raises(RuntimeError, match="giving up"):
+        agent.run()
+    assert agent.restarts == 3
+
+
+def test_elastic_with_stream_resume(tmp_path):
+    """Data-pipeline cursor rides along in the checkpoint (native.TokenStream)."""
+    from paddle_tpu.io import native
+    corpus = tmp_path / "toks.bin"
+    native.write_token_file(corpus, np.arange(5000) % 251)
+    mgr = CheckpointManager(tmp_path / "ck", async_save=False)
+
+    def run(crash):
+        s = native.TokenStream(str(corpus), 16, 2, seed=3, backend="python")
+        st = mgr.restore()
+        seen = list(st["seen"]) if st else []
+        if st:
+            s.set_state_dict({"cursor": st["cursor"]})
+        crashed = {"done": not crash}
+
+        def train_fn(state, start_step):
+            for i in range(len(seen), 8):
+                if crash and not crashed["done"] and i == 5:
+                    crashed["done"] = True
+                    raise RuntimeError("die")
+                x, _ = s.next()
+                seen.append(int(x[0, 0]))
+                mgr.save(i + 1, {"cursor": s.state_dict()["cursor"], "seen": list(seen)})
+            return seen
+
+        # restore stream cursor on each (re)start
+        def train_with_restore(state, start_step):
+            if state is not None:
+                s.set_state_dict({"cursor": state["cursor"]})
+                del seen[:]
+                seen.extend(state["seen"])
+            return train_fn(state, start_step)
+
+        return elastic.ElasticAgent(train_with_restore, mgr, max_restarts=1).run()
+
+    golden = run(crash=False)
+    # fresh dirs for the crashing variant
+    import shutil
+    shutil.rmtree(tmp_path / "ck")
+    mgr = CheckpointManager(tmp_path / "ck", async_save=False)
+    resumed = run(crash=True)
+    assert resumed == golden
