@@ -59,6 +59,7 @@ from . import distribution  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401
 from .hapi import callbacks  # noqa: E402,F401

@@ -18,6 +18,7 @@ both with host-local primitives:
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
@@ -32,13 +33,20 @@ class NonFiniteError(RuntimeError):
 
 def check_numerics(tree, name="tensors"):
     """Raise NonFiniteError if any leaf of ``tree`` has a NaN or Inf."""
-    leaves = [l._data if hasattr(l, "_data") else l for l in jax.tree_util.tree_leaves(tree)]
-    leaves = [l for l in leaves if hasattr(l, "dtype") and jnp.issubdtype(
-        jnp.asarray(l).dtype, jnp.inexact)]
-    if not leaves:
+    arrays = []
+    for l in jax.tree_util.tree_leaves(tree):
+        if hasattr(l, "_data"):
+            l = l._data
+        if isinstance(l, float):  # plain python / numpy scalar loss
+            if not math.isfinite(l):
+                raise NonFiniteError(f"non-finite value detected in {name}")
+            continue
+        if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.inexact):
+            arrays.append(l)
+    if not arrays:
         return
     ok = True
-    for l in leaves:
+    for l in arrays:
         ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(l)))
     if not bool(ok):
         raise NonFiniteError(f"non-finite value detected in {name}")
@@ -70,19 +78,25 @@ class Heartbeat:
         self._status = "running"
         self._stop = threading.Event()
         self._thread = None
+        self._write_lock = threading.Lock()
 
     def beat(self, step=None, status=None):
-        if step is not None:
-            self._step = int(step)
-        if status is not None:
-            self._status = status
-        tmp = self._path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"ts": time.time(), "rank": self.rank,
-                       "step": self._step, "status": self._status}, f)
-        os.replace(tmp, self._path)
+        with self._write_lock:  # loop thread + user beat(step=...) both write
+            if step is not None:
+                self._step = int(step)
+            if status is not None:
+                self._status = status
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"ts": time.time(), "rank": self.rank,
+                           "step": self._step, "status": self._status}, f)
+            os.replace(tmp, self._path)
 
     def start(self):
+        if self._thread is not None:
+            return self  # already beating
+        self._stop.clear()  # restartable after stop() (elastic retries)
+        self._status = "running"
         self.beat()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -175,7 +189,10 @@ class ElasticAgent:
             except Exception as e:  # noqa: BLE001 — any training failure restarts
                 if self.heartbeat is not None:
                     self.heartbeat.stop(status="failed")
-                self.ckpt.wait()
+                try:
+                    self.ckpt.wait()
+                except Exception:  # stale async-save IO error must not
+                    pass           # preempt the restart: older ckpts are valid
                 self.restarts += 1
                 if self.restarts > self.max_restarts:
                     raise RuntimeError(

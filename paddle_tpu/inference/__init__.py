@@ -1,0 +1,199 @@
+"""Inference/deploy path (ref: paddle/fluid/inference, python/paddle/inference).
+
+The reference deploys a serialized static Program (`.pdmodel` + `.pdiparams`)
+loaded by a C++ predictor. The TPU-native artifact is a *serialized StableHLO
+export* (`jax.export`): the traced forward is saved as a compiler-level
+program, so loading needs **no Python model code** — exactly the property the
+reference's Program gives its C++ predictor — and XLA AOT-compiles it for the
+target backend on load.
+
+Artifact layout (``save_inference_model(prefix, layer, input_spec)``):
+    ``{prefix}.pdhlo``      serialized StableHLO module (jax.export blob)
+    ``{prefix}.pdiparams``  weights + buffers (framework.io pickle)
+    ``{prefix}.pdconfig``   json: input specs, export platforms, version
+
+Dynamic batch: an ``InputSpec`` leading dim of ``None``/-1 exports with a
+symbolic dimension, so one artifact serves any batch size.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+from jax import export as jexport
+
+from ..framework import io as fio
+from ..jit.functional import capture_buffers, capture_params, functional_call
+from ..static import InputSpec
+from ..tensor_impl import Tensor
+
+_HLO_SUFFIX = ".pdhlo"
+_PARAMS_SUFFIX = ".pdiparams"
+_CONFIG_SUFFIX = ".pdconfig"
+
+
+def _spec_to_sds(spec, scope):
+    """InputSpec -> ShapeDtypeStruct, mapping None/-1 leading dims to a
+    symbolic batch dimension (shape polymorphism)."""
+    shape = []
+    symbolic = False
+    for i, d in enumerate(spec.shape):
+        if d is None or (isinstance(d, int) and d < 0):
+            shape.append("b" if i == 0 else f"d{i}")
+            symbolic = True
+        else:
+            shape.append(int(d))
+    dtype = np.dtype(spec.dtype) if not isinstance(spec.dtype, str) else np.dtype(spec.dtype)
+    if symbolic:
+        dims = jexport.symbolic_shape(
+            "(" + ", ".join(str(s) for s in shape) + ")", scope=scope)
+        return jax.ShapeDtypeStruct(dims, dtype)
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def save_inference_model(path_prefix, layer, input_spec, platforms=None):
+    """Export ``layer``'s eval-mode forward for deployment.
+
+    ``input_spec``: list of InputSpec (or Tensors/arrays used as templates).
+    ``platforms``: e.g. ``["cpu", "tpu"]`` for a cross-platform artifact;
+    default exports for the current default backend only.
+    """
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        params = {k: np.asarray(jax.device_get(v)) for k, v in capture_params(layer).items()}
+        buffers = {k: np.asarray(jax.device_get(v)) for k, v in capture_buffers(layer).items()}
+
+        def fn(params, buffers, *inputs):
+            outs, _ = functional_call(layer, params, buffers, inputs,
+                                      rng_key=jax.random.PRNGKey(0))
+            return outs
+
+        specs = []
+        for s in input_spec:
+            if isinstance(s, InputSpec):
+                specs.append(s)
+            else:
+                arr = s._data if isinstance(s, Tensor) else np.asarray(s)
+                specs.append(InputSpec(shape=arr.shape, dtype=str(arr.dtype)))
+        scope = jexport.SymbolicScope()
+        input_sds = [_spec_to_sds(s, scope) for s in specs]
+        params_sds = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+        buffers_sds = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers)
+
+        kwargs = {}
+        if platforms is not None:
+            kwargs["platforms"] = tuple(platforms)
+        exported = jexport.export(jax.jit(fn), **kwargs)(
+            params_sds, buffers_sds, *input_sds)
+
+        with open(path_prefix + _HLO_SUFFIX, "wb") as f:
+            f.write(exported.serialize())
+        fio.save({"params": params, "buffers": buffers}, path_prefix + _PARAMS_SUFFIX)
+        with open(path_prefix + _CONFIG_SUFFIX, "w") as f:
+            json.dump({
+                "version": 1,
+                "inputs": [{"shape": [d if isinstance(d, int) else None for d in s.shape],
+                            "dtype": str(np.dtype(s.dtype)), "name": s.name} for s in specs],
+                "platforms": list(exported.platforms),
+            }, f, indent=2)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+
+class Config:
+    """Deploy config (parity shim for paddle.inference.Config)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # accept either a path prefix or explicit file paths
+        if prog_file is not None and prog_file.endswith(_HLO_SUFFIX):
+            self.path_prefix = prog_file[: -len(_HLO_SUFFIX)]
+        else:
+            self.path_prefix = prog_file
+        self._device = None
+
+    def enable_use_gpu(self, *a, **k):  # reference API compat; device is jax's
+        self._device = "gpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+
+class Predictor:
+    """Runs a saved inference artifact. No model source code required."""
+
+    def __init__(self, path_prefix):
+        self.path_prefix = path_prefix
+        with open(path_prefix + _HLO_SUFFIX, "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        blob = fio.load(path_prefix + _PARAMS_SUFFIX)
+        self._params = blob["params"]
+        self._buffers = blob["buffers"]
+        with open(path_prefix + _CONFIG_SUFFIX) as f:
+            self.config = json.load(f)
+        self._call = jax.jit(self._exported.call)
+        self._inputs = [None] * len(self.config["inputs"])
+
+    # -- simple API --------------------------------------------------------
+    def run(self, *inputs):
+        """Predict: numpy/Tensor inputs -> list of numpy outputs."""
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        arrs = [x._data if isinstance(x, Tensor) else np.asarray(x) for x in inputs]
+        outs = self._call(self._params, self._buffers, *arrs)
+        flat = jax.tree_util.tree_leaves(outs)
+        return [np.asarray(jax.device_get(o)) for o in flat]
+
+    # -- reference-style handle API ---------------------------------------
+    def get_input_names(self):
+        return [i["name"] or f"x{k}" for k, i in enumerate(self.config["inputs"])]
+
+    def get_input_handle(self, name):
+        idx = self.get_input_names().index(name)
+        pred = self
+
+        class _Handle:
+            def copy_from_cpu(self, arr):
+                pred._inputs[idx] = np.asarray(arr)
+
+            def copy_to_cpu(self):
+                return pred._outputs[idx]
+
+        return _Handle()
+
+    def get_output_names(self):
+        self._ensure_ran()
+        return [f"out{k}" for k in range(len(self._outputs))]
+
+    def get_output_handle(self, name):
+        idx = int(name[3:]) if name.startswith("out") else 0
+        pred = self
+
+        class _Handle:
+            def copy_to_cpu(self):
+                return pred._outputs[idx]
+
+        return _Handle()
+
+    def run_handles(self):
+        self._outputs = self.run(*self._inputs)
+        return True
+
+    def _ensure_ran(self):
+        if not hasattr(self, "_outputs"):
+            raise RuntimeError("call run()/run_handles() first")
+
+
+def load_inference_model(path_prefix):
+    return Predictor(path_prefix)
+
+
+def create_predictor(config):
+    return Predictor(config.path_prefix)

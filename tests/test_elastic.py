@@ -70,6 +70,24 @@ def test_heartbeat_monitor(tmp_path):
 # NaN guard
 # ---------------------------------------------------------------------------
 
+def test_heartbeat_restartable(tmp_path):
+    """After stop(status='failed'), start() must resume beating as 'running'."""
+    hb = elastic.Heartbeat(tmp_path, rank=0, interval=0.02).start()
+    hb.stop(status="failed")
+    hb.start()
+    time.sleep(0.1)
+    mon = elastic.HeartbeatMonitor(tmp_path, world_size=1, timeout=5.0)
+    info = mon.poll()[0]
+    assert info["status"] == "running" and info["age"] < 1.0
+    hb.stop()
+
+
+def test_check_numerics_python_float():
+    with pytest.raises(elastic.NonFiniteError):
+        elastic.check_numerics({"loss": float("nan")})
+    elastic.check_numerics({"loss": 1.5, "step": 3})
+
+
 def test_check_numerics():
     elastic.check_numerics({"a": np.ones(3), "b": paddle.to_tensor([1.0, 2.0])})
     with pytest.raises(elastic.NonFiniteError):
