@@ -60,6 +60,14 @@ from . import sparse  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import geometric  # noqa: E402,F401
+from . import text  # noqa: E402,F401
+from . import audio  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401
 from .hapi import callbacks  # noqa: E402,F401

@@ -1,0 +1,13 @@
+"""Audio domain API (ref: python/paddle/audio/__init__.py).
+
+Subpackages: `functional` (mel/fbank/dct/window math), `features`
+(Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC layers), `datasets`
+(TESS/ESC50 with synthetic zero-egress fallback). Backends (soundfile IO)
+are host-side and stubbed to a raw-PCM reader — TPU compute never touches
+file IO.
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from . import datasets  # noqa: F401
+
+__all__ = ["functional", "features", "datasets"]

@@ -1,0 +1,82 @@
+"""Audio datasets (ref: python/paddle/audio/datasets/{tess,esc50}.py).
+
+Synthetic zero-egress fallback: deterministic sine-mixture waveforms with the
+reference's class structure, optionally transformed to features at __getitem__
+time (matching the reference's feat_type switch).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+from ..tensor_impl import as_tensor_data
+
+_FEAT_BUILDERS = ("raw", "melspectrogram", "mfcc", "logmelspectrogram",
+                  "spectrogram")
+
+
+class _SyntheticAudioDataset(Dataset):
+    sample_rate = 16000
+    duration = 1.0
+
+    def __init__(self, mode, n_classes, size, feat_type="raw", **feat_conf):
+        if feat_type not in _FEAT_BUILDERS:
+            raise ValueError(f"Unknown feat_type {feat_type}")
+        self.mode = mode
+        self.n_classes = n_classes
+        self.size = size
+        self.feat_type = feat_type
+        self._feat = None
+        if feat_type != "raw":
+            from ..audio import features as F
+            layer = {"melspectrogram": F.MelSpectrogram,
+                     "logmelspectrogram": F.LogMelSpectrogram,
+                     "mfcc": F.MFCC, "spectrogram": F.Spectrogram}[feat_type]
+            feat_conf.setdefault("sr" if feat_type != "spectrogram" else "n_fft",
+                                 self.sample_rate if feat_type != "spectrogram"
+                                 else 512)
+            self._feat = layer(**feat_conf)
+
+    def __len__(self):
+        return self.size
+
+    def _waveform(self, idx):
+        rng = np.random.RandomState(idx * 7919 + (0 if self.mode == "train" else 1))
+        n = int(self.sample_rate * self.duration)
+        t = np.arange(n) / self.sample_rate
+        label = idx % self.n_classes
+        f0 = 110.0 * (label + 1)
+        wav = sum(np.sin(2 * np.pi * f0 * (k + 1) * t) / (k + 1)
+                  for k in range(3))
+        wav = (wav + 0.05 * rng.randn(n)).astype(np.float32)
+        return wav, label
+
+    def __getitem__(self, idx):
+        wav, label = self._waveform(idx)
+        if self._feat is not None:
+            out = self._feat(wav[None, :])
+            return np.asarray(as_tensor_data(out))[0], np.int64(label)
+        return wav, np.int64(label)
+
+
+class TESS(_SyntheticAudioDataset):
+    """Toronto emotional speech set: 7 emotion classes."""
+
+    n_class = 7
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        assert 1 <= split <= n_folds
+        super().__init__(mode, self.n_class, 560 if mode == "train" else 140,
+                         feat_type, **kwargs)
+
+
+class ESC50(_SyntheticAudioDataset):
+    """Environmental sound classification: 50 classes."""
+
+    n_class = 50
+
+    def __init__(self, mode="train", split=1, feat_type="raw", archive=None,
+                 **kwargs):
+        super().__init__(mode, self.n_class, 1600 if mode == "train" else 400,
+                         feat_type, **kwargs)

@@ -1,0 +1,102 @@
+"""Audio feature layers (ref: python/paddle/audio/features/layers.py).
+
+Each layer precomputes its constants (window, fbank, DCT) at construction and
+runs stft → power → matmul in one traced graph: the filterbank application is
+a dense matmul that XLA maps onto the MXU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import signal as _signal
+from ..dispatch import apply
+from ..nn import Layer
+from ..tensor_impl import as_tensor_data
+from .functional import compute_fbank_matrix, create_dct, get_window, power_to_db
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        if power is None or power <= 0:
+            raise ValueError("power must be a positive number")
+        self.n_fft = n_fft
+        self.hop_length = hop_length
+        self.win_length = win_length if win_length is not None else n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = get_window(window, self.win_length, fftbins=True,
+                                     dtype=dtype)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.fft_window, center=self.center,
+                            pad_mode=self.pad_mode)
+        return apply(lambda s: jnp.abs(s) ** self.power, spec)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, dtype)
+        self.n_mels = n_mels
+        self.fbank_matrix = compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)          # (..., n_fft//2+1, frames)
+        fb = as_tensor_data(self.fbank_matrix)
+        return apply(lambda s: jnp.matmul(fb.astype(s.dtype), s), spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, ref_value=self.ref_value, amin=self.amin,
+                           top_db=self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=512,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = create_dct(n_mfcc=n_mfcc, n_mels=n_mels, dtype=dtype)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)   # (..., n_mels, frames)
+        dct = as_tensor_data(self.dct_matrix)
+        return apply(
+            lambda m: jnp.swapaxes(
+                jnp.matmul(jnp.swapaxes(m, -1, -2), dct.astype(m.dtype)),
+                -1, -2),
+            logmel)

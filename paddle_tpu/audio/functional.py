@@ -1,0 +1,276 @@
+"""Audio functional ops (ref: python/paddle/audio/functional/functional.py,
+window.py).
+
+Pure jnp implementations — filterbank construction and windows are small
+trace-time constants, so feature layers built on them compile into one XLA
+program (stft → |.|^p → fbank matmul rides the MXU).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, as_tensor_data
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "power_to_db", "create_dct", "get_window",
+]
+
+
+def hz_to_mel(freq, htk=False):
+    """Hz → mel (Slaney by default, HTK formula optional)."""
+    is_tensor = isinstance(freq, Tensor) or hasattr(freq, "shape")
+    f = jnp.asarray(as_tensor_data(freq), jnp.float64) if is_tensor else float(freq)
+    if htk:
+        if is_tensor:
+            return Tensor(2595.0 * jnp.log10(1.0 + f / 700.0))
+        return 2595.0 * math.log10(1.0 + freq / 700.0)
+    f_sp = 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    if is_tensor:
+        lin = f / f_sp
+        log = min_log_mel + jnp.log(jnp.maximum(f, min_log_hz) / min_log_hz) / logstep
+        return Tensor(jnp.where(f >= min_log_hz, log, lin))
+    if freq >= min_log_hz:
+        return min_log_mel + math.log(freq / min_log_hz) / logstep
+    return freq / f_sp
+
+
+def mel_to_hz(mel, htk=False):
+    """Mel → Hz (inverse of hz_to_mel)."""
+    is_tensor = isinstance(mel, Tensor) or hasattr(mel, "shape")
+    m = jnp.asarray(as_tensor_data(mel), jnp.float64) if is_tensor else float(mel)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        return Tensor(out) if is_tensor else out
+    f_sp = 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    if is_tensor:
+        lin = f_sp * m
+        log = min_log_hz * jnp.exp(logstep * (m - min_log_mel))
+        return Tensor(jnp.where(m >= min_log_mel, log, lin))
+    if mel >= min_log_mel:
+        return min_log_hz * math.exp(logstep * (mel - min_log_mel))
+    return f_sp * mel
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """n_mels frequencies evenly spaced on the mel scale."""
+    lo = hz_to_mel(float(f_min), htk=htk)
+    hi = hz_to_mel(float(f_max), htk=htk)
+    mels = jnp.linspace(lo, hi, n_mels, dtype=jnp.float64)
+    return Tensor(jnp.asarray(as_tensor_data(mel_to_hz(Tensor(mels), htk=htk)),
+                              dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """Center frequencies of rfft bins."""
+    return Tensor(jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2, dtype=dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank matrix of shape (n_mels, 1 + n_fft//2)."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = jnp.asarray(as_tensor_data(fft_frequencies(sr, n_fft, "float64")))
+    mel_f = jnp.asarray(as_tensor_data(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk, "float64")))
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif norm is not None and norm != 1.0:
+        raise ValueError(f"Unsupported norm: {norm}")
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """Power spectrogram → decibels (10*log10), clamped to top_db range."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    x = jnp.asarray(as_tensor_data(spect))
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix (n_mels, n_mfcc) for MFCC extraction."""
+    n = jnp.arange(n_mels, dtype=jnp.float64)
+    k = jnp.arange(n_mfcc, dtype=jnp.float64)[None, :]
+    dct = jnp.cos(math.pi / float(n_mels) * (n[:, None] + 0.5) * k)
+    if norm is None:
+        dct = dct * 2.0
+    elif norm == "ortho":
+        dct = dct * jnp.where(k == 0, math.sqrt(1.0 / (4 * n_mels)),
+                              math.sqrt(1.0 / (2 * n_mels))) * 2.0
+    else:
+        raise ValueError(f"Unsupported norm: {norm}")
+    return Tensor(dct.astype(dtype))
+
+
+# -- windows ----------------------------------------------------------------
+
+def _extend(M, sym):
+    return (M + 1, True) if not sym else (M, False)
+
+
+def _truncate(w, needs_trunc):
+    return w[:-1] if needs_trunc else w
+
+
+def _general_cosine(M, a, sym):
+    if M <= 1:
+        return jnp.ones((M,), jnp.float64)
+    M, trunc = _extend(M, sym)
+    fac = jnp.linspace(-math.pi, math.pi, M, dtype=jnp.float64)
+    w = jnp.zeros((M,), jnp.float64)
+    for k, coef in enumerate(a):
+        w = w + coef * jnp.cos(k * fac)
+    return _truncate(w, trunc)
+
+
+def _window_hann(M, sym):
+    return _general_cosine(M, [0.5, 0.5], sym)
+
+
+def _window_hamming(M, sym):
+    return _general_cosine(M, [0.54, 0.46], sym)
+
+
+def _window_blackman(M, sym):
+    return _general_cosine(M, [0.42, 0.50, 0.08], sym)
+
+
+def _window_bartlett(M, sym):
+    if M <= 1:
+        return jnp.ones((M,), jnp.float64)
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(M, dtype=jnp.float64)
+    w = jnp.where(n <= (M - 1) / 2.0, 2.0 * n / (M - 1),
+                  2.0 - 2.0 * n / (M - 1))
+    return _truncate(w, trunc)
+
+
+def _window_triang(M, sym):
+    if M <= 1:
+        return jnp.ones((M,), jnp.float64)
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(1, (M + 1) // 2 + 1, dtype=jnp.float64)
+    if M % 2 == 0:
+        half = (2 * n - 1.0) / M
+        w = jnp.concatenate([half, half[::-1]])
+    else:
+        half = 2 * n / (M + 1.0)
+        w = jnp.concatenate([half, half[-2::-1]])
+    return _truncate(w, trunc)
+
+
+def _window_bohman(M, sym):
+    if M <= 1:
+        return jnp.ones((M,), jnp.float64)
+    M, trunc = _extend(M, sym)
+    fac = jnp.abs(jnp.linspace(-1, 1, M, dtype=jnp.float64)[1:-1])
+    w = (1 - fac) * jnp.cos(math.pi * fac) + 1.0 / math.pi * jnp.sin(math.pi * fac)
+    w = jnp.concatenate([jnp.zeros((1,)), w, jnp.zeros((1,))])
+    return _truncate(w, trunc)
+
+
+def _window_cosine(M, sym):
+    if M <= 1:
+        return jnp.ones((M,), jnp.float64)
+    M, trunc = _extend(M, sym)
+    w = jnp.sin(math.pi / M * (jnp.arange(M, dtype=jnp.float64) + 0.5))
+    return _truncate(w, trunc)
+
+
+def _window_gaussian(M, std=7, sym=True):
+    if M <= 1:
+        return jnp.ones((M,), jnp.float64)
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(M, dtype=jnp.float64) - (M - 1.0) / 2.0
+    w = jnp.exp(-(n ** 2) / (2 * std * std))
+    return _truncate(w, trunc)
+
+
+def _window_general_gaussian(M, p=1.0, sig=7, sym=True):
+    if M <= 1:
+        return jnp.ones((M,), jnp.float64)
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(M, dtype=jnp.float64) - (M - 1.0) / 2.0
+    w = jnp.exp(-0.5 * jnp.abs(n / sig) ** (2 * p))
+    return _truncate(w, trunc)
+
+
+def _window_exponential(M, center=None, tau=1.0, sym=True):
+    if sym and center is not None:
+        raise ValueError("When sym=True, center must be None.")
+    if M <= 1:
+        return jnp.ones((M,), jnp.float64)
+    M, trunc = _extend(M, sym)
+    if center is None:
+        center = (M - 1) / 2
+    n = jnp.arange(M, dtype=jnp.float64)
+    w = jnp.exp(-jnp.abs(n - center) / tau)
+    return _truncate(w, trunc)
+
+
+def _window_tukey(M, alpha=0.5, sym=True):
+    if M <= 1:
+        return jnp.ones((M,), jnp.float64)
+    if alpha <= 0:
+        return jnp.ones((M,), jnp.float64)
+    if alpha >= 1.0:
+        return _window_hann(M, sym)
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(M, dtype=jnp.float64)
+    width = int(alpha * (M - 1) / 2.0)
+    n1, n2, n3 = n[:width + 1], n[width + 1:M - width - 1], n[M - width - 1:]
+    w1 = 0.5 * (1 + jnp.cos(math.pi * (-1 + 2.0 * n1 / alpha / (M - 1))))
+    w2 = jnp.ones_like(n2)
+    w3 = 0.5 * (1 + jnp.cos(math.pi * (-2.0 / alpha + 1 + 2.0 * n3 / alpha / (M - 1))))
+    return _truncate(jnp.concatenate([w1, w2, w3]), trunc)
+
+
+_WINDOWS = {
+    "hann": _window_hann, "hamming": _window_hamming,
+    "blackman": _window_blackman, "bartlett": _window_bartlett,
+    "triang": _window_triang, "bohman": _window_bohman,
+    "cosine": _window_cosine, "gaussian": _window_gaussian,
+    "general_gaussian": _window_general_gaussian,
+    "exponential": _window_exponential, "tukey": _window_tukey,
+}
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """Return a window of `win_length` samples. `window` is a name or a
+    (name, *params) tuple; fftbins=True gives the periodic form."""
+    sym = not fftbins
+    if isinstance(window, (str,)):
+        name, args = window, ()
+    elif isinstance(window, tuple):
+        name, args = window[0], tuple(window[1:])
+    else:
+        raise ValueError(f"The window argument {window!r} is not supported.")
+    if name not in _WINDOWS:
+        raise ValueError(f"Unknown window type {name!r}.")
+    w = _WINDOWS[name](win_length, *args, sym=sym)
+    return Tensor(w.astype(dtype))

@@ -1,0 +1,166 @@
+"""Discrete Fourier transforms (ref: python/paddle/fft.py).
+
+TPU-native: every transform lowers to XLA's FFT HLO via jnp.fft (single fused
+kernel per call, differentiable, jit-compatible). The Hermitian family is
+expressed through the conjugate/swapped-norm identities (hfftn == irfftn of
+the conjugate with the normalization direction swapped) rather than dedicated
+kernels — same math, fewer primitives.
+
+Norm conventions match the reference: "backward" (default), "ortho",
+"forward".
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dispatch import apply
+from .tensor_impl import as_tensor_data
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be forward, backward or ortho")
+    return norm
+
+
+def _swap_norm(norm):
+    """Invert the normalization direction (used by the Hermitian family)."""
+    return {"backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
+
+
+# -- standard complex transforms -------------------------------------------
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.fft(a, n=n, axis=axis, norm=norm), x)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ifft(a, n=n, axis=axis, norm=norm), x)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.fftn(a, s=s, axes=axes, norm=norm), x)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ifftn(a, s=s, axes=axes, norm=norm), x)
+
+
+# -- real input -------------------------------------------------------------
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.rfft(a, n=n, axis=axis, norm=norm), x)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.irfft(a, n=n, axis=axis, norm=norm), x)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.rfftn(a, s=s, axes=axes, norm=norm), x)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.irfftn(a, s=s, axes=axes, norm=norm), x)
+
+
+# -- Hermitian input (real spectrum) ---------------------------------------
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.hfft(a, n=n, axis=axis, norm=norm), x)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ihfft(a, n=n, axis=axis, norm=norm), x)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """n-D FFT of a Hermitian-symmetric signal (real output).
+
+    Identity: hfftn(x) == irfftn(conj(x)) with the norm direction swapped.
+    """
+    _check_norm(norm)
+    return apply(
+        lambda a: jnp.fft.irfftn(jnp.conj(a), s=s, axes=axes,
+                                 norm=_swap_norm(norm)), x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(
+        lambda a: jnp.conj(jnp.fft.rfftn(a, s=s, axes=axes,
+                                         norm=_swap_norm(norm))), x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+# -- helpers ----------------------------------------------------------------
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor_impl import Tensor
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor_impl import Tensor
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x)

@@ -1,0 +1,230 @@
+"""Graph learning primitives (ref: python/paddle/geometric/__init__.py,
+message_passing/send_recv.py, reindex.py, sampling/neighbors.py).
+
+TPU-native split of responsibilities:
+
+* message passing (`send_u_recv`, `send_ue_recv`, `send_uv`, `segment_*`)
+  lowers to XLA gather + segment-reduce (scatter-add/min/max), which TPU
+  executes as vectorized dynamic-update ops — jit/grad compatible when
+  `out_size`/`num_segments` is static.
+* structure ops with data-dependent output shapes (`reindex_graph`,
+  `sample_neighbors`) run host-side on numpy, mirroring how the reference
+  runs them as CPU preprocessing before the dense compute; XLA requires
+  static shapes so these belong on the host by design.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..dispatch import apply
+from ..tensor_impl import Tensor, as_tensor_data
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "reindex_graph", "reindex_heter_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]
+
+_MESSAGE_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+_sample_rng = None
+
+
+def _host_rng():
+    """Persistent host-side RNG for neighbor sampling: seeded from
+    `paddle.seed` when set, advances across calls so each sampling step
+    draws a fresh subgraph."""
+    global _sample_rng
+    if _sample_rng is None:
+        from ..framework.random import get_seed
+        s = get_seed()
+        _sample_rng = np.random.RandomState(s if s is not None else None)
+    return _sample_rng
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    return int(np.asarray(jax.device_get(ids)).max()) + 1 if ids.size else 0
+
+
+def _segment_reduce(data, ids, pool, num_segments):
+    if pool == "sum":
+        return jax.ops.segment_sum(data, ids, num_segments)
+    if pool == "mean":
+        tot = jax.ops.segment_sum(data, ids, num_segments)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype), ids,
+                                  num_segments)
+        return tot / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (data.ndim - 1))
+    if pool == "min":
+        out = jax.ops.segment_min(data, ids, num_segments)
+    elif pool == "max":
+        out = jax.ops.segment_max(data, ids, num_segments)
+    else:
+        raise ValueError(f"reduce_op should be sum/mean/min/max, got {pool}")
+    # empty segments come back as +/-inf identity; the reference zeros them
+    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],)), ids, num_segments)
+    mask = (cnt > 0).reshape((-1,) + (1,) * (data.ndim - 1))
+    return jnp.where(mask, out, jnp.zeros_like(out))
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = _num_segments(as_tensor_data(segment_ids), None)
+    return apply(lambda d, i: _segment_reduce(d, i, "sum", n), data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(as_tensor_data(segment_ids), None)
+    return apply(lambda d, i: _segment_reduce(d, i, "mean", n), data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _num_segments(as_tensor_data(segment_ids), None)
+    return apply(lambda d, i: _segment_reduce(d, i, "min", n), data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _num_segments(as_tensor_data(segment_ids), None)
+    return apply(lambda d, i: _segment_reduce(d, i, "max", n), data, segment_ids)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges and reduce at destinations."""
+    n = out_size if out_size is not None else as_tensor_data(x).shape[0]
+    return apply(
+        lambda xv, s, d: _segment_reduce(jnp.take(xv, s, axis=0), d,
+                                         reduce_op, int(n)),
+        x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
+                 out_size=None, name=None):
+    """Combine source-node features with edge features, then reduce."""
+    if message_op not in _MESSAGE_OPS:
+        raise ValueError(f"message_op should be add/sub/mul/div, got {message_op}")
+    n = out_size if out_size is not None else as_tensor_data(x).shape[0]
+    op = _MESSAGE_OPS[message_op]
+    return apply(
+        lambda xv, yv, s, d: _segment_reduce(op(jnp.take(xv, s, axis=0), yv),
+                                             d, reduce_op, int(n)),
+        x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from source and destination node features."""
+    if message_op not in _MESSAGE_OPS:
+        raise ValueError(f"message_op should be add/sub/mul/div, got {message_op}")
+    op = _MESSAGE_OPS[message_op]
+    return apply(
+        lambda xv, yv, s, d: op(jnp.take(xv, s, axis=0),
+                                jnp.take(yv, d, axis=0)),
+        x, y, src_index, dst_index)
+
+
+# -- host-side structure ops -------------------------------------------------
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact a sampled subgraph's node ids to a dense [0, n) range."""
+    xs = np.asarray(jax.device_get(as_tensor_data(x)))
+    nb = np.asarray(jax.device_get(as_tensor_data(neighbors)))
+    cnt = np.asarray(jax.device_get(as_tensor_data(count)))
+    order = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    for v in nb:
+        v = int(v)
+        if v not in order:
+            order[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.array([order[int(v)] for v in nb], np.int64)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.array(out_nodes, np.int64))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are per-edge-type lists."""
+    cat_nb = np.concatenate(
+        [np.asarray(jax.device_get(as_tensor_data(n))) for n in neighbors])
+    cat_cnt_parts = [np.asarray(jax.device_get(as_tensor_data(c)))
+                     for c in count]
+    src, dst, nodes = reindex_graph(x, Tensor(jnp.asarray(cat_nb)),
+                                    Tensor(jnp.asarray(np.concatenate(cat_cnt_parts))))
+    # dst must restart per edge type over the same seed nodes
+    xs = np.asarray(jax.device_get(as_tensor_data(x)))
+    dsts = [np.repeat(np.arange(len(xs), dtype=np.int64), c)
+            for c in cat_cnt_parts]
+    return src, Tensor(jnp.asarray(np.concatenate(dsts))), nodes
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniformly sample up to `sample_size` in-neighbors per seed node (CSC)."""
+    r = np.asarray(jax.device_get(as_tensor_data(row)))
+    cp = np.asarray(jax.device_get(as_tensor_data(colptr)))
+    seeds = np.asarray(jax.device_get(as_tensor_data(input_nodes)))
+    rng = _host_rng()
+    out_n, out_c, out_e = [], [], []
+    ev = (np.asarray(jax.device_get(as_tensor_data(eids)))
+          if eids is not None else None)
+    for node in seeds:
+        beg, end = int(cp[node]), int(cp[node + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            pick = beg + rng.choice(deg, sample_size, replace=False)
+        out_n.append(r[pick])
+        out_c.append(len(pick))
+        if ev is not None:
+            out_e.append(ev[pick])
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n) if out_n else
+                                   np.zeros((0,), r.dtype)))
+    counts = Tensor(jnp.asarray(np.array(out_c, np.int64)))
+    if return_eids:
+        e = Tensor(jnp.asarray(np.concatenate(out_e) if out_e else
+                               np.zeros((0,), np.int64)))
+        return neighbors, counts, e
+    return neighbors, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted (probability ∝ edge weight) neighbor sampling."""
+    r = np.asarray(jax.device_get(as_tensor_data(row)))
+    cp = np.asarray(jax.device_get(as_tensor_data(colptr)))
+    w = np.asarray(jax.device_get(as_tensor_data(edge_weight)), np.float64)
+    seeds = np.asarray(jax.device_get(as_tensor_data(input_nodes)))
+    rng = _host_rng()
+    out_n, out_c, out_e = [], [], []
+    ev = (np.asarray(jax.device_get(as_tensor_data(eids)))
+          if eids is not None else None)
+    for node in seeds:
+        beg, end = int(cp[node]), int(cp[node + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            p = w[beg:end] / w[beg:end].sum()
+            pick = beg + rng.choice(deg, sample_size, replace=False, p=p)
+        out_n.append(r[pick])
+        out_c.append(len(pick))
+        if ev is not None:
+            out_e.append(ev[pick])
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n) if out_n else
+                                   np.zeros((0,), r.dtype)))
+    counts = Tensor(jnp.asarray(np.array(out_c, np.int64)))
+    if return_eids:
+        e = Tensor(jnp.asarray(np.concatenate(out_e) if out_e else
+                               np.zeros((0,), np.int64)))
+        return neighbors, counts, e
+    return neighbors, counts

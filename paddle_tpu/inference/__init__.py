@@ -45,7 +45,7 @@ def _spec_to_sds(spec, scope):
             symbolic = True
         else:
             shape.append(int(d))
-    dtype = np.dtype(spec.dtype) if not isinstance(spec.dtype, str) else np.dtype(spec.dtype)
+    dtype = np.dtype(spec.dtype)
     if symbolic:
         dims = jexport.symbolic_shape(
             "(" + ", ".join(str(s) for s in shape) + ")", scope=scope)
@@ -164,7 +164,11 @@ class Predictor:
                 pred._inputs[idx] = np.asarray(arr)
 
             def copy_to_cpu(self):
-                return pred._outputs[idx]
+                if pred._inputs[idx] is None:
+                    raise RuntimeError(
+                        f"input handle {name!r} has no data; call "
+                        f"copy_from_cpu first")
+                return pred._inputs[idx]
 
         return _Handle()
 

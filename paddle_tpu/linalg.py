@@ -1,0 +1,16 @@
+"""`paddle.linalg` namespace (ref: python/paddle/linalg.py — a re-export of
+tensor.linalg)."""
+from .tensor.linalg import (  # noqa: F401
+    cholesky, norm, cond, cov, corrcoef, inv, eig, eigvals, multi_dot,
+    matrix_rank, svd, svdvals, qr, lu, lu_unpack, matrix_power, matrix_exp,
+    det, slogdet, eigh, eigvalsh, pinv, solve, cholesky_solve,
+    triangular_solve, lstsq, householder_product, vector_norm, matrix_norm,
+)
+
+__all__ = [
+    "cholesky", "norm", "cond", "cov", "corrcoef", "inv", "eig", "eigvals",
+    "multi_dot", "matrix_rank", "svd", "qr", "lu", "lu_unpack",
+    "matrix_power", "det", "slogdet", "eigh", "eigvalsh", "pinv", "solve",
+    "cholesky_solve", "triangular_solve", "lstsq", "svdvals", "matrix_exp",
+    "householder_product", "vector_norm", "matrix_norm",
+]

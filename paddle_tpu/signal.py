@@ -1,0 +1,172 @@
+"""Signal processing: frame, overlap_add, stft, istft
+(ref: python/paddle/signal.py).
+
+TPU-native design: framing is a static gather (indices computed at trace
+time, so the whole STFT — pad → frame → window → rfft — fuses into one XLA
+program with an MXU-friendly batched FFT); overlap-add is its transpose, a
+scatter-add. Everything is jit/grad compatible with static shapes.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dispatch import apply
+from .tensor_impl import as_tensor_data
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_idx(seq_len, frame_length, hop_length, axis):
+    if frame_length > seq_len:
+        raise ValueError(
+            f"Attribute frame_length should be less equal than sequence length, "
+            f"but got ({frame_length}) > ({seq_len}).")
+    n_frames = 1 + (seq_len - frame_length) // hop_length
+    offsets = jnp.arange(n_frames) * hop_length
+    within = jnp.arange(frame_length)
+    if axis == -1 or axis is None:
+        # output (..., frame_length, n_frames)
+        return within[:, None] + offsets[None, :]
+    # axis == 0: output (n_frames, frame_length, ...)
+    return offsets[:, None] + within[None, :]
+
+
+def _frame_data(a, frame_length, hop_length, axis):
+    if axis in (-1, a.ndim - 1):
+        idx = _frame_idx(a.shape[-1], frame_length, hop_length, -1)
+        return a[..., idx]
+    elif axis == 0:
+        idx = _frame_idx(a.shape[0], frame_length, hop_length, 0)
+        return a[idx]
+    raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice input into overlapping frames.
+
+    axis=-1: (..., seq_len) -> (..., frame_length, num_frames)
+    axis=0:  (seq_len, ...) -> (num_frames, frame_length, ...)
+    """
+    if hop_length < 1:
+        raise ValueError(f"Unexpected hop_length: {hop_length}. It should be an "
+                         f"positive integer.")
+    return apply(_frame_data, x, frame_length=frame_length,
+                 hop_length=hop_length, axis=axis)
+
+
+def _overlap_add_data(a, hop_length, axis):
+    if axis in (-1, a.ndim - 1):
+        frame_length, n_frames = a.shape[-2], a.shape[-1]
+        seq = (n_frames - 1) * hop_length + frame_length
+        pos = _frame_idx(seq, frame_length, hop_length, -1)  # (flen, nf)
+        out = jnp.zeros(a.shape[:-2] + (seq,), a.dtype)
+        return out.at[..., pos].add(a)
+    elif axis == 0:
+        n_frames, frame_length = a.shape[0], a.shape[1]
+        seq = (n_frames - 1) * hop_length + frame_length
+        pos = _frame_idx(seq, frame_length, hop_length, 0)  # (nf, flen)
+        out = jnp.zeros((seq,) + a.shape[2:], a.dtype)
+        return out.at[pos].add(a)
+    raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct a signal from overlapping frames (transpose of `frame`)."""
+    if hop_length < 1:
+        raise ValueError(f"Unexpected hop_length: {hop_length}. It should be an "
+                         f"positive integer.")
+    return apply(_overlap_add_data, x, hop_length=hop_length, axis=axis)
+
+
+def _resolve_window(window, win_length, n_fft, dtype):
+    if window is None:
+        w = jnp.ones((win_length,), dtype)
+    else:
+        w = jnp.asarray(as_tensor_data(window), dtype)
+        if w.ndim != 1 or w.shape[0] != win_length:
+            raise ValueError(
+                f"expected a 1D window tensor of size equal to win_length"
+                f"({win_length}), but got window with shape {w.shape}.")
+    if win_length < n_fft:  # center-pad window to n_fft
+        pad_l = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad_l, n_fft - win_length - pad_l))
+    return w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform.
+
+    Input (..., seq_len) real or complex; output (..., n_fft//2+1, num_frames)
+    when onesided else (..., n_fft, num_frames), complex.
+    """
+    hop_length = hop_length if hop_length is not None else n_fft // 4
+    win_length = win_length if win_length is not None else n_fft
+
+    def _stft(a, w):
+        if jnp.iscomplexobj(a):
+            one = False
+        else:
+            one = onesided
+        y = a
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (y.ndim - 1) + [(pad, pad)]
+            y = jnp.pad(y, cfg, mode=pad_mode)
+        frames = _frame_data(y, n_fft, hop_length, -1)  # (..., n_fft, nf)
+        frames = frames * w[:, None].astype(frames.dtype)
+        if one:
+            spec = jnp.fft.rfft(frames, axis=-2)
+        else:
+            spec = jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            spec = spec * (float(n_fft) ** -0.5)
+        return spec
+
+    a = as_tensor_data(x)
+    w = _resolve_window(window, win_length, n_fft,
+                        jnp.real(jnp.zeros((), a.dtype)).dtype)
+    if jnp.iscomplexobj(a) and onesided:
+        raise ValueError("onesided is not supported for complex input")
+    return apply(_stft, x, w)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with least-squares window compensation."""
+    hop_length = hop_length if hop_length is not None else n_fft // 4
+    win_length = win_length if win_length is not None else n_fft
+
+    def _istft(spec, w):
+        n_frames = spec.shape[-1]
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-2)
+            if not return_complex:
+                frames = jnp.real(frames)
+        if normalized:
+            frames = frames * (float(n_fft) ** 0.5)
+        wf = w.astype(frames.real.dtype)
+        frames = frames * wf[:, None]
+        sig = _overlap_add_data(frames, hop_length, -1)
+        # window envelope for least-squares inversion
+        env = _overlap_add_data(
+            jnp.broadcast_to((wf * wf)[:, None], (n_fft, n_frames)),
+            hop_length, -1)
+        sig = sig / jnp.where(env > 1e-11, env, 1.0)
+        expected = n_fft + hop_length * (n_frames - 1)
+        start = n_fft // 2 if center else 0
+        if length is not None:
+            end = start + length
+        else:
+            end = expected - (n_fft // 2 if center else 0)
+        return sig[..., start:end]
+
+    a = as_tensor_data(x)
+    if not jnp.iscomplexobj(a):
+        raise ValueError("istft expects a complex spectrum input")
+    w = _resolve_window(window, win_length, n_fft, jnp.float64
+                        if a.dtype == jnp.complex128 else jnp.float32)
+    return apply(_istft, x, w)

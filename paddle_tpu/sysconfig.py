@@ -1,0 +1,14 @@
+"""Build/system configuration (ref: python/paddle/sysconfig.py)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing the native extension headers."""
+    return os.path.join(os.path.dirname(__file__), os.pardir, "native")
+
+
+def get_lib():
+    """Directory containing the compiled native runtime library."""
+    return os.path.join(os.path.dirname(__file__), os.pardir, "native", "build")

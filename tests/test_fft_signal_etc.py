@@ -1,0 +1,261 @@
+"""fft / signal / geometric / text / audio / linalg-extras parity tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class TestFFT:
+    def test_fft_ifft_roundtrip(self):
+        x = np.random.RandomState(0).randn(4, 32).astype(np.float32)
+        out = _np(paddle.fft.fft(paddle.to_tensor(x)))
+        np.testing.assert_allclose(out, np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        back = _np(paddle.fft.ifft(paddle.to_tensor(out)))
+        np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_rfft_norms(self, norm):
+        x = np.random.RandomState(1).randn(3, 16).astype(np.float64)
+        out = _np(paddle.fft.rfft(paddle.to_tensor(x), norm=norm))
+        np.testing.assert_allclose(out, np.fft.rfft(x, norm=norm), rtol=1e-10)
+
+    def test_fft2_fftn(self):
+        x = np.random.RandomState(2).randn(2, 8, 8)
+        np.testing.assert_allclose(_np(paddle.fft.fft2(paddle.to_tensor(x))),
+                                   np.fft.fft2(x), rtol=1e-8, atol=1e-8)
+        np.testing.assert_allclose(_np(paddle.fft.ifftn(paddle.to_tensor(x))),
+                                   np.fft.ifftn(x), rtol=1e-8, atol=1e-8)
+
+    def test_hermitian_family_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(3).randn(4, 9) + 1j * np.random.RandomState(4).randn(4, 9)
+        for norm in ["backward", "ortho", "forward"]:
+            ours = _np(paddle.fft.hfft2(paddle.to_tensor(x), norm=norm))
+            ref = torch.fft.hfft2(torch.tensor(x), norm=norm).numpy()
+            np.testing.assert_allclose(ours, ref, rtol=1e-8, atol=1e-8)
+        r = np.random.RandomState(5).randn(4, 8)
+        ours = _np(paddle.fft.ihfft2(paddle.to_tensor(r)))
+        ref = torch.fft.ihfft2(torch.tensor(r)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-8, atol=1e-8)
+
+    def test_freq_shift_helpers(self):
+        np.testing.assert_allclose(_np(paddle.fft.fftfreq(8, d=0.5)),
+                                   np.fft.fftfreq(8, d=0.5))
+        np.testing.assert_allclose(_np(paddle.fft.rfftfreq(8)), np.fft.rfftfreq(8))
+        x = np.arange(10.0)
+        np.testing.assert_allclose(_np(paddle.fft.fftshift(paddle.to_tensor(x))),
+                                   np.fft.fftshift(x))
+        np.testing.assert_allclose(_np(paddle.fft.ifftshift(paddle.to_tensor(x))),
+                                   np.fft.ifftshift(x))
+
+    def test_fft_grad(self):
+        x = paddle.to_tensor(np.random.RandomState(6).randn(8).astype(np.float32))
+        x.stop_gradient = False
+        y = paddle.fft.rfft(x)
+        loss = (y.real() ** 2 + y.imag() ** 2).sum() if hasattr(y, "real") else None
+        if loss is None:
+            pytest.skip("complex helpers absent")
+        loss.backward()
+        assert x.grad is not None and np.isfinite(_np(x.grad)).all()
+
+
+class TestSignal:
+    def test_frame_axis_last(self):
+        x = np.arange(10.0, dtype=np.float32)
+        out = _np(paddle.signal.frame(paddle.to_tensor(x), 4, 2))
+        assert out.shape == (4, 4)  # (frame_length, num_frames)
+        np.testing.assert_allclose(out[:, 0], x[0:4])
+        np.testing.assert_allclose(out[:, 1], x[2:6])
+
+    def test_frame_axis0_and_batch(self):
+        x = np.random.RandomState(0).randn(12, 3).astype(np.float32)
+        out = _np(paddle.signal.frame(paddle.to_tensor(x), 5, 3, axis=0))
+        assert out.shape == (3, 5, 3)
+        np.testing.assert_allclose(out[1], x[3:8])
+
+    def test_overlap_add_inverts_frame_nonoverlap(self):
+        x = np.random.RandomState(1).randn(2, 12).astype(np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), 4, 4)
+        back = _np(paddle.signal.overlap_add(f, 4))
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_stft_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(2).randn(2, 256).astype(np.float64)
+        win = np.hanning(64).astype(np.float64)  # sym window, len == n_fft
+        ours = _np(paddle.signal.stft(paddle.to_tensor(x), n_fft=64,
+                                      hop_length=16,
+                                      window=paddle.to_tensor(win)))
+        ref = torch.stft(torch.tensor(x), n_fft=64, hop_length=16,
+                         window=torch.tensor(win), center=True,
+                         pad_mode="reflect", onesided=True,
+                         return_complex=True).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-8, atol=1e-8)
+
+    def test_istft_roundtrip(self):
+        x = np.random.RandomState(3).randn(2, 400).astype(np.float64)
+        win = (np.hanning(129)[:-1]).astype(np.float64)  # periodic hann, COLA
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=128, hop_length=32,
+                                  window=paddle.to_tensor(win))
+        back = _np(paddle.signal.istft(spec, n_fft=128, hop_length=32,
+                                       window=paddle.to_tensor(win),
+                                       length=400))
+        np.testing.assert_allclose(back, x, rtol=1e-6, atol=1e-8)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]],
+                                         np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1]))
+        np.testing.assert_allclose(_np(paddle.geometric.segment_sum(data, ids)),
+                                   [[4., 6.], [12., 14.]])
+        np.testing.assert_allclose(_np(paddle.geometric.segment_mean(data, ids)),
+                                   [[2., 3.], [6., 7.]])
+        np.testing.assert_allclose(_np(paddle.geometric.segment_min(data, ids)),
+                                   [[1., 2.], [5., 6.]])
+        np.testing.assert_allclose(_np(paddle.geometric.segment_max(data, ids)),
+                                   [[3., 4.], [7., 8.]])
+
+    def test_send_u_recv(self):
+        x = paddle.to_tensor(np.array([[0., 2., 3.], [1., 4., 5.], [2., 6., 7.]],
+                                      np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+        out = _np(paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum"))
+        np.testing.assert_allclose(out, [[0., 2., 3.], [2., 8., 10.], [1., 4., 5.]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.array([[1., 1.], [2., 2.]], np.float32))
+        e = paddle.to_tensor(np.array([[1., 0.], [0., 1.], [1., 1.]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 1]))
+        dst = paddle.to_tensor(np.array([1, 0, 0]))
+        out = _np(paddle.geometric.send_ue_recv(x, e, src, dst, "add", "sum"))
+        np.testing.assert_allclose(out, [[5., 6.], [2., 1.]])
+        uv = _np(paddle.geometric.send_uv(x, x, src, dst, "mul"))
+        np.testing.assert_allclose(uv, [[2., 2.], [2., 2.], [2., 2.]])
+
+    def test_reindex_and_sample(self):
+        x = paddle.to_tensor(np.array([0, 5, 9]))
+        neighbors = paddle.to_tensor(np.array([5, 9, 7, 0]))
+        count = paddle.to_tensor(np.array([2, 1, 1]))
+        src, dst, nodes = paddle.geometric.reindex_graph(x, neighbors, count)
+        np.testing.assert_array_equal(_np(nodes), [0, 5, 9, 7])
+        np.testing.assert_array_equal(_np(src), [1, 2, 3, 0])
+        np.testing.assert_array_equal(_np(dst), [0, 0, 1, 2])
+        # CSC graph: col j has rows colptr[j]:colptr[j+1]
+        row = paddle.to_tensor(np.array([1, 2, 0, 2, 0, 1]))
+        colptr = paddle.to_tensor(np.array([0, 2, 4, 6]))
+        nb, cnt = paddle.geometric.sample_neighbors(row, colptr,
+                                                    paddle.to_tensor(np.array([0, 2])),
+                                                    sample_size=1)
+        assert _np(cnt).tolist() == [1, 1]
+        assert len(_np(nb)) == 2
+
+
+class TestText:
+    def test_viterbi_matches_bruteforce(self):
+        rs = np.random.RandomState(0)
+        B, L, C = 3, 5, 4
+        pot = rs.rand(B, L, C).astype(np.float32)
+        trans = rs.rand(C, C).astype(np.float32)
+        lens = np.array([5, 3, 1], np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        scores, paths = _np(scores), _np(paths)
+        import itertools
+        for b in range(B):
+            n = lens[b]
+            best, best_path = -1e9, None
+            for assign in itertools.product(range(C), repeat=int(n)):
+                s = pot[b, 0, assign[0]]
+                for t in range(1, n):
+                    s += trans[assign[t - 1], assign[t]] + pot[b, t, assign[t]]
+                if s > best:
+                    best, best_path = s, assign
+            np.testing.assert_allclose(scores[b], best, rtol=1e-5)
+            np.testing.assert_array_equal(paths[b, :n], best_path)
+
+    def test_viterbi_bos_eos(self):
+        rs = np.random.RandomState(1)
+        pot = rs.rand(2, 4, 5).astype(np.float32)
+        trans = rs.rand(5, 5).astype(np.float32)
+        lens = np.array([4, 2], np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=True)
+        assert _np(scores).shape == (2,) and _np(paths).shape == (2, 4)
+        assert np.isfinite(_np(scores)).all()
+
+    def test_datasets_shapes(self):
+        ds = paddle.text.Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        uci = paddle.text.UCIHousing(mode="test")
+        x, y = uci[3]
+        assert x.shape == (13,) and y.shape == (1,)
+        wmt = paddle.text.WMT16(mode="train")
+        src, trg, nxt = wmt[5]
+        assert trg[0] == 0 and nxt[-1] == 1 and len(trg) == len(nxt)
+
+
+class TestAudio:
+    def test_mel_conversions(self):
+        f = paddle.audio.functional.hz_to_mel(440.0)
+        back = paddle.audio.functional.mel_to_hz(f)
+        assert abs(back - 440.0) < 1e-6
+        t = paddle.audio.functional.hz_to_mel(paddle.to_tensor(np.array([440.0])),
+                                              htk=True)
+        np.testing.assert_allclose(_np(t), 2595.0 * np.log10(1 + 440.0 / 700.0),
+                                   rtol=1e-6)
+
+    def test_windows_vs_numpy(self):
+        w = _np(paddle.audio.functional.get_window("hann", 16, fftbins=False))
+        np.testing.assert_allclose(w, np.hanning(16), atol=1e-12)
+        w = _np(paddle.audio.functional.get_window("hamming", 17, fftbins=False))
+        np.testing.assert_allclose(w, np.hamming(17), atol=1e-12)
+        w = _np(paddle.audio.functional.get_window("blackman", 16, fftbins=False))
+        np.testing.assert_allclose(w, np.blackman(16), atol=1e-12)
+
+    def test_fbank_and_dct_shapes(self):
+        fb = _np(paddle.audio.functional.compute_fbank_matrix(16000, 512,
+                                                              n_mels=40))
+        assert fb.shape == (40, 257) and (fb >= 0).all()
+        dct = _np(paddle.audio.functional.create_dct(13, 40))
+        assert dct.shape == (40, 13)
+
+    def test_feature_layers(self):
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 4000).astype(np.float32))
+        spec = paddle.audio.features.Spectrogram(n_fft=256, hop_length=128)(x)
+        assert _np(spec).shape[1] == 129
+        mel = paddle.audio.features.MelSpectrogram(sr=8000, n_fft=256,
+                                                   hop_length=128, n_mels=32)(x)
+        assert _np(mel).shape[1] == 32
+        mfcc = paddle.audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=256,
+                                          hop_length=128, n_mels=32)(x)
+        assert _np(mfcc).shape[1] == 13
+        assert np.isfinite(_np(mfcc)).all()
+
+    def test_datasets(self):
+        ds = paddle.audio.datasets.TESS(mode="dev", feat_type="raw")
+        wav, label = ds[0]
+        assert wav.shape == (16000,) and 0 <= label < 7
+
+
+class TestLinalgExtras:
+    def test_lu_unpack(self):
+        a = np.random.RandomState(0).randn(5, 5)
+        lu_mat, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.linalg.lu_unpack(lu_mat, piv)
+        rec = _np(P) @ _np(L) @ _np(U)
+        np.testing.assert_allclose(rec, a, rtol=1e-8, atol=1e-8)
+
+    def test_top_level_linalg_namespace(self):
+        for name in ["cholesky", "svd", "qr", "det", "solve", "pinv", "lstsq"]:
+            assert hasattr(paddle.linalg, name)
