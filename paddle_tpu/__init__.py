@@ -75,6 +75,20 @@ from .hapi import callbacks  # noqa: E402,F401
 from .framework.device import (  # noqa: E402,F401
     set_device, get_device, is_compiled_with_cuda,
 )
+from .framework.extras import (  # noqa: E402,F401
+    get_rng_state, set_rng_state, get_cuda_rng_state, set_cuda_rng_state,
+    set_printoptions, disable_signal_handler, LazyGuard, DataParallel,
+    create_parameter, flops, batch, check_shape,
+)
+from .nn import ParamAttr  # noqa: E402,F401
+
+# `paddle.dtype` is the dtype type itself (VarType analog)
+dtype = _jnp.dtype
+
+
+class CUDAPinnedPlace:  # host-staging place: meaningless on TPU, API parity
+    def __repr__(self):
+        return "CUDAPinnedPlace"
 
 
 def disable_static(place=None):

@@ -153,3 +153,14 @@ def white_list():
 
 def black_list():
     return {"float16": {"O1": sorted(BLACK_OPS)}, "bfloat16": {"O1": sorted(BLACK_OPS)}}
+
+
+def is_float16_supported(device=None):
+    """fp16 compute is supported on every XLA backend; on TPU bf16 is the
+    preferred half type (MXU-native)."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    import jax
+    return jax.default_backend() in ("tpu", "cpu")

@@ -201,3 +201,19 @@ class Bernoulli(Distribution):
 def kl_divergence(p, q):
     """Dispatch KL(p||q) (ref distribution/kl.py)."""
     return p.kl_divergence(q)
+
+
+from .extras import (  # noqa: E402,F401
+    Beta, Cauchy, Dirichlet, ExponentialFamily, Multinomial, Independent,
+    TransformedDistribution, Laplace, LogNormal, Gumbel, Geometric,
+    register_kl, dispatch_kl as _dispatch_kl,
+)
+
+
+def kl_divergence(p, q):  # noqa: F811 — registry-aware override
+    """Dispatch KL(p||q): registered pairs first (`register_kl`), then the
+    distribution's own closed form (ref distribution/kl.py)."""
+    out = _dispatch_kl(p, q)
+    if out is not None:
+        return out
+    return p.kl_divergence(q)

@@ -79,3 +79,120 @@ def is_compiled_with_cuda() -> bool:  # API parity; we are a TPU framework
 
 def is_compiled_with_tpu() -> bool:
     return True
+
+
+class XPUPlace(Place):
+    def __init__(self, *a):
+        raise NotImplementedError("XPU is out of scope on the TPU build")
+
+
+class IPUPlace(Place):
+    def __init__(self, *a):
+        raise NotImplementedError("IPU is out of scope on the TPU build")
+
+
+def get_cudnn_version():
+    return None  # no cuDNN in an XLA/TPU stack
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False  # XLA plays CINN's role
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+def get_all_device_type():
+    return ["cpu", "tpu"]
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+class Stream:
+    """XLA orders work internally; streams surface as no-op handles
+    (ref: device/cuda/streams.py)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    _current_stream = stream
+    return stream
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def stream_guard(stream):
+    old = current_stream()
+    set_stream(stream)
+    try:
+        yield
+    finally:
+        set_stream(old)
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes."""
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()

@@ -1,0 +1,185 @@
+"""Framework-level long-tail APIs (ref: python/paddle/framework/__init__.py,
+python/paddle/fluid/framework.py): RNG state, print options, LazyGuard,
+DataParallel, create_parameter, flops, batch reader."""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import random as _random
+from ..tensor_impl import Tensor, Parameter, as_tensor_data
+
+__all__ = [
+    "get_rng_state", "set_rng_state", "get_cuda_rng_state",
+    "set_cuda_rng_state", "set_printoptions", "disable_signal_handler",
+    "LazyGuard", "DataParallel", "create_parameter", "flops", "batch",
+    "check_shape",
+]
+
+
+def get_rng_state(device=None):
+    """The global PRNG key (TPU-native analog of the generator state list)."""
+    return [_random._rng.key]
+
+
+def set_rng_state(state_list, device=None):
+    key = state_list[0] if isinstance(state_list, (list, tuple)) else state_list
+    _random._rng.key = key
+
+
+# single accelerator namespace on TPU: the "cuda" generator IS the generator
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr printing options (backed by numpy's printoptions)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op: jax/XLA installs no competing signal handlers (the reference
+    needed this for its C++ runtime's SIGSEGV hooks)."""
+
+
+class LazyGuard:
+    """Parity shim for lazy (deferred) parameter init. Our initializers
+    already run at first trace on-device, so materialization is inherently
+    lazy with respect to host memory; the guard is a no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class DataParallel:
+    """ref: paddle.DataParallel. Under single-controller SPMD, data
+    parallelism is a mesh axis (GSPMD shards the batch), so this wrapper only
+    needs to preserve the reference's interface: attribute passthrough,
+    `scale_loss`/`no_sync` semantics."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss  # XLA's psum-of-mean handles scaling inside the step
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """ref: paddle.create_parameter (static/layer_helper path)."""
+    from ..nn import initializer as I
+    if default_initializer is None:
+        default_initializer = I.Constant(0.0) if is_bias else I.XavierNormal()
+    data = default_initializer(tuple(int(s) for s in shape), dtype)
+    return Parameter(as_tensor_data(data) if not isinstance(data, jnp.ndarray)
+                     else data, name=name)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """ref: paddle.batch — wrap an item-reader into a batch-reader."""
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def check_shape(shape):
+    """Validate a shape argument (ref: fluid check_shape utility)."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if not isinstance(s, (int, np.integer)) and s is not None:
+                raise TypeError(f"shape entries must be int, got {type(s)}")
+    return True
+
+
+_CONV_CLASSES = ("Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose",
+                 "Conv2DTranspose", "Conv3DTranspose")
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Static FLOPs estimate by layer walk (ref: paddle.flops /
+    hapi/dynamic_flops.py): counts multiply-adds of conv/linear plus norm and
+    activation elementwise costs from a tracing forward."""
+    import paddle_tpu as paddle
+
+    total = [0]
+    hooks = []
+
+    def count(layer, inputs, output):
+        cls = type(layer).__name__
+        if custom_ops and type(layer) in custom_ops:
+            total[0] += int(custom_ops[type(layer)](layer, inputs, output))
+            return
+        x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+        out = output[0] if isinstance(output, (tuple, list)) else output
+        out_elems = int(np.prod(out.shape)) if hasattr(out, "shape") else 0
+        if cls == "Linear":
+            total[0] += 2 * out_elems * layer.weight.shape[0]
+        elif cls in _CONV_CLASSES:
+            w = layer.weight
+            kernel_elems = int(np.prod(w.shape[1:]))
+            total[0] += 2 * out_elems * kernel_elems
+        elif cls in ("BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "LayerNorm",
+                     "GroupNorm", "InstanceNorm2D"):
+            total[0] += 2 * out_elems
+        elif cls in ("ReLU", "GELU", "Sigmoid", "Tanh", "Softmax"):
+            total[0] += out_elems
+
+    for sub in net.sublayers(include_self=True):
+        hooks.append(sub.register_forward_post_hook(count))
+    try:
+        x = paddle.zeros(list(input_size), "float32")
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]}")
+    return total[0]

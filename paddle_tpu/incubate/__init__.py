@@ -1,5 +1,13 @@
 """paddle_tpu.incubate — experimental subsystems (ref: python/paddle/incubate).
 
-Currently: step-tagged async checkpointing (``incubate.checkpoint``).
+- ``checkpoint``: step-tagged async checkpointing
+- ``nn``: fused transformer/MoE blocks + ``nn.functional`` fused op surface
+  (XLA compiler fusion; pallas flash attention on TPU)
+- ``autograd``: functional jvp/vjp/Jacobian/Hessian (jax transforms)
+- ``optimizer``: LookAhead, ModelAverage wrappers
 """
 from . import checkpoint  # noqa: F401
+from . import nn  # noqa: F401
+from . import autograd  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,65 @@
+"""Functional autodiff (ref: python/paddle/incubate/autograd/__init__.py).
+
+jvp/vjp/Jacobian/Hessian map directly onto jax transforms — forward-mode is
+native here (the reference needed a primitive-rewrite pass, enable_prim).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..autograd import jvp, vjp, jacobian as _jacobian, hessian as _hessian
+from ..tensor_impl import Tensor, as_tensor_data
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim", "disable_prim",
+           "forward_grad", "grad"]
+
+
+class Jacobian:
+    """Lazy Jacobian J[func](xs) with [i, j] indexing (ref: functional.py)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._mat = _jacobian(func, xs)
+        self.is_batched = is_batched
+
+    def __getitem__(self, idx):
+        return self._mat[idx]
+
+    @property
+    def shape(self):
+        return self._mat.shape
+
+    def numpy(self):
+        return self._mat.numpy() if isinstance(self._mat, Tensor) else self._mat
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        self._mat = _hessian(func, xs)
+        self.is_batched = is_batched
+
+    def __getitem__(self, idx):
+        return self._mat[idx]
+
+    @property
+    def shape(self):
+        return self._mat.shape
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode gradient: jax.jvp is the primitive here."""
+    return jvp(func, xs, v)
+
+
+def grad(func, xs, v=None):
+    """Reverse-mode gradient of a scalar-output function."""
+    _, pullback = vjp(func, xs)
+    return pullback if v is None else pullback
+
+
+def enable_prim():
+    """The reference lowers to primitive ops for higher-order AD; jax traces
+    primitives natively, so this is a no-op kept for API parity."""
+
+
+def disable_prim():
+    """No-op (see enable_prim)."""

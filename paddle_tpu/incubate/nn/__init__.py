@@ -1,0 +1,6 @@
+"""Incubating nn APIs (ref: python/paddle/incubate/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+    FusedEcMoe,
+)

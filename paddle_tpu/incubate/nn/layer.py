@@ -1,0 +1,129 @@
+"""Fused transformer layers (ref: python/paddle/incubate/nn/layer/
+fused_transformer.py, fused_ec_moe.py) — Layer wrappers over the
+compiler-fused functional ops."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn import Layer
+from ...nn.initializer import XavierUniform, Constant
+from ...tensor_impl import Parameter
+from . import functional as F
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None, normalize_before=False,
+                 need_weights=False, qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, self.head_dim, embed_dim), default_initializer=XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            (3, num_heads, self.head_dim), is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), default_initializer=XavierUniform())
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), is_bias=True, default_initializer=Constant(0.0))
+        self.pre_ln_scale = self.create_parameter(
+            (embed_dim,), default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            (embed_dim,), is_bias=True, default_initializer=Constant(0.0))
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        return F.fused_multi_head_attention(
+            query, self.qkv_weight, self.qkv_bias, self.linear_weight,
+            self.linear_bias, pre_layer_norm=self.normalize_before,
+            ln_scale=self.pre_ln_scale, ln_bias=self.pre_ln_bias,
+            ln_epsilon=self.epsilon, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), default_initializer=XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            (dim_feedforward,), is_bias=True, default_initializer=Constant(0.0))
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), default_initializer=XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            (d_model,), is_bias=True, default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            (d_model,), default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (d_model,), is_bias=True, default_initializer=Constant(0.0))
+
+    def forward(self, src, cache=None):
+        kw = (dict(ln1_scale=self.ln_scale, ln1_bias=self.ln_bias)
+              if self.normalize_before
+              else dict(ln2_scale=self.ln_scale, ln2_bias=self.ln_bias))
+        return F.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight, self.linear1_bias,
+            self.linear2_bias, activation=self.activation,
+            pre_layer_norm=self.normalize_before, training=self.training,
+            ln1_epsilon=self.epsilon, ln2_epsilon=self.epsilon, **kw)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.self_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.self_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedEcMoe(Layer):
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.act_type = act_type
+        self.gate_weight = self.create_parameter(
+            (hidden_size, num_experts), default_initializer=XavierUniform())
+        self.bmm_weight0 = self.create_parameter(
+            (num_experts, hidden_size, inter_size),
+            default_initializer=XavierUniform())
+        self.bmm_bias0 = self.create_parameter(
+            (num_experts, inter_size), is_bias=True,
+            default_initializer=Constant(0.0))
+        self.bmm_weight1 = self.create_parameter(
+            (num_experts, inter_size, hidden_size),
+            default_initializer=XavierUniform())
+        self.bmm_bias1 = self.create_parameter(
+            (num_experts, hidden_size), is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x, gate=None):
+        return F.fused_ec_moe(x, self.gate_weight, self.bmm_weight0,
+                              self.bmm_bias0, self.bmm_weight1, self.bmm_bias1,
+                              act_type=self.act_type)

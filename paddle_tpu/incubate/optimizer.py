@@ -1,0 +1,92 @@
+"""Incubating optimizers (ref: python/paddle/incubate/optimizer/lookahead.py,
+modelaverage.py): wrappers that keep slow/averaged copies of the fast
+optimizer's parameters."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+from ..tensor_impl import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """k fast steps, then slow weights interpolate toward fast weights:
+    slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        super().__init__(learning_rate=inner_optimizer._learning_rate,
+                         parameters=inner_optimizer._parameter_list)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._slow = {}
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k != 0:
+            return
+        for p in self._parameter_list or []:
+            key = id(p)
+            slow = self._slow.get(key)
+            if slow is None:
+                slow = p._data
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[key] = slow
+            p._data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_count
+        return sd
+
+
+class ModelAverage(Optimizer):
+    """Maintains a running average of parameters; `apply()` swaps it in for
+    evaluation, `restore()` swaps the live weights back."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        super().__init__(parameters=list(parameters) if parameters else [])
+        self.avg_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._sum = {id(p): jnp.zeros_like(p._data) for p in self._parameter_list}
+        self._cnt = 0
+        self._backup = None
+
+    def step(self):
+        for p in self._parameter_list:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._cnt = min(self._cnt + 1, self.max_average_window)
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._parameter_list}
+        for p in self._parameter_list:
+            if self._cnt:
+                p._data = (self._sum[id(p)] / self._cnt).astype(p._data.dtype)
+        if not need_restore:
+            self._backup = None
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameter_list:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()

@@ -11,3 +11,29 @@ from .train_step import TrainStep  # noqa: F401
 
 def enable_to_static(flag=True):
     pass
+
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """ref: jit.set_verbosity — controls dy2static logging; here it toggles
+    jax jit logging verbosity."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """ref: jit.set_code_level — the reference prints transformed AST; our
+    analog is the traced HLO, available via to_static(...).get_concrete_program."""
+    global _code_level
+    _code_level = int(level)
+
+
+def not_to_static(fn=None):
+    """Mark a function to stay eager inside to_static regions."""
+    if fn is None:
+        return not_to_static
+    fn._not_to_static = True
+    return fn

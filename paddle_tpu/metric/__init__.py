@@ -139,3 +139,17 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (ref: python/paddle/metric/metrics.py
+    accuracy)."""
+    import jax.numpy as jnp
+    from ..tensor_impl import as_tensor_data, wrap
+    logits = as_tensor_data(input)
+    lab = as_tensor_data(label)
+    if lab.ndim == logits.ndim:
+        lab = lab.reshape(lab.shape[:-1])
+    topk = jnp.argsort(-logits, axis=-1)[..., :k]
+    hit = jnp.any(topk == lab[..., None], axis=-1)
+    return wrap(jnp.mean(hit.astype(jnp.float32)))

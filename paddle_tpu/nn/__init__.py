@@ -1,6 +1,12 @@
 """paddle_tpu.nn (ref: python/paddle/nn/__init__.py)."""
 from .layer_base import Layer, ParamAttr  # noqa: F401
 from . import functional  # noqa: F401
+from .layer.extras import (  # noqa: F401
+    PoissonNLLLoss, Softmax2D, RNNTLoss, HSigmoidLoss, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D, MultiLabelSoftMarginLoss, MultiMarginLoss,
+    TripletMarginWithDistanceLoss, SoftMarginLoss, GaussianNLLLoss, Unflatten,
+    BeamSearchDecoder, dynamic_decode,
+)
 from . import initializer  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,

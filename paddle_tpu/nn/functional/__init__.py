@@ -10,6 +10,7 @@ from .norm import (  # noqa: F401
     local_response_norm, normalize,
 )
 from .loss import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention,
 )

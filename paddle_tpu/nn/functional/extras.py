@@ -1,0 +1,406 @@
+"""nn.functional long tail (ref: python/paddle/nn/functional/*): remaining
+losses, unpooling, decode utilities, temporal ops. All XLA compositions."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...dispatch import apply as _apply, apply_inplace
+from ...tensor_impl import Tensor, as_tensor_data
+from .loss import _reduce
+
+__all__ = [
+    "elu_", "log_sigmoid", "softmax_", "diag_embed", "sequence_mask",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d", "dice_loss",
+    "multi_label_soft_margin_loss", "poisson_nll_loss", "margin_cross_entropy",
+    "rnnt_loss", "gather_tree", "temporal_shift", "class_center_sample",
+    "sparse_attention", "triplet_margin_with_distance_loss",
+    "multi_margin_loss", "soft_margin_loss", "gaussian_nll_loss",
+    "hsigmoid_loss",
+]
+
+
+def elu_(x, alpha=1.0, name=None):
+    return apply_inplace(x, lambda a: jnp.where(a > 0, a,
+                                                alpha * jnp.expm1(a)), x)
+
+
+def log_sigmoid(x, name=None):
+    return _apply(lambda a: jax.nn.log_sigmoid(a), x, op_name="log_sigmoid")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(dtype)
+        return jax.nn.softmax(a, axis=axis)
+    return apply_inplace(x, f, x)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batch of diagonal matrices from the last dim of `input`."""
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        rows = jnp.arange(a.shape[-1]) + max(-offset, 0)
+        cols = jnp.arange(a.shape[-1]) + max(offset, 0)
+        out = base.at[..., rows, cols].set(a)
+        # place the constructed matrix axes at dim1/dim2
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+            order = sorted([(d1, nd - 2), (d2, nd - 1)])
+            for dst, src in order:
+                perm.insert(dst, src)
+            out = jnp.transpose(out, perm)
+        return out
+    return _apply(f, input)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    lens = as_tensor_data(x)
+    m = int(maxlen) if maxlen is not None else int(np.asarray(
+        jax.device_get(lens)).max())
+    return _apply(
+        lambda l: (jnp.arange(m) < l[..., None]).astype(dtype), x)
+
+
+def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size,
+                data_format):
+    """Scatter pooled values back to the positions recorded by max_pool's
+    argmax indices (flat per-channel spatial index, reference convention)."""
+    def f(a, idx):
+        spatial = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in output_size[-nd:])
+        else:
+            ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+                else [kernel_size] * nd
+            st = stride if isinstance(stride, (list, tuple)) else \
+                ([stride] * nd if stride is not None else ks)
+            pd = padding if isinstance(padding, (list, tuple)) else [padding] * nd
+            out_sp = tuple((spatial[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                           for i in range(nd))
+        N, C = a.shape[0], a.shape[1]
+        flat_len = int(np.prod(out_sp))
+        flat = jnp.zeros((N, C, flat_len), a.dtype)
+        av = a.reshape(N, C, -1)
+        iv = idx.reshape(N, C, -1).astype(jnp.int32)
+        out = jax.vmap(jax.vmap(lambda dest, vals, ii:
+                                dest.at[ii].set(vals)))(flat, av, iv)
+        return out.reshape((N, C) + out_sp)
+    return _apply(f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+# -- losses -----------------------------------------------------------------
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, l):
+        lab = jax.nn.one_hot(l.squeeze(-1).astype(jnp.int32), p.shape[-1],
+                             dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * lab, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(lab, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return _apply(f, input, label, op_name="cross_entropy")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    args = [weight] if weight is not None else []
+
+    def f(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    return _apply(f, input, label, *args, op_name="cross_entropy")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * math.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return _apply(f, input, label, op_name="cross_entropy")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return _apply(lambda x, y: _reduce(jnp.log1p(jnp.exp(-y * x)), reduction),
+                  input, label, op_name="cross_entropy")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    args = [weight] if weight is not None else []
+
+    def f(x, y, *w):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), 1)
+        diff = jnp.maximum(margin - correct + x, 0.0) ** p
+        if w:
+            diff = diff * jnp.take(w[0], y.astype(jnp.int32))[:, None]
+        mask = jax.nn.one_hot(y.astype(jnp.int32), c, dtype=x.dtype)
+        loss = jnp.sum(diff * (1 - mask), axis=1) / c
+        return _reduce(loss, reduction)
+    return _apply(f, input, label, *args, op_name="cross_entropy")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+    return _apply(f, input, label, variance, op_name="cross_entropy")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    dist = distance_function or (
+        lambda a, b: jnp.sqrt(jnp.sum((a - b) ** 2, axis=-1) + 1e-12))
+
+    def f(a, p, n):
+        dp = dist(a, p)
+        dn = dist(a, n)
+        if swap:
+            dn = jnp.minimum(dn, dist(p, n))
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return _apply(f, input, positive, negative, op_name="cross_entropy")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (ref: nn/functional/loss.py hsigmoid_loss). Paths are derived from the
+    label's binary encoding over num_classes-1 internal nodes."""
+    depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+
+    if path_table is None:
+        # complete-tree paths, computed host-side from concrete labels
+        lab = np.asarray(jax.device_get(as_tensor_data(label))).astype(np.int64)
+        codes = np.zeros((lab.shape[0], depth), np.int64)   # node ids
+        bits = np.zeros((lab.shape[0], depth), np.float32)  # left/right
+        for i, l in enumerate(lab.reshape(-1)):
+            node = int(l) + num_classes - 1  # leaf position in heap order
+            for d in range(depth):
+                parent = (node - 1) // 2
+                bits[i, depth - 1 - d] = float(node == 2 * parent + 2)
+                codes[i, depth - 1 - d] = parent
+                node = parent
+                if parent == 0:
+                    break
+        pt, pc = jnp.asarray(codes), jnp.asarray(bits)
+    else:
+        pt = jnp.asarray(as_tensor_data(path_table))
+        pc = jnp.asarray(as_tensor_data(path_code)).astype(jnp.float32)
+
+    args = [input, weight] + ([bias] if bias is not None else [])
+
+    def f(x, w, *b):
+        wp = jnp.take(w, pt, axis=0)               # [N, depth, D]
+        logits = jnp.einsum("nd,nkd->nk", x, wp)
+        if b:
+            logits = logits + jnp.take(b[0].reshape(-1), pt)
+        # BCE with code bits as targets
+        loss = -(pc * jax.nn.log_sigmoid(logits)
+                 + (1 - pc) * jax.nn.log_sigmoid(-logits))
+        return jnp.sum(loss, axis=-1, keepdims=True)
+    return _apply(f, *args, op_name="cross_entropy")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ArcFace-class margin softmax (ref: loss.py margin_cross_entropy):
+    cos(m1·θ + m2) - m3 applied to the target logit, then scaled CE."""
+    def f(lg, lab):
+        lab_i = lab.astype(jnp.int32).reshape(-1)
+        onehot = jax.nn.one_hot(lab_i, lg.shape[-1], dtype=lg.dtype)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = jnp.where(onehot > 0, target, cos) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        if return_softmax:
+            return _reduce(loss, reduction), jnp.exp(logp)
+        return _reduce(loss, reduction)
+    return _apply(f, logits, label, op_name="cross_entropy")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss via the forward algorithm (log-alpha) on the
+    (T, U) lattice, scanned over time on-device (ref: loss.py rnnt_loss;
+    the CUDA warp-rnnt kernel becomes a lax.scan)."""
+    def f(logits, labels, tlen, ulen):
+        # logits [B, T, U+1, V] log-probs; labels [B, U]
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        blank_lp = lp[..., blank]                        # [B, T, U+1]
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], jnp.broadcast_to(
+                labels.astype(jnp.int32)[:, None, :, None], (B, T, U, 1)),
+            axis=-1)[..., 0]                             # [B, T, U]
+        NEG = jnp.float32(-1e30)
+
+        def step(alpha, t):
+            # alpha [B, U+1] at time t-1 -> time t
+            from_left = alpha + blank_lp[:, t - 1, :]    # emit blank, t-1→t
+            alpha_t = from_left
+            # then consume labels within time t (scan over u)
+            def consume(carry, u):
+                cur = carry
+                prev_u = jnp.where(u > 0, cur[:, u - 1] +
+                                   lab_lp[:, t, u - 1], NEG)
+                val = jnp.logaddexp(cur[:, u], prev_u)
+                cur = cur.at[:, u].set(val)
+                return cur, None
+            alpha_t, _ = lax.scan(consume, alpha_t, jnp.arange(1, U1))
+            return alpha_t, alpha_t
+
+        # t = 0 row: only label consumption
+        alpha0 = jnp.full((B, U1), NEG)
+        alpha0 = alpha0.at[:, 0].set(0.0)
+
+        def consume0(carry, u):
+            cur = carry
+            val = cur[:, u - 1] + lab_lp[:, 0, u - 1]
+            cur = cur.at[:, u].set(val)
+            return cur, None
+        alpha0, _ = lax.scan(consume0, alpha0, jnp.arange(1, U1))
+
+        alpha_fin, alphas = lax.scan(step, alpha0, jnp.arange(1, T))
+        all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,U+1]
+        # total log-prob: alpha[tlen-1, ulen] + blank at (tlen-1, ulen)
+        t_idx = (tlen.astype(jnp.int32) - 1)
+        u_idx = ulen.astype(jnp.int32)
+        a_final = all_alphas[t_idx, jnp.arange(B), u_idx]
+        final_blank = blank_lp[jnp.arange(B), t_idx, u_idx]
+        nll = -(a_final + final_blank)
+        return _reduce(nll, reduction)
+    return _apply(f, input, label, input_lengths, label_lengths,
+                  op_name="cross_entropy")
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref: ops gather_tree): walk parent pointers
+    from the last step to recover full beams. ids/parents [T, B, W]."""
+    def f(idv, par):
+        T = idv.shape[0]
+
+        def step(carry, t):
+            beams = carry                        # [B, W] beam slot at t+1
+            out = jnp.take_along_axis(idv[t], beams, axis=-1)
+            prev = jnp.take_along_axis(par[t], beams, axis=-1)
+            return prev, out
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2]),
+                                idv.shape[1:]).astype(idv.dtype)
+        _, outs = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+    return _apply(f, ids, parents)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """Shift a fraction of channels one step along the segment (time) axis
+    (ref: ops temporal_shift for TSM models)."""
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        v = a.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        back = jnp.pad(v[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+        fwd = jnp.pad(v[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+        keep = v[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return _apply(f, x)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (PartialFC): positives always kept,
+    negatives uniformly drawn host-side (data-dependent sizes are host work,
+    ref: ops class_center_sample)."""
+    lab = np.asarray(jax.device_get(as_tensor_data(label))).astype(np.int64)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        from ...framework.random import get_seed
+        rng = np.random.RandomState(get_seed())
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        extra = rng.choice(neg_pool, num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab])), Tensor(jnp.asarray(sampled)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention evaluated via the CSR mask (ref: the cuda
+    sparse_attention op). TPU picks dense+mask: scores are computed on the
+    MXU and non-stored positions masked to -inf — same math, and for the
+    seq lens this op targets the MXU beats gather-scatter."""
+    def f(q, k, v, offs, cols):
+        B, H, S, D = q.shape
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(D)
+        bidx, hidx = jnp.meshgrid(jnp.arange(B), jnp.arange(H), indexing="ij")
+        nnz = cols.shape[-1]
+
+        # reconstruct each nnz's row id from the CSR offsets per (B, H)
+        def rows_from_offsets(off):
+            c = jnp.diff(off.astype(jnp.int32))
+            return jnp.repeat(jnp.arange(S), c, total_repeat_length=nnz)
+        rowids = jax.vmap(jax.vmap(rows_from_offsets))(offs)   # [B,H,nnz]
+        m = jnp.zeros((B, H, S, S), bool)
+        m = m.at[bidx[..., None], hidx[..., None], rowids,
+                 cols.astype(jnp.int32)].set(True)
+        s = jnp.where(m, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(m, p, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return _apply(f, query, key, value, sparse_csr_offset, sparse_csr_columns,
+                  op_name="attention")

@@ -66,28 +66,110 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     df = "NCW" if data_format.upper() in ("NCL", "NCW") else "NWC"
     out = _pool(x, kernel_size, stride, padding, 1, df, "max", None, "max_pool1d",
                 ceil_mode)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 1, df, ceil_mode)) \
+        if return_mask else out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", None,
                 "max_pool2d", ceil_mode)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 2,
+                            data_format, ceil_mode)) if return_mask else out
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 3, data_format, "max", None,
                 "max_pool3d", ceil_mode)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 3,
+                            data_format, ceil_mode)) if return_mask else out
 
 
-def _pool_mask(x, out):
-    # Indices for return_mask parity: not tracked through reduce_window; rarely
-    # used outside unpooling. Provide flat argmax indices via a recompute.
-    from ...tensor_impl import Tensor
-    return Tensor(jnp.zeros(out.shape, jnp.int64))
+def _pool_mask(x, out, kernel_size=None, stride=None, padding=0, nd=2,
+               data_format="NCHW", ceil_mode=False, windows=None):
+    """Flat per-channel spatial argmax index for each pooling window (the
+    reference's return_mask convention, consumed by max_unpool*).
+
+    `windows`, when given (adaptive pooling), is a per-dim list of
+    (starts, ends) arrays describing variable windows; otherwise the regular
+    kernel/stride/padding geometry is used (string paddings and ceil_mode
+    follow `_pool`'s conventions)."""
+    from ...dispatch import apply as _ap
+
+    channel_last = not data_format.upper().startswith("NC")
+
+    def f(a):
+        ac = a
+        if channel_last:
+            perm = (0, a.ndim - 1) + tuple(range(1, a.ndim - 1))
+            ac = jnp.transpose(a, perm)
+        spatial = ac.shape[2:]
+
+        idxs, valids, out_sp = [], [], []
+        if windows is not None:
+            for i in range(nd):
+                starts, ends = windows[i]
+                kmax = int(np.max(ends - starts))
+                grid = starts[:, None] + np.arange(kmax)[None, :]
+                valids.append(jnp.asarray(grid < ends[:, None]))
+                idxs.append(jnp.asarray(np.clip(grid, 0, spatial[i] - 1)))
+                out_sp.append(len(starts))
+        else:
+            k = _tuple(kernel_size, nd)
+            st = _tuple(stride, nd) if stride is not None else k
+            pad, _ = _norm_padding(padding, nd, data_format)
+            for i in range(nd):
+                if pad == "VALID":
+                    lo = hi = 0
+                elif pad == "SAME":
+                    o = -(-spatial[i] // st[i])
+                    total = max((o - 1) * st[i] + k[i] - spatial[i], 0)
+                    lo, hi = total // 2, total - total // 2
+                else:
+                    lo, hi = pad[i]
+                span = spatial[i] + lo + hi - k[i]
+                o = (-(-span // st[i]) if ceil_mode else span // st[i]) + 1
+                grid = (np.arange(o)[:, None] * st[i]
+                        + np.arange(k[i])[None, :] - lo)
+                valids.append(jnp.asarray(
+                    (grid >= 0) & (grid < spatial[i])))
+                idxs.append(jnp.asarray(np.clip(grid, 0, spatial[i] - 1)))
+                out_sp.append(o)
+        out_sp = tuple(out_sp)
+        ks = tuple(ix.shape[1] for ix in idxs)
+
+        patches = ac
+        # gather each spatial dim in turn: dim 2+2*i splits into (out, k)
+        for i in range(nd):
+            patches = jnp.take(patches, idxs[i], axis=2 + 2 * i)
+        # patches: [N, C, o1, k1, o2, k2, ...]; move ks last
+        perm = ([0, 1] + [2 + 2 * i for i in range(nd)]
+                + [3 + 2 * i for i in range(nd)])
+        patches = jnp.transpose(patches, perm)
+        # combine validity + flat spatial index across dims by broadcasting
+        vshape_base = [1] * (2 * nd)
+        vcomb = jnp.ones((), bool)
+        fidx = jnp.zeros((), jnp.int64)
+        for i in range(nd):
+            sh = list(vshape_base)
+            sh[i] = out_sp[i]
+            sh[nd + i] = ks[i]
+            vcomb = vcomb & valids[i].reshape(sh)
+            fidx = fidx * spatial[i] + idxs[i].astype(jnp.int64).reshape(sh)
+        win = int(np.prod(ks))
+        scores = jnp.where(vcomb, patches, -jnp.inf)
+        scores = scores.reshape(ac.shape[:2] + out_sp + (win,))
+        arg = jnp.argmax(scores, axis=-1)                     # [N, C, o...]
+        fidx_r = jnp.broadcast_to(fidx, out_sp + ks).reshape(out_sp + (win,))
+        flat = jnp.take_along_axis(
+            fidx_r[None, None], arg[..., None], axis=-1)[..., 0]
+        flat = flat.astype(jnp.int64)
+        if channel_last:
+            flat = jnp.transpose(flat, (0,) + tuple(range(2, flat.ndim)) + (1,))
+        return flat
+
+    return _ap(f, x)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -164,16 +246,30 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool(x, output_size, 3, data_format, "avg", "adaptive_avg_pool3d")
 
 
+
+
+def _adaptive_mask(x, out, nd, df):
+    """Argmax indices for adaptive max pooling: exact per-output variable
+    windows via `_adaptive_windows` (same semantics as the reference
+    kernel)."""
+    from ...tensor_impl import as_tensor_data
+    a = as_tensor_data(x)
+    spatial = a.shape[2:2 + nd]
+    osp = as_tensor_data(out).shape[2:2 + nd]
+    wins = [_adaptive_windows(spatial[i], osp[i]) for i in range(nd)]
+    return _pool_mask(x, out, nd=nd, data_format=df, windows=wins)
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
     out = _adaptive_pool(x, output_size, 1, "NCW", "max", "adaptive_max_pool1d")
-    return (out, _pool_mask(x, out)) if return_mask else out
+    return (out, _adaptive_mask(x, out, 1, "NCW")) if return_mask else out
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     out = _adaptive_pool(x, output_size, 2, "NCHW", "max", "adaptive_max_pool2d")
-    return (out, _pool_mask(x, out)) if return_mask else out
+    return (out, _adaptive_mask(x, out, 2, "NCHW")) if return_mask else out
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     out = _adaptive_pool(x, output_size, 3, "NCDHW", "max", "adaptive_max_pool3d")
-    return (out, _pool_mask(x, out)) if return_mask else out
+    return (out, _adaptive_mask(x, out, 3, "NCDHW")) if return_mask else out

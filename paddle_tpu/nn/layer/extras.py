@@ -1,0 +1,261 @@
+"""nn layer long tail (ref: python/paddle/nn/layer/loss.py, pooling.py,
+common.py, rnn.py BeamSearchDecoder/dynamic_decode)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from ...tensor_impl import Tensor, as_tensor_data, wrap
+from ..functional import extras as FE
+
+__all__ = [
+    "PoissonNLLLoss", "Softmax2D", "RNNTLoss", "HSigmoidLoss",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "MultiLabelSoftMarginLoss",
+    "MultiMarginLoss", "TripletMarginWithDistanceLoss", "SoftMarginLoss",
+    "GaussianNLLLoss", "Unflatten", "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input, self.full = log_input, full
+        self.epsilon, self.reduction = epsilon, reduction
+
+    def forward(self, input, label):
+        return FE.poisson_nll_loss(input, label, self.log_input, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW (ref: activation.py)."""
+
+    def forward(self, x):
+        from .. import functional as F
+        return F.softmax(x, axis=-3)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank, self.fastemit_lambda = blank, fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return FE.rnnt_loss(input, label, input_lengths, label_lengths,
+                            self.blank, self.fastemit_lambda, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        from ..initializer import Uniform
+        self.num_classes = num_classes
+        k = 1.0 / np.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), default_initializer=Uniform(-k, k))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (num_classes - 1, 1), is_bias=True,
+                default_initializer=Uniform(-k, k))
+        else:
+            self.bias = None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return FE.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                                self.bias, path_table, path_code)
+
+
+class _MaxUnPoolNd(Layer):
+    nd = 2
+    fn = staticmethod(FE.max_unpool2d)
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format=None,
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding, self.output_size = padding, output_size
+        self.data_format = data_format
+
+    def forward(self, x, indices):
+        return type(self).fn(x, indices, self.kernel_size, self.stride,
+                             self.padding, output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    nd = 1
+    fn = staticmethod(FE.max_unpool1d)
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    nd = 2
+    fn = staticmethod(FE.max_unpool2d)
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    nd = 3
+    fn = staticmethod(FE.max_unpool3d)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return FE.multi_label_soft_margin_loss(input, label, self.weight,
+                                               self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return FE.multi_margin_loss(input, label, self.p, self.margin,
+                                    self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function, self.margin = distance_function, margin
+        self.swap, self.reduction = swap, reduction
+
+    def forward(self, input, positive, negative):
+        return FE.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return FE.soft_margin_loss(input, label, self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        return FE.gaussian_nll_loss(input, label, variance, self.full,
+                                    self.epsilon, self.reduction)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ...tensor.extras import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoding over an RNN cell (ref: nn/layer/rnn.py
+    BeamSearchDecoder). Eager, host-driven loop — decoding is inherently
+    sequential; each cell step is an XLA call."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        """Tile cell states to [B*W, ...]; start tokens for each beam."""
+        W = self.beam_size
+
+        def tile(t):
+            a = jnp.asarray(as_tensor_data(t))
+            return jnp.repeat(a, W, axis=0)
+
+        states = jax.tree_util.tree_map(tile, initial_cell_states)
+        batch = jax.tree_util.tree_leaves(states)[0].shape[0] // W
+        ids = jnp.full((batch * W,), self.start_token, jnp.int64)
+        # log-prob 0 for beam 0, -inf for the rest so the first expansion
+        # starts from a single live beam
+        lp = jnp.tile(jnp.concatenate(
+            [jnp.zeros((1,)), jnp.full((W - 1,), -1e9)]), (batch,))
+        finished = jnp.zeros((batch * W,), bool)
+        return ids, states, (lp, finished)
+
+    def step(self, time, inputs, states, beam_state):
+        """One expansion: cell forward, top-W over (beam × vocab)."""
+        lp, finished = beam_state
+        W = self.beam_size
+        x = inputs
+        if self.embedding_fn is not None:
+            x = self.embedding_fn(wrap(jnp.asarray(x)))
+        out, new_states = self.cell(wrap(jnp.asarray(as_tensor_data(x))),
+                                    jax.tree_util.tree_map(wrap, states))
+        logits = as_tensor_data(self.output_fn(out) if self.output_fn else out)
+        logq = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)  # [B*W, V]
+        V = logq.shape[-1]
+        B = logq.shape[0] // W
+        # finished beams only extend with end_token at zero cost
+        end_only = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        logq = jnp.where(finished[:, None], end_only[None, :], logq)
+        total = lp[:, None] + logq                          # [B*W, V]
+        flat = total.reshape(B, W * V)
+        top_lp, top_idx = jax.lax.top_k(flat, W)            # [B, W]
+        beam_src = top_idx // V                             # which beam
+        tok = (top_idx % V).astype(jnp.int64)               # which token
+        gather_rows = (jnp.arange(B)[:, None] * W + beam_src).reshape(-1)
+
+        def reorder(t):
+            return jnp.asarray(as_tensor_data(t))[gather_rows]
+
+        new_states = jax.tree_util.tree_map(reorder, new_states)
+        new_finished = finished[gather_rows] | (tok.reshape(-1) == self.end_token)
+        return (tok.reshape(-1), new_states,
+                (top_lp.reshape(-1), new_finished), gather_rows)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run a decoder until all beams finish or max_step_num (ref:
+    nn/layer/rnn.py dynamic_decode)."""
+    ids, states, beam_state = decoder.initialize(inits)
+    outputs, parents = [], []
+    for t in range(max_step_num):
+        ids, states, beam_state, gather_rows = decoder.step(
+            t, ids, states, beam_state)
+        outputs.append(ids)
+        parents.append(gather_rows % decoder.beam_size)
+        if bool(jnp.all(beam_state[1])):
+            break
+    W = decoder.beam_size
+    T = len(outputs)
+    B = outputs[0].shape[0] // W
+    ids_twb = jnp.stack(outputs).reshape(T, B, W)
+    par_twb = jnp.stack(parents).reshape(T, B, W)
+    final = as_tensor_data(FE.gather_tree(wrap(ids_twb), wrap(par_twb)))
+    if not output_time_major:
+        final = jnp.transpose(final, (1, 2, 0))       # [B, W, T]
+    lengths = jnp.sum(jnp.cumsum(
+        (final == decoder.end_token).astype(jnp.int32), axis=-1) == 0,
+        axis=-1) + 1
+    lengths = jnp.minimum(lengths, final.shape[-1])
+    if return_length:
+        return wrap(final), wrap(beam_state[0].reshape(B, W)), wrap(lengths)
+    return wrap(final), wrap(beam_state[0].reshape(B, W))

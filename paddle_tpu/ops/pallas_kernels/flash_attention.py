@@ -6,8 +6,10 @@ gpu/flash_attn_kernel.cu capability) with a TPU-native kernel: the grid walks
 lives in VMEM scratch across the k-block sweep, scores are computed on the MXU
 in fp32, and causal q<k blocks are skipped entirely (predicated grid steps).
 
-Backward: custom_vjp recomputes via the differentiable blockwise XLA path
-(ops/blockwise_attention.py) — flash-style memory behavior in both directions.
+Backward: pallas kernels in flash_attention_bwd.py (LSE saved by this
+forward, scores recomputed blockwise on the MXU). The differentiable blockwise
+XLA path (ops/blockwise_attention.py) remains as the interpret/fallback
+reference.
 """
 from __future__ import annotations
 
@@ -19,11 +21,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..blockwise_attention import blockwise_attention
+from .flash_attention_bwd import flash_attention_backward
 
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                 causal, nk, bq, bk, scale):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -68,11 +71,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l = jnp.maximum(jnp.max(l_scr[:, :], axis=1, keepdims=True),
                         jnp.float32(1e-30))
         o_ref[0, :, :] = (acc_scr[:, :] / l).astype(o_ref.dtype)
+        m = jnp.max(m_scr[:, :], axis=1)
+        lse_ref[0, :] = m + jnp.log(jnp.max(l_scr[:, :], axis=1))
 
 
-def _pallas_forward(q, k, v, causal, block_q=256, block_k=256):
+def _pallas_forward(q, k, v, causal, block_q=256, block_k=256,
+                    with_residuals=False, interpret=False):
     """q,k,v: [B, S, H, D] -> [B, S, H, D]. Head dim padded to a lane (128)
-    multiple — zero columns don't change scores or outputs."""
+    multiple — zero columns don't change scores or outputs. With
+    with_residuals, also returns the bh-layout tensors + LSE the pallas
+    backward consumes."""
+    if q.dtype == jnp.float64:
+        # kernel accumulates in fp32 regardless; f64 only appears via the
+        # framework's global x64 flag, never as a deliberate attention dtype
+        q, k, v = (t.astype(jnp.float32) for t in (q, k, v))
     D0 = q.shape[-1]
     if D0 % 128 != 0:
         pad = 128 - D0 % 128
@@ -91,29 +103,37 @@ def _pallas_forward(q, k, v, causal, block_q=256, block_k=256):
 
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
     grid = (B * H, nq, nk)
+    interpret = interpret or jax.default_backend() != "tpu"
     kernel = functools.partial(_fwd_kernel, causal=causal, nk=nk, bq=block_q,
                                bk=block_k, scale=scale)
     # Mosaic rejects x64-typed index math; the framework enables x64 globally
     # for dtype parity, so pin 32-bit types inside the kernel trace.
     with jax.enable_x64(False):
-        out = pl.pallas_call(
+        out, lse = pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+            out_shape=(jax.ShapeDtypeStruct(qb.shape, q.dtype),
+                       jax.ShapeDtypeStruct(qb.shape[:2], jnp.float32)),
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
                 pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            out_specs=(
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            ),
             scratch_shapes=[
                 pltpu.VMEM((block_q, 128), jnp.float32),
                 pltpu.VMEM((block_q, 128), jnp.float32),
                 pltpu.VMEM((block_q, D), jnp.float32),
             ],
+            interpret=interpret,
         )(qb, kb, vb)
+    res = (qb, kb, vb, out, lse, scale) if with_residuals else None
     out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
-    return out[..., :D0] if D0 != D else out
+    out = out[..., :D0] if D0 != D else out
+    return (out, res) if with_residuals else out
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -122,14 +142,37 @@ def flash_attention_bshd(q, k, v, causal=True):
 
 
 def _vjp_fwd(q, k, v, causal):
-    return _pallas_forward(q, k, v, causal), (q, k, v)
+    out, res = _pallas_forward(q, k, v, causal, with_residuals=True)
+    # dtype carried as a zero-length proto array (residuals must be jax types)
+    return out, (res, q.shape, jnp.zeros((0,), q.dtype))
 
 
 def _vjp_bwd(causal, residuals, g):
-    q, k, v = residuals
-    _, pullback = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal), q, k, v)
-    return pullback(g)
+    (qb, kb, vb, ob, lse, scale), (B, Sq, H, D0), dt_proto = residuals
+    in_dtype = dt_proto.dtype
+    Sk = kb.shape[1]
+    D = qb.shape[-1]
+    gb = g
+    if D != D0:
+        gb = jnp.pad(g, ((0, 0), (0, 0), (0, 0), (0, D - D0)))
+    gb = gb.transpose(0, 2, 1, 3).reshape(B * H, Sq, D).astype(qb.dtype)
+    interpret = jax.default_backend() != "tpu"
+    dqb, dkb, dvb = flash_attention_backward(qb, kb, vb, ob, lse, gb,
+                                             scale, causal,
+                                             interpret=interpret)
+
+    def from_bh(x, S):
+        x = x.reshape(B, H, S, D).transpose(0, 2, 1, 3).astype(in_dtype)
+        return x[..., :D0] if D != D0 else x
+
+    return from_bh(dqb, Sq), from_bh(dkb, Sk), from_bh(dvb, Sk)
 
 
 flash_attention_bshd.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def flash_attention_interpret(q, k, v, causal=True, block_q=256, block_k=256):
+    """Interpret-mode forward (+ residuals) so kernel numerics are testable
+    on CPU without a TPU."""
+    return _pallas_forward(q, k, v, causal, block_q=block_q, block_k=block_k,
+                           with_residuals=True, interpret=True)

@@ -1,0 +1,161 @@
+"""Pallas TPU flash-attention backward kernels.
+
+Standard flash-attention backward (Dao et al.) mapped to the TPU memory
+hierarchy: the forward saves only O and the per-row logsumexp (LSE); the
+backward recomputes score blocks on the MXU in fp32 and accumulates dQ (one
+kernel, k-sweep in VMEM scratch) and dK/dV (one kernel, q-sweep in VMEM
+scratch). Nothing S×S ever touches HBM, and causal off-diagonal blocks are
+skipped via predicated grid steps — same blocking discipline as the forward
+kernel in flash_attention.py.
+
+Replaces the reference's fused CUDA flash_attn_grad kernel (ref: paddle/phi/
+kernels/gpu/flash_attn_grad_kernel.cu capability).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, bq, bk, scale, causal):
+    q = q_ref[0, :, :].astype(jnp.float32)              # [bq, D]
+    k = k_ref[0, :, :].astype(jnp.float32)              # [bk, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s * jnp.float32(scale)
+    if causal:
+        q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(k_pos <= q_pos, s, jnp.float32(_NEG_INF))
+    lse = lse_ref[0, :].astype(jnp.float32)             # [bq]
+    return q, k, jnp.exp(s - lse[:, None])              # p: [bq, bk]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, causal, nk, bq, bk, scale):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (ki <= qi) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _block():
+        _, k, p = _recompute_p(q_ref, k_ref, lse_ref, qi, ki, bq, bk, scale,
+                               causal)
+        do = do_ref[0, :, :].astype(jnp.float32)        # [bq, D]
+        v = v_ref[0, :, :].astype(jnp.float32)          # [bk, D]
+        delta = delta_ref[0, :].astype(jnp.float32)     # [bq]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)
+        dq_scr[:, :] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, :, :] = dq_scr[:, :].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, causal, nq, bq, bk, scale):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # causal: q-block contributes to this k-block only when qi >= ki
+    run = (qi >= ki) if causal else (qi >= 0)
+
+    @pl.when(run)
+    def _block():
+        q, _, p = _recompute_p(q_ref, k_ref, lse_ref, qi, ki, bq, bk, scale,
+                               causal)
+        do = do_ref[0, :, :].astype(jnp.float32)        # [bq, D]
+        v = v_ref[0, :, :].astype(jnp.float32)          # [bk, D]
+        delta = delta_ref[0, :].astype(jnp.float32)     # [bq]
+        dv_scr[:, :] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)
+        dk_scr[:, :] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, :, :] = dk_scr[:, :].astype(dk_ref.dtype)
+        dv_ref[0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
+
+
+def flash_attention_backward(q, k, v, o, lse, do, scale, causal,
+                             block_q=256, block_k=256, interpret=False):
+    """All array args [BH, S, D] (lse [BH, S] fp32); returns (dq, dk, dv).
+
+    `scale` is the softmax scale of the UNPADDED head dim (the caller pads D
+    to a lane multiple; zero columns keep zero gradients automatically).
+    """
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    assert Sq % block_q == 0 and Sk % block_k == 0
+    nq, nk = Sq // block_q, Sk // block_k
+
+    # delta[b, i] = rowsum(dO ∘ O): one fused elementwise+reduce in XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    common = dict(causal=causal, bq=block_q, bk=block_k, scale=scale)
+
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, nk=nk, **common),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            grid=(BH, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, nq=nq, **common),
+            out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                       jax.ShapeDtypeStruct(v.shape, v.dtype)),
+            grid=(BH, nk, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            ),
+            scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                            pltpu.VMEM((block_k, D), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+    return dq, dk, dv

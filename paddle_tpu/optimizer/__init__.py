@@ -252,3 +252,4 @@ class Lamb(Optimizer):
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
            "Adam", "AdamW", "Adamax", "Lamb", "lr"]
+from .lbfgs import LBFGS  # noqa: E402,F401

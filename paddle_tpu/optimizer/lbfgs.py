@@ -1,0 +1,172 @@
+"""L-BFGS optimizer (ref: python/paddle/incubate/optimizer/lbfgs.py,
+python/paddle/optimizer/lbfgs.py).
+
+Closure-driven quasi-Newton: history of (s, y) pairs approximates the inverse
+Hessian (two-loop recursion), optional strong-Wolfe line search. The driver
+loop is host-side (inherently sequential decisions); every closure evaluation
+is one XLA forward+backward, so the device work stays fused.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _gather_flat(ts):
+    return jnp.concatenate([jnp.ravel(t._data.astype(jnp.float32)) for t in ts])
+
+
+def _gather_flat_grad(ts):
+    outs = []
+    for t in ts:
+        g = t.grad
+        outs.append(jnp.ravel(g._data.astype(jnp.float32)) if g is not None
+                    else jnp.zeros(int(np.prod(t._data.shape)), jnp.float32))
+    return jnp.concatenate(outs)
+
+
+def _set_flat(ts, flat):
+    off = 0
+    for t in ts:
+        n = int(np.prod(t._data.shape))
+        t._data = flat[off:off + n].reshape(t._data.shape).astype(t._data.dtype)
+        off += n
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s, self._y, self._rho = [], [], []
+        self._prev_flat_grad = None
+        self._H_diag = 1.0
+
+    def _direction(self, flat_grad):
+        q = -flat_grad
+        al = []
+        for s, y, rho in zip(reversed(self._s), reversed(self._y),
+                             reversed(self._rho)):
+            a = rho * jnp.dot(s, q)
+            al.append(a)
+            q = q - a * y
+        q = q * self._H_diag
+        for (s, y, rho), a in zip(zip(self._s, self._y, self._rho),
+                                  reversed(al)):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        return q
+
+    def _eval(self, closure, flat, d, t):
+        _set_flat(self._parameter_list, flat + t * d)
+        loss = closure()
+        return float(np.asarray(jax.device_get(loss._data))), \
+            _gather_flat_grad(self._parameter_list)
+
+    def step(self, closure):
+        """closure: callable that clears grads, computes loss, calls
+        backward, returns the loss Tensor."""
+        params = self._parameter_list
+        assert params, "LBFGS requires parameters"
+        loss = closure()
+        loss_val = float(np.asarray(jax.device_get(loss._data)))
+        flat_grad = _gather_flat_grad(params)
+        evals = 1
+        if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+            return loss
+
+        for it in range(self.max_iter):
+            d = self._direction(flat_grad)
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -self.tolerance_change:
+                break
+            lr = self.get_lr() if (it > 0 or self._s) else \
+                min(1.0, 1.0 / max(float(jnp.sum(jnp.abs(flat_grad))), 1e-12)) \
+                * self.get_lr()
+            flat = _gather_flat(params)
+
+            if self.line_search_fn == "strong_wolfe":
+                t, new_loss, new_grad, ls_evals = self._strong_wolfe(
+                    closure, flat, d, lr, loss_val, flat_grad, gtd)
+                evals += ls_evals
+            else:
+                t = lr
+                new_loss, new_grad = self._eval(closure, flat, d, t)
+                evals += 1
+
+            s = t * d
+            y = new_grad - flat_grad
+            ys = float(jnp.dot(y, s))
+            if ys > 1e-10:
+                if len(self._s) >= self.history_size:
+                    self._s.pop(0); self._y.pop(0); self._rho.pop(0)
+                self._s.append(s); self._y.append(y)
+                self._rho.append(1.0 / ys)
+                self._H_diag = ys / float(jnp.dot(y, y))
+
+            delta = abs(new_loss - loss_val)
+            loss_val, flat_grad = new_loss, new_grad
+            if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+                break
+            if delta < self.tolerance_change or evals >= self.max_eval:
+                break
+        return loss
+
+    def _strong_wolfe(self, closure, flat, d, t, f0, g0, gtd0, c1=1e-4,
+                      c2=0.9, max_ls=25):
+        """Bracketing + zoom line search satisfying the strong Wolfe
+        conditions (same scheme as the reference's line_search_dygraph)."""
+        f_prev, g_prev, t_prev = f0, g0, 0.0
+        evals = 0
+        f_new, g_new = self._eval(closure, flat, d, t)
+        evals += 1
+        for i in range(max_ls):
+            gtd_new = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or (i > 0 and f_new >= f_prev):
+                return self._zoom(closure, flat, d, t_prev, t, f_prev, f_new,
+                                  f0, gtd0, c1, c2, evals)
+            if abs(gtd_new) <= -c2 * gtd0:
+                return t, f_new, g_new, evals
+            if gtd_new >= 0:
+                return self._zoom(closure, flat, d, t, t_prev, f_new, f_prev,
+                                  f0, gtd0, c1, c2, evals)
+            t_prev, f_prev, g_prev = t, f_new, g_new
+            t = t * 2.0
+            f_new, g_new = self._eval(closure, flat, d, t)
+            evals += 1
+        return t, f_new, g_new, evals
+
+    def _zoom(self, closure, flat, d, lo, hi, f_lo, f_hi, f0, gtd0, c1, c2,
+              evals, max_zoom=25):
+        g_new = None
+        t = 0.5 * (lo + hi)
+        for _ in range(max_zoom):
+            t = 0.5 * (lo + hi)
+            f_new, g_new = self._eval(closure, flat, d, t)
+            evals += 1
+            if f_new > f0 + c1 * t * gtd0 or f_new >= f_lo:
+                hi, f_hi = t, f_new
+            else:
+                gtd_new = float(jnp.dot(g_new, d))
+                if abs(gtd_new) <= -c2 * gtd0:
+                    break
+                if gtd_new * (hi - lo) >= 0:
+                    hi, f_hi = lo, f_lo
+                lo, f_lo = t, f_new
+            if abs(hi - lo) < 1e-9:
+                break
+        return t, f_new, g_new, evals

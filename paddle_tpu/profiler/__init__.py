@@ -213,3 +213,34 @@ class _Benchmark:
 def load_profiler_result(path):
     with open(path) as f:
         return json.load(f)
+
+
+class SortedKeys:
+    """ref: profiler/profiler_statistic.py SortedKeys enum."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """ref: profiler SummaryView enum."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """The jax profiler's native artifact is xplane protobuf; exporting
+    chrome tracing also materializes the .xplane.pb files under dir_name."""
+    return export_chrome_tracing(dir_name, worker_name)

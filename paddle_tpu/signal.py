@@ -32,12 +32,14 @@ def _frame_idx(seq_len, frame_length, hop_length, axis):
 
 
 def _frame_data(a, frame_length, hop_length, axis):
-    if axis in (-1, a.ndim - 1):
-        idx = _frame_idx(a.shape[-1], frame_length, hop_length, -1)
-        return a[..., idx]
-    elif axis == 0:
+    # axis == 0 must win for 1-D inputs (where 0 is also the last axis):
+    # the layouts differ — (num_frames, frame_length) vs (frame_length, num_frames)
+    if axis == 0:
         idx = _frame_idx(a.shape[0], frame_length, hop_length, 0)
         return a[idx]
+    elif axis in (-1, a.ndim - 1):
+        idx = _frame_idx(a.shape[-1], frame_length, hop_length, -1)
+        return a[..., idx]
     raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
 
 

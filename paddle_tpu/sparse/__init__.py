@@ -91,3 +91,215 @@ def relu(a):
     if isinstance(a, SparseCooTensor):
         return SparseCooTensor(a.indices, jnp.maximum(a.values, 0), a.shape)
     return wrap(jnp.maximum(as_tensor_data(a), 0))
+
+
+class SparseCsrTensor:
+    """CSR layout (ref sparse/creation.py sparse_csr_tensor): crows [m+1],
+    cols [nnz], values [nnz]. Converted to COO for compute."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows = jnp.asarray(as_tensor_data(crows)).astype(jnp.int64)
+        self.cols = jnp.asarray(as_tensor_data(cols)).astype(jnp.int64)
+        self.values = jnp.asarray(as_tensor_data(values))
+        self.shape = list(shape)
+
+    @property
+    def nnz(self):
+        return int(self.cols.shape[0])
+
+    def to_coo(self):
+        counts = jnp.diff(self.crows)
+        rows = jnp.repeat(jnp.arange(len(counts), dtype=jnp.int64), counts,
+                          total_repeat_length=self.nnz)
+        return SparseCooTensor(jnp.stack([rows, self.cols]), self.values,
+                               self.shape)
+
+    def to_dense(self):
+        return self.to_coo().to_dense()
+
+    def numpy(self):
+        return np.asarray(as_tensor_data(self.to_dense()))
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.values.dtype})")
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    val = jnp.asarray(as_tensor_data(values))
+    if dtype is not None:
+        val = val.astype(dtype)
+    return SparseCsrTensor(crows, cols, val, shape)
+
+
+def _valueswise(fn, zero_preserving=True):
+    """Lift an elementwise fn to sparse tensors: zero-preserving ops act on
+    stored values only (sparsity kept); others densify."""
+
+    def op(x, *args, **kw):
+        if isinstance(x, SparseCsrTensor):
+            if zero_preserving:
+                return SparseCsrTensor(x.crows, x.cols, fn(x.values, *args, **kw),
+                                       x.shape)
+            return wrap(fn(as_tensor_data(x.to_dense()), *args, **kw))
+        if isinstance(x, SparseCooTensor):
+            if zero_preserving:
+                return SparseCooTensor(x.indices, fn(x.values, *args, **kw),
+                                       x.shape)
+            return wrap(fn(as_tensor_data(x.to_dense()), *args, **kw))
+        return wrap(fn(as_tensor_data(x), *args, **kw))
+
+    return op
+
+
+sin = _valueswise(jnp.sin)
+tan = _valueswise(jnp.tan)
+asin = _valueswise(jnp.arcsin)
+atan = _valueswise(jnp.arctan)
+sinh = _valueswise(jnp.sinh)
+tanh = _valueswise(jnp.tanh)
+asinh = _valueswise(jnp.arcsinh)
+atanh = _valueswise(jnp.arctanh)
+sqrt = _valueswise(jnp.sqrt)
+square = _valueswise(jnp.square)
+log1p = _valueswise(jnp.log1p)
+abs = _valueswise(jnp.abs)
+neg = _valueswise(jnp.negative)
+expm1 = _valueswise(jnp.expm1)
+deg2rad = _valueswise(jnp.deg2rad)
+rad2deg = _valueswise(jnp.rad2deg)
+isnan = _valueswise(jnp.isnan)
+
+
+def pow(x, factor, name=None):
+    return _valueswise(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    if isinstance(x, SparseCooTensor):
+        ind = x.indices.astype(index_dtype) if index_dtype else x.indices
+        val = x.values.astype(value_dtype) if value_dtype else x.values
+        return SparseCooTensor(ind, val, x.shape)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(
+            x.crows.astype(index_dtype) if index_dtype else x.crows,
+            x.cols.astype(index_dtype) if index_dtype else x.cols,
+            x.values.astype(value_dtype) if value_dtype else x.values, x.shape)
+    return wrap(as_tensor_data(x).astype(value_dtype))
+
+
+def coalesce(x, name=None):
+    """Merge duplicate coordinates (sum values), sort indices row-major."""
+    assert isinstance(x, SparseCooTensor)
+    flat = jnp.zeros((), jnp.int64)
+    for d in range(x.indices.shape[0]):
+        flat = flat * x.shape[d] + x.indices[d]
+    order = jnp.argsort(flat)
+    flat_s = flat[order]
+    vals_s = x.values[order]
+    uniq, inv = jnp.unique(flat_s, return_inverse=True, size=flat_s.shape[0],
+                           fill_value=-1)
+    summed = jax.ops.segment_sum(vals_s, inv, num_segments=uniq.shape[0])
+    keep = np.asarray(jax.device_get(uniq)) >= 0
+    uniq_np = np.asarray(jax.device_get(uniq))[keep]
+    summed = jnp.asarray(np.asarray(jax.device_get(summed))[keep])
+    coords = []
+    rem = jnp.asarray(uniq_np)
+    for d in reversed(range(len(x.shape))):
+        coords.append(rem % x.shape[d])
+        rem = rem // x.shape[d]
+    indices = jnp.stack(list(reversed(coords)))
+    return SparseCooTensor(indices, summed, x.shape)
+
+
+def is_same_shape(x, y):
+    xs = x.shape if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else \
+        list(as_tensor_data(x).shape)
+    ys = y.shape if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else \
+        list(as_tensor_data(y).shape)
+    return list(xs) == list(ys)
+
+
+def reshape(x, shape, name=None):
+    assert isinstance(x, SparseCooTensor)
+    flat = jnp.zeros((), jnp.int64)
+    for d in range(x.indices.shape[0]):
+        flat = flat * x.shape[d] + x.indices[d]
+    coords = []
+    rem = flat
+    for d in reversed(range(len(shape))):
+        coords.append(rem % shape[d])
+        rem = rem // shape[d]
+    return SparseCooTensor(jnp.stack(list(reversed(coords))), x.values,
+                           list(shape))
+
+
+def transpose(x, perm, name=None):
+    assert isinstance(x, SparseCooTensor)
+    ind = jnp.stack([x.indices[p] for p in perm])
+    return SparseCooTensor(ind, x.values, [x.shape[p] for p in perm])
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = as_tensor_data(to_dense(x))
+    out = jnp.sum(d, axis=axis, keepdims=keepdim, dtype=dtype)
+    return wrap(out)
+
+
+def subtract(a, b, name=None):
+    return wrap(as_tensor_data(to_dense(a)) - as_tensor_data(to_dense(b)))
+
+
+def divide(a, b, name=None):
+    return wrap(as_tensor_data(to_dense(a)) / as_tensor_data(to_dense(b)))
+
+
+def mv(a, v, name=None):
+    """sparse matrix @ dense vector."""
+    vd = as_tensor_data(v)
+    if isinstance(a, SparseCsrTensor):
+        a = a.to_coo()
+    if isinstance(a, SparseCooTensor):
+        rows, cols = a.indices[0], a.indices[1]
+        contrib = a.values * vd[cols]
+        return wrap(jax.ops.segment_sum(contrib, rows, num_segments=a.shape[0]))
+    return wrap(as_tensor_data(a) @ vd)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) with sparse x (ref sparse/binary.py)."""
+    prod = as_tensor_data(matmul(x, y))
+    return wrap(beta * as_tensor_data(to_dense(input)) + alpha * prod)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense @ dense evaluated only at mask's nnz coordinates (SDDMM)."""
+    xd, yd = as_tensor_data(x), as_tensor_data(y)
+    assert isinstance(mask, (SparseCooTensor, SparseCsrTensor))
+    coo = mask.to_coo() if isinstance(mask, SparseCsrTensor) else mask
+    rows, cols = coo.indices[0], coo.indices[1]
+    vals = jnp.einsum("nd,nd->n", xd[rows, :], yd[:, cols].T)
+    if isinstance(mask, SparseCsrTensor):
+        return SparseCsrTensor(mask.crows, mask.cols, vals, mask.shape)
+    return SparseCooTensor(coo.indices, vals, coo.shape)
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Slice a sparse COO tensor along `axes` (ref sparse/unary.py slice):
+    filter stored entries inside the range, shift coordinates."""
+    assert isinstance(x, SparseCooTensor)
+    ind = np.asarray(jax.device_get(x.indices))
+    val = np.asarray(jax.device_get(x.values))
+    new_shape = list(x.shape)
+    keep = np.ones(ind.shape[1], bool)
+    for ax, st, en in zip(axes, starts, ends):
+        st = st + x.shape[ax] if st < 0 else st
+        en = en + x.shape[ax] if en < 0 else min(en, x.shape[ax])
+        keep &= (ind[ax] >= st) & (ind[ax] < en)
+        new_shape[ax] = en - st
+    ind = ind[:, keep].copy()
+    for ax, st, _ in zip(axes, starts, ends):
+        st = st + x.shape[ax] if st < 0 else st
+        ind[ax] -= st
+    return SparseCooTensor(jnp.asarray(ind), jnp.asarray(val[keep]), new_shape)

@@ -34,3 +34,7 @@ class InputSpec:
 
     def unbatch(self):
         return InputSpec(self.shape[1:], self.dtype, self.name)
+
+
+from .extras import *  # noqa: E402,F401,F403
+from .extras import __all__ as _extras_all  # noqa: E402

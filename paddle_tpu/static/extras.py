@@ -1,0 +1,462 @@
+"""Static-graph API surface (ref: python/paddle/static/__init__.py).
+
+Design note: the reference's Program/Executor stack is a graph IR + C++
+interpreter; on TPU that role is played by jax tracing + XLA. This module
+keeps the reference's static API *names and call patterns* working by
+backing them with the traced-function machinery:
+
+* a `Program` records `to_static`-style callables and their parameters,
+* `Executor.run` executes a traced program (or an inference artifact),
+* `save/load_inference_model` bridge to the StableHLO deploy path
+  (paddle_tpu.inference),
+* pure utilities (EMA, gradients, py_func, places, metrics) are real.
+
+IPU-specific entries raise — no such hardware path on TPU (SURVEY §2
+out-of-scope list).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, Parameter, as_tensor_data, wrap
+from . import InputSpec
+
+__all__ = [
+    "append_backward", "gradients", "Executor", "global_scope", "scope_guard",
+    "BuildStrategy", "CompiledProgram", "ExecutionStrategy", "Print",
+    "py_func", "name_scope", "program_guard", "WeightNormParamAttr",
+    "ExponentialMovingAverage", "default_main_program",
+    "default_startup_program", "Program", "data", "Variable",
+    "save_inference_model", "load_inference_model", "serialize_program",
+    "serialize_persistables", "save_to_file", "deserialize_program",
+    "deserialize_persistables", "load_from_file", "normalize_program",
+    "load_program_state", "set_program_state", "cpu_places", "cuda_places",
+    "xpu_places", "create_global_var", "create_parameter", "accuracy", "auc",
+    "device_guard", "ipu_shard_guard", "IpuCompiledProgram", "IpuStrategy",
+    "set_ipu_shard", "ctr_metric_bundle", "exponential_decay", "save", "load",
+]
+
+
+class Variable(InputSpec):
+    """Placeholder variable (static.data result). Carries name/shape/dtype;
+    feeding happens by name through Executor.run."""
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return Variable([d if d is not None else -1 for d in shape], dtype, name)
+
+
+class Program:
+    """Recorded computation: a list of (name, traced callable) plus state.
+    XLA is the optimizer/scheduler; this object is the user-facing handle."""
+
+    def __init__(self):
+        self.functions = {}
+        self.state = {}
+        self.random_seed = 0
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.functions = dict(self.functions)
+        p.state = dict(self.state)
+        return p
+
+    def global_block(self):
+        return self
+
+    # block-protocol shims used by reference-style code
+    @property
+    def blocks(self):
+        return [self]
+
+    def state_dict(self, mode="all", scope=None):
+        return dict(self.state)
+
+    def set_state_dict(self, sd, scope=None):
+        self.state.update(sd)
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    old = (_default_main, _default_startup)
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = old
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+class Executor:
+    """Runs traced callables / loaded inference artifacts. `place` is kept
+    for signature parity; XLA chooses the backend."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kw):
+        feed = feed or {}
+        if hasattr(program, "run"):  # Predictor from load_inference_model
+            outs = program.run(*feed.values())
+            return outs
+        if isinstance(program, Program) and program.functions:
+            results = []
+            for fn in program.functions.values():
+                results.append(fn(**feed))
+            return results
+        if callable(program):
+            return program(**feed)
+        return []
+
+
+class BuildStrategy:
+    """Config shell (XLA performs fusion/memory planning internally)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["program"], name)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Dygraph-backed: runs autograd and returns (param, grad) pairs."""
+    loss.backward()
+    params = parameter_list or []
+    return [(p, p.grad) for p in params]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd.engine import grad as _grad
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug print that also works under jit (jax.debug.print)."""
+    a = as_tensor_data(input)
+    jax.debug.print((message or "") + " {x}", x=a)
+    return wrap(a)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host callback op (ref static.py_func) via jax.pure_callback."""
+    xs = [as_tensor_data(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
+    sample = out if not isinstance(out, (list, tuple)) else out[0]
+    sds = jax.ShapeDtypeStruct(tuple(sample.shape), jnp.dtype(sample.dtype))
+    res = jax.pure_callback(lambda *a: np.asarray(func(*a)), sds, *xs)
+    return wrap(res)
+
+
+class WeightNormParamAttr:
+    """ref: static.WeightNormParamAttr — carried metadata; weight-norm
+    reparameterization is applied by nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters with bias correction
+    (ref: static/ema.py). apply()/restore() swap shadow weights in/out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._step = 0
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def register(self, parameters):
+        self._params = list(parameters)
+        for p in self._params:
+            self._shadow[id(p)] = jnp.array(p._data)
+
+    def update(self, parameters=None):
+        if parameters is not None and not self._params:
+            self.register(parameters)
+        self._step += 1
+        d = min(self.decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            prev = self._shadow.get(id(p), p._data)
+            self._shadow[id(p)] = d * prev + (1 - d) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._data = self._shadow[id(p)].astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}
+
+
+# -- deploy bridge ----------------------------------------------------------
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kw):
+    """Bridge to the StableHLO deploy path: `program` (or fetch_vars[0]'s
+    bound layer) must be a Layer; feed_vars carry the input specs."""
+    from .. import inference as inf
+    layer = kw.get("layer") or program
+    if layer is None or not hasattr(layer, "forward"):
+        raise ValueError(
+            "save_inference_model needs the Layer (pass program=layer); the "
+            "graph-free reference signature cannot be reconstructed from "
+            "fetch_vars under eager tracing")
+    specs = [v if isinstance(v, InputSpec) else
+             InputSpec(v.shape, v.dtype, getattr(v, "name", None))
+             for v in feed_vars]
+    inf.save_inference_model(path_prefix, layer, specs)
+
+
+def load_inference_model(path_prefix, executor=None, **kw):
+    from .. import inference as inf
+    pred = inf.load_inference_model(path_prefix)
+    feeds = pred.get_input_names()
+    return [pred, feeds, [f"out{i}" for i in range(1)]]
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None, **kw):
+    import pickle
+    return pickle.dumps({"type": "paddle_tpu-program",
+                         "state": getattr(program, "state", {})})
+
+
+def deserialize_program(data):
+    import pickle
+    blob = pickle.loads(data)
+    p = Program()
+    p.state = blob.get("state", {})
+    return p
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None, **kw):
+    import pickle
+    state = getattr(program, "state", {})
+    return pickle.dumps({k: np.asarray(jax.device_get(as_tensor_data(v)))
+                         for k, v in state.items()})
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    program.state = pickle.loads(data)
+    return program
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars=None, fetch_vars=None, **kw):
+    return program
+
+
+def save(program, model_path, protocol=4, **kw):
+    save_to_file(model_path + ".pdmodel", serialize_program(program=program))
+    save_to_file(model_path + ".pdparams",
+                 serialize_persistables(program=program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    deserialize_persistables(program,
+                             load_from_file(model_path + ".pdparams"))
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+    return pickle.loads(load_from_file(model_path + ".pdparams"))
+
+
+def set_program_state(program, state_dict):
+    program.state = dict(state_dict)
+
+
+# -- places / metrics / misc -------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..framework.device import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.device import TPUPlace
+    ids = device_ids if device_ids is not None else range(jax.device_count())
+    return [TPUPlace() for _ in ids]
+
+
+def xpu_places(device_ids=None):
+    raise NotImplementedError("XPU is out of scope on the TPU build "
+                              "(SURVEY §2 not-rebuilt list)")
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    return Tensor(jnp.full(tuple(shape), value, dtype))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..framework.extras import create_parameter as _cp
+    return _cp(shape, dtype, name, attr, is_bias, default_initializer)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (ref: static/nn/metric.py accuracy)."""
+    logits = as_tensor_data(input)
+    lab = as_tensor_data(label).reshape(-1)
+    topk = jnp.argsort(-logits, axis=-1)[:, :k]
+    hit = jnp.any(topk == lab[:, None], axis=-1)
+    return wrap(jnp.mean(hit.astype(jnp.float32)))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC via the trapezoid rule over score-sorted thresholds."""
+    score = np.asarray(jax.device_get(as_tensor_data(input)))
+    if score.ndim == 2 and score.shape[1] == 2:
+        score = score[:, 1]
+    y = np.asarray(jax.device_get(as_tensor_data(label))).reshape(-1)
+    order = np.argsort(-score.reshape(-1))
+    y_sorted = y[order]
+    tps = np.cumsum(y_sorted)
+    fps = np.cumsum(1 - y_sorted)
+    tpr = tps / max(tps[-1], 1)
+    fpr = fps / max(fps[-1], 1)
+    a = float(np.trapezoid(tpr, fpr))
+    t = wrap(jnp.asarray(a, jnp.float32))
+    return t, t, [t]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    pred = as_tensor_data(input).reshape(-1)
+    lab = as_tensor_data(label).reshape(-1).astype(jnp.float32)
+    sqrerr = jnp.sum((pred - lab) ** 2)
+    abserr = jnp.sum(jnp.abs(pred - lab))
+    prob = jnp.sum(pred)
+    q = jnp.sum(pred)
+    pos = jnp.sum(lab)
+    total = jnp.asarray(pred.shape[0], jnp.float32)
+    return tuple(wrap(v) for v in (sqrerr, abserr, prob, q, pos, total))
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    from ..optimizer.lr import ExponentialDecay
+    # static-graph helper returns a scheduler in our world
+    return ExponentialDecay(learning_rate, decay_rate)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """The reference pins ops to a device inside a program; XLA owns
+    placement. Context preserved for API parity."""
+    yield
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU is out of scope on the TPU build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU is out of scope on the TPU build")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU is out of scope on the TPU build")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("IPU is out of scope on the TPU build")

@@ -15,9 +15,11 @@ import numpy as np
 from ..tensor_impl import Tensor, as_tensor_data
 from ..dispatch import apply as _apply, apply_inplace
 from . import creation, random, math, manipulation, linalg, logic, search, stat
+from . import extras
 from .einsum import einsum  # noqa: F401
 
 from .creation import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403

@@ -1,0 +1,264 @@
+"""Remaining tensor-op surface (ref: python/paddle/tensor/math.py,
+manipulation.py, creation.py — the long tail of the reference's top-level
+namespace). All jnp/lax compositions: jit/grad-compatible, fused by XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatch import apply as _apply
+from ..tensor_impl import Tensor, as_tensor_data
+
+__all__ = [
+    "logcumsumexp", "logit", "complex", "cdist", "increment", "tensordot",
+    "add_n", "diff", "renorm", "sgn", "take", "frexp", "trapezoid",
+    "cumulative_trapezoid", "polar", "vander", "unflatten", "i0", "i0e",
+    "i1", "i1e", "polygamma", "vsplit", "reverse", "shard_index", "tolist",
+    "tanh_", "ldexp", "nextafter", "heaviside", "hypot", "combinations",
+]
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(dtype)
+        if axis is None:
+            return jax.lax.cumlogsumexp(a.reshape(-1), axis=0)
+        return jax.lax.cumlogsumexp(a, axis=axis)
+    return _apply(f, x, op_name="logcumsumexp")
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        out = jnp.log(a / (1.0 - a))
+        if eps is None:
+            out = jnp.where((a < 0) | (a > 1), jnp.nan, out)
+        return out
+    return _apply(f, x, op_name="logit")
+
+
+def complex(real, imag, name=None):
+    return _apply(lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def polar(abs, angle, name=None):
+    return _apply(lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+                  abs, angle)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distances [..., P, M] between [..., P, D], [..., M, D].
+    p==2 rides the MXU via the ||x||²+||y||²-2xy expansion."""
+    def f(a, b):
+        if p == 2.0 and "use_mm" in compute_mode:
+            a2 = jnp.sum(a * a, axis=-1, keepdims=True)        # [..., P, 1]
+            b2 = jnp.sum(b * b, axis=-1)[..., None, :]         # [..., 1, M]
+            ab = jnp.matmul(a, jnp.swapaxes(b, -1, -2))        # [..., P, M]
+            return jnp.sqrt(jnp.maximum(a2 + b2 - 2 * ab, 0.0))
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype), axis=-1)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), axis=-1)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return _apply(f, x, y, op_name="cdist")
+
+
+def increment(x, value=1.0, name=None):
+    """In-place scalar add (returns x, ref: tensor/math.py increment)."""
+    from ..dispatch import apply_inplace
+    return apply_inplace(x, lambda a: a + value, x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    def norm_axes(ax):
+        if isinstance(ax, (list, tuple)):
+            a0, a1 = ax
+            a0 = [a0] if isinstance(a0, int) else list(a0)
+            a1 = [a1] if isinstance(a1, int) else list(a1)
+            return (tuple(a0), tuple(a1))
+        return int(ax)
+    return _apply(lambda a, b: jnp.tensordot(a, b, axes=norm_axes(axes)),
+                  x, y, op_name="matmul")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    return _apply(lambda *ts: sum(ts[1:], ts[0]), *inputs)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [t for t in (prepend, append) if t is not None]
+
+    def f(a, *rest):
+        i = 0
+        pre = rest[i] if prepend is not None else None
+        if prepend is not None:
+            i += 1
+        app = rest[i] if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    return _apply(f, x, *args, op_name="diff")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clip the p-norm of every slice along `axis` to max_norm."""
+    def f(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return _apply(f, x, op_name="renorm")
+
+
+def sgn(x, name=None):
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+    return _apply(f, x, op_name="sgn")
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather over the flattened tensor."""
+    def f(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx2 = idx % n
+        elif mode == "clip":
+            idx2 = jnp.clip(idx, 0, n - 1)
+        else:  # raise: negative python-style indexing, no bounds check in jit
+            idx2 = jnp.where(idx < 0, idx + n, idx)
+        return jnp.take(flat, idx2.astype(jnp.int32)).reshape(idx.shape)
+    return _apply(f, x, index, op_name="take")
+
+
+def frexp(x, name=None):
+    return _apply(lambda a: jnp.frexp(a), x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    args = [x] if x is not None else []
+
+    def f(a, *rest):
+        xs = rest[0] if rest else None
+        return jnp.trapezoid(a, x=xs, dx=1.0 if dx is None else dx, axis=axis)
+    return _apply(f, y, *args, op_name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    args = [x] if x is not None else []
+
+    def f(a, *rest):
+        d = jnp.moveaxis(a, axis, -1)
+        if rest:
+            xs = jnp.moveaxis(rest[0], axis, -1) if rest[0].ndim == a.ndim \
+                else rest[0]
+            dxs = jnp.diff(xs, axis=-1)
+        else:
+            dxs = 1.0 if dx is None else dx
+        avg = (d[..., 1:] + d[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * dxs, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+    return _apply(f, y, *args, op_name="trapezoid")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return _apply(lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+def unflatten(x, axis, shape, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        tgt = tuple(int(s) for s in shape)
+        return a.reshape(a.shape[:ax] + tgt + a.shape[ax + 1:])
+    return _apply(f, x, op_name="reshape")
+
+
+def i0(x, name=None):
+    return _apply(lambda a: jax.scipy.special.i0(a), x)
+
+
+def i0e(x, name=None):
+    return _apply(lambda a: jax.scipy.special.i0e(a), x)
+
+
+def i1(x, name=None):
+    return _apply(lambda a: jax.scipy.special.i1(a), x)
+
+
+def i1e(x, name=None):
+    return _apply(lambda a: jax.scipy.special.i1e(a), x)
+
+
+def polygamma(x, n, name=None):
+    return _apply(lambda a: jax.scipy.special.polygamma(int(n), a), x)
+
+
+def ldexp(x, y, name=None):
+    return _apply(lambda a, b: a * (2.0 ** b.astype(jnp.float32)), x, y)
+
+
+def nextafter(x, y, name=None):
+    return _apply(lambda a, b: jnp.nextafter(a, b), x, y)
+
+
+def heaviside(x, y, name=None):
+    return _apply(lambda a, b: jnp.heaviside(a, b), x, y)
+
+
+def hypot(x, y, name=None):
+    return _apply(lambda a, b: jnp.hypot(a, b), x, y)
+
+
+def vsplit(x, num_or_indices, name=None):
+    def f(a):
+        assert a.ndim >= 2, "vsplit expects ndim >= 2"
+        return tuple(jnp.split(a, num_or_indices, axis=0))
+    return list(_apply(f, x))
+
+
+def reverse(x, axis, name=None):
+    ax = [axis] if isinstance(axis, int) else list(axis)
+    return _apply(lambda a: jnp.flip(a, axis=tuple(ax)), x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Relabel class ids for a sharded classifier (ref: tensor/math.py
+    shard_index): ids owned by this shard map to [0, shard_size), others to
+    ignore_value."""
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def f(a):
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        inside = (a >= lo) & (a < hi)
+        return jnp.where(inside, a - lo, ignore_value)
+    return _apply(f, input, op_name="shard_index")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    a = as_tensor_data(x)
+    n = a.shape[0]
+    gen = (itertools.combinations_with_replacement(range(n), r)
+           if with_replacement else itertools.combinations(range(n), r))
+    idx = np.array(list(gen), np.int32).reshape(-1, r)
+    return _apply(lambda v: jnp.take(v, idx, axis=0), x)
+
+
+def tolist(x):
+    return np.asarray(jax.device_get(as_tensor_data(x))).tolist()
+
+
+def tanh_(x, name=None):
+    from ..dispatch import apply_inplace
+    return apply_inplace(x, lambda a: jnp.tanh(a), x)

@@ -11,3 +11,16 @@ def set_image_backend(backend):
 
 def get_image_backend():
     return "numpy"
+
+
+def image_load(path, backend=None):
+    """Load an image file (ref: vision/image.py). PIL when present, else a
+    raw-npy fallback (zero-dependency environments)."""
+    try:
+        from PIL import Image
+        return Image.open(path)
+    except ImportError:
+        import numpy as np
+        if str(path).endswith(".npy"):
+            return np.load(path)
+        raise

@@ -1,0 +1,64 @@
+"""Pallas flash-attention backward kernel numerics (interpret mode on CPU)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.blockwise_attention import blockwise_attention
+from paddle_tpu.ops.pallas_kernels.flash_attention import (
+    flash_attention_interpret,
+)
+from paddle_tpu.ops.pallas_kernels.flash_attention_bwd import (
+    flash_attention_backward,
+)
+
+
+def _make(B=1, S=256, H=2, D=64, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 4)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, H, D), jnp.float32)
+    g = jax.random.normal(ks[3], (B, S, H, D), jnp.float32)
+    return q, k, v, g
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_fwd_bwd_vs_xla(causal):
+    q, k, v, g = _make()
+    B, S, H, D = q.shape
+
+    out, (qb, kb, vb, ob, lse, scale) = flash_attention_interpret(
+        q, k, v, causal=causal, block_q=128, block_k=128)
+    ref_out = blockwise_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=2e-5, atol=2e-5)
+
+    Dp = qb.shape[-1]
+    gb = jnp.pad(g, ((0, 0), (0, 0), (0, 0), (0, Dp - D)))
+    gb = gb.transpose(0, 2, 1, 3).reshape(B * H, S, Dp)
+    dqb, dkb, dvb = flash_attention_backward(qb, kb, vb, ob, lse, gb, scale,
+                                             causal, block_q=128, block_k=128,
+                                             interpret=True)
+
+    _, pullback = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal),
+        q, k, v)
+    rdq, rdk, rdv = pullback(g)
+
+    def from_bh(x):
+        return np.asarray(x.reshape(B, H, S, Dp).transpose(0, 2, 1, 3)[..., :D])
+
+    np.testing.assert_allclose(from_bh(dvb), np.asarray(rdv), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(from_bh(dkb), np.asarray(rdk), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(from_bh(dqb), np.asarray(rdq), rtol=2e-4, atol=2e-4)
+
+
+def test_lse_matches_dense():
+    q, k, v, _ = _make(seed=3)
+    _, (qb, kb, vb, ob, lse, scale) = flash_attention_interpret(
+        q, k, v, causal=False, block_q=128, block_k=128)
+    s = jnp.einsum("bqd,bkd->bqk", qb.astype(jnp.float32),
+                   kb.astype(jnp.float32)) * scale
+    ref_lse = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=1e-5, atol=1e-5)
