@@ -67,6 +67,7 @@ from . import geometric  # noqa: E402,F401
 from . import text  # noqa: E402,F401
 from . import audio  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
+from . import hub  # noqa: E402,F401
 from . import sysconfig  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401

@@ -1,0 +1,50 @@
+"""Model hub (ref: python/paddle/hub.py).
+
+Zero-egress environment: `github`/`gitee` sources are unavailable; `local`
+source loads a hubconf.py from a directory — same entrypoint contract as the
+reference (callables listed in hubconf, `dependencies` checked).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for dep in getattr(mod, "dependencies", []):
+        importlib.import_module(dep)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network egress; this build supports "
+            f"source='local' (a directory containing {_HUBCONF})")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False, **kwargs):
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model)(*args, **kwargs)

@@ -51,9 +51,9 @@ def forward_grad(func, xs, v=None):
 
 
 def grad(func, xs, v=None):
-    """Reverse-mode gradient of a scalar-output function."""
-    _, pullback = vjp(func, xs)
-    return pullback if v is None else pullback
+    """Reverse-mode gradient; `v` seeds the cotangent (ones when omitted)."""
+    _, grads = vjp(func, xs, v)
+    return grads
 
 
 def enable_prim():

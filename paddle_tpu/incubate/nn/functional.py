@@ -116,26 +116,37 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight,
                                linear_bias=None, pre_layer_norm=False,
                                ln_scale=None, ln_bias=None, ln_epsilon=1e-5,
-                               attn_mask=None, dropout_rate=0.0,
-                               attn_dropout_rate=0.0, training=True,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, training=True,
                                name=None, **_):
     """Fused MHA block (ref: fused_transformer.py fused_multi_head_attention):
-    [pre-LN] → qkv proj → attention (flash on TPU) → out proj (+residual).
+    pre-LN → qkv proj → attention → dropout → out proj → residual (+post-LN
+    when pre_layer_norm=False, matching the reference's default path).
     qkv_weight: [3, H, D, hidden]; x: [B, S, hidden]."""
-    from ...nn import functional as F
+    from ...framework.random import next_key
+
+    keys = []
+    if training and attn_dropout_rate > 0:
+        keys.append(next_key())
+    if training and dropout_rate > 0:
+        keys.append(next_key())
+
+    def ln(h):
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.var(h, axis=-1, keepdims=True)
+        out = (h - mu) * jax.lax.rsqrt(var + ln_epsilon)
+        return out
 
     def f(xv, qkvw, qkvb, lw, *rest):
-        h = xv
         idx = 0
         lns = lnb = None
+        if ln_scale is not None:
+            lns = rest[idx]; idx += 1
+        if ln_bias is not None:
+            lnb = rest[idx]; idx += 1
+        h = xv
         if pre_layer_norm:
-            if ln_scale is not None:
-                lns = rest[idx]; idx += 1
-            if ln_bias is not None:
-                lnb = rest[idx]; idx += 1
-            mu = jnp.mean(h, axis=-1, keepdims=True)
-            var = jnp.var(h, axis=-1, keepdims=True)
-            h = (h - mu) * jax.lax.rsqrt(var + ln_epsilon)
+            h = ln(h)
             if lns is not None:
                 h = h * lns
             if lnb is not None:
@@ -151,16 +162,31 @@ def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight,
         if attn_mask is not None:
             s = s + as_tensor_data(attn_mask).astype(s.dtype)
         p = jax.nn.softmax(s, axis=-1)
+        ki = 0
+        if training and attn_dropout_rate > 0:
+            keep = jax.random.bernoulli(keys[ki], 1 - attn_dropout_rate, p.shape)
+            p = jnp.where(keep, p / (1 - attn_dropout_rate), 0.0)
+            ki += 1
         ctx = jnp.einsum("bhqk,bkhd->bqhd", p, vh).reshape(B, S, n_head * head_dim)
         out = ctx @ lw
         if linear_bias is not None:
             out = out + rest[-1]
-        return xv + out  # residual add
+        if training and dropout_rate > 0:
+            keep = jax.random.bernoulli(keys[ki], 1 - dropout_rate, out.shape)
+            out = jnp.where(keep, out / (1 - dropout_rate), 0.0)
+        res = xv + out
+        if not pre_layer_norm:
+            res = ln(res)
+            if lns is not None:
+                res = res * lns
+            if lnb is not None:
+                res = res + lnb
+        return res
 
     args = [x, qkv_weight, qkv_bias, linear_weight]
-    if pre_layer_norm and ln_scale is not None:
+    if ln_scale is not None:
         args.append(ln_scale)
-    if pre_layer_norm and ln_bias is not None:
+    if ln_bias is not None:
         args.append(ln_bias)
     if linear_bias is not None:
         args.append(linear_bias)
@@ -173,10 +199,15 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
                       dropout2_rate=0.5, activation="relu",
                       ln1_epsilon=1e-5, ln2_epsilon=1e-5,
                       pre_layer_norm=False, training=True, name=None, **_):
-    """Fused FFN block: [pre-LN] → linear → act → linear (+residual, post-LN)
-    (ref: fused_transformer.py fused_feedforward). Dropout omitted from the
-    fused trace when rate==0 or eval."""
+    """Fused FFN block: [pre-LN] → linear → act → dropout → linear → dropout
+    → residual [+post-LN] (ref: fused_transformer.py fused_feedforward)."""
+    from ...framework.random import next_key
     act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
+    keys = []
+    if training and dropout1_rate > 0:
+        keys.append(next_key())
+    if training and dropout2_rate > 0:
+        keys.append(next_key())
 
     def ln(h, scale, bias, eps):
         mu = jnp.mean(h, axis=-1, keepdims=True)
@@ -203,9 +234,17 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         if b1 is not None:
             h = h + b1
         h = act(h)
+        ki = 0
+        if training and dropout1_rate > 0:
+            keep = jax.random.bernoulli(keys[ki], 1 - dropout1_rate, h.shape)
+            h = jnp.where(keep, h / (1 - dropout1_rate), 0.0)
+            ki += 1
         h = h @ w2
         if b2 is not None:
             h = h + b2
+        if training and dropout2_rate > 0:
+            keep = jax.random.bernoulli(keys[ki], 1 - dropout2_rate, h.shape)
+            h = jnp.where(keep, h / (1 - dropout2_rate), 0.0)
         out = xv + h
         if not pre_layer_norm:
             out = ln(out, s2, sb2, ln2_epsilon)

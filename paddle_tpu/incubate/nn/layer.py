@@ -26,6 +26,7 @@ class FusedMultiHeadAttention(Layer):
         self.head_dim = embed_dim // num_heads
         self.normalize_before = normalize_before
         self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
         self.epsilon = epsilon
         self.qkv_weight = self.create_parameter(
             (3, num_heads, self.head_dim, embed_dim), default_initializer=XavierUniform())
@@ -47,7 +48,8 @@ class FusedMultiHeadAttention(Layer):
             self.linear_bias, pre_layer_norm=self.normalize_before,
             ln_scale=self.pre_ln_scale, ln_bias=self.pre_ln_bias,
             ln_epsilon=self.epsilon, attn_mask=attn_mask,
-            dropout_rate=self.dropout_rate, training=self.training)
+            dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate, training=self.training)
 
 
 class FusedFeedForward(Layer):

@@ -65,18 +65,31 @@ class ModelAverage(Optimizer):
         self.max_average_window = max_average_window
         self._sum = {id(p): jnp.zeros_like(p._data) for p in self._parameter_list}
         self._cnt = 0
+        self._old_sum = {}
+        self._old_cnt = 0
         self._backup = None
 
     def step(self):
+        # sliding-window approximation matching the reference's accumulator
+        # swap: when the live window fills, it becomes the "old" block and a
+        # fresh accumulator starts; apply() averages over both blocks.
+        if self._cnt >= self.max_average_window:
+            self._old_sum = dict(self._sum)
+            self._old_cnt = self._cnt
+            self._sum = {id(p): jnp.zeros_like(p._data)
+                         for p in self._parameter_list}
+            self._cnt = 0
         for p in self._parameter_list:
             self._sum[id(p)] = self._sum[id(p)] + p._data
-        self._cnt = min(self._cnt + 1, self.max_average_window)
+        self._cnt += 1
 
     def apply(self, executor=None, need_restore=True):
         self._backup = {id(p): p._data for p in self._parameter_list}
+        total = self._cnt + self._old_cnt
         for p in self._parameter_list:
-            if self._cnt:
-                p._data = (self._sum[id(p)] / self._cnt).astype(p._data.dtype)
+            if total:
+                acc = self._sum[id(p)] + self._old_sum.get(id(p), 0)
+                p._data = (acc / total).astype(p._data.dtype)
         if not need_restore:
             self._backup = None
 

@@ -74,7 +74,13 @@ def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size,
                 data_format):
     """Scatter pooled values back to the positions recorded by max_pool's
     argmax indices (flat per-channel spatial index, reference convention)."""
+    channel_last = not data_format.upper().startswith("NC")
+
     def f(a, idx):
+        if channel_last:
+            perm = (0, a.ndim - 1) + tuple(range(1, a.ndim - 1))
+            a = jnp.transpose(a, perm)
+            idx = jnp.transpose(idx, perm)
         spatial = a.shape[2:]
         if output_size is not None:
             out_sp = tuple(int(s) for s in output_size[-nd:])
@@ -93,7 +99,10 @@ def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size,
         iv = idx.reshape(N, C, -1).astype(jnp.int32)
         out = jax.vmap(jax.vmap(lambda dest, vals, ii:
                                 dest.at[ii].set(vals)))(flat, av, iv)
-        return out.reshape((N, C) + out_sp)
+        out = out.reshape((N, C) + out_sp)
+        if channel_last:
+            out = jnp.transpose(out, (0,) + tuple(range(2, out.ndim)) + (1,))
+        return out
     return _apply(f, x, indices)
 
 

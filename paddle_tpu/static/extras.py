@@ -392,12 +392,10 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):
-    """Top-k accuracy (ref: static/nn/metric.py accuracy)."""
-    logits = as_tensor_data(input)
-    lab = as_tensor_data(label).reshape(-1)
-    topk = jnp.argsort(-logits, axis=-1)[:, :k]
-    hit = jnp.any(topk == lab[:, None], axis=-1)
-    return wrap(jnp.mean(hit.astype(jnp.float32)))
+    """Top-k accuracy (ref: static/nn/metric.py accuracy) — delegates to the
+    functional metric helper."""
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
 
 
 def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,

@@ -216,8 +216,7 @@ class RandomRotation(BaseTransform):
     def _apply_image(self, img):
         arr = _to_numpy(img)
         deg = self.degrees[0] + _rand() * (self.degrees[1] - self.degrees[0])
-        k = int(round(deg / 90.0)) % 4  # coarse rotation (host-side, no scipy)
-        return np.rot90(arr, k=k, axes=(0, 1)).copy()
+        return rotate(arr, deg)
 
 
 class Pad(BaseTransform):
@@ -261,3 +260,351 @@ def center_crop(img, output_size):
 
 def crop(img, top, left, height, width):
     return _to_numpy(img)[top:top + height, left:left + width]
+
+
+# -- geometric + photometric functional ops (ref: vision/transforms/
+#    functional.py; cv2/PIL backends replaced by a numpy inverse-map
+#    bilinear sampler — host-side preprocessing, device never involved) ----
+
+def _inverse_map_sample(arr, inv, out_h=None, out_w=None, interpolation="bilinear",
+                        fill=0):
+    """Sample arr (H, W[, C]) at positions inv @ [x_out, y_out, 1]."""
+    H, W = arr.shape[:2]
+    oh, ow = out_h or H, out_w or W
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1)
+    mapped = inv @ coords
+    if inv.shape[0] == 3:  # perspective: divide by w
+        mapped = mapped[:2] / np.maximum(np.abs(mapped[2:3]), 1e-9) \
+            * np.sign(mapped[2:3])
+    eps = 1e-4  # tolerate trig round-off at exact-gridpoint mappings
+    sx = np.clip(mapped[0].reshape(oh, ow), -1 - eps, W)
+    sy = np.clip(mapped[1].reshape(oh, ow), -1 - eps, H)
+    valid = (sx >= -eps) & (sx <= W - 1 + eps) & (sy >= -eps) & (sy <= H - 1 + eps)
+    sx = np.clip(sx, 0, W - 1)
+    sy = np.clip(sy, 0, H - 1)
+    if interpolation == "nearest":
+        xi = np.clip(np.round(sx), 0, W - 1).astype(np.int64)
+        yi = np.clip(np.round(sy), 0, H - 1).astype(np.int64)
+        out = arr[yi, xi].astype(np.float32)
+    else:
+        x0 = np.clip(np.floor(sx), 0, W - 1).astype(np.int64)
+        y0 = np.clip(np.floor(sy), 0, H - 1).astype(np.int64)
+        x1 = np.clip(x0 + 1, 0, W - 1)
+        y1 = np.clip(y0 + 1, 0, H - 1)
+        wx = (sx - x0).astype(np.float32)
+        wy = (sy - y0).astype(np.float32)
+        if arr.ndim == 3:
+            wx, wy = wx[..., None], wy[..., None]
+        out = (arr[y0, x0] * (1 - wy) * (1 - wx) + arr[y0, x1] * (1 - wy) * wx
+               + arr[y1, x0] * wy * (1 - wx) + arr[y1, x1] * wy * wx)
+    mask = valid if arr.ndim == 2 else valid[..., None]
+    out = np.where(mask, out, np.float32(fill))
+    return out.astype(arr.dtype) if arr.dtype == np.uint8 else out
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    import math
+    rot = math.radians(angle)
+    sx, sy = [math.radians(s) for s in shear]
+    cx, cy = center
+    tx, ty = translate
+    # forward matrix M = T(center) R S Sh T(-center) + translate
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    M = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]], np.float64)
+    M[0, 2] = cx + tx - M[0, 0] * cx - M[0, 1] * cy
+    M[1, 2] = cy + ty - M[1, 0] * cx - M[1, 1] * cy
+    return M
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine-transform an HWC image (ref functional.affine)."""
+    arr = _to_numpy(img)
+    H, W = arr.shape[:2]
+    if center is None:
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    M = _affine_matrix(angle, translate, scale, shear, center)
+    inv = np.linalg.inv(M)[:2]
+    return _inverse_map_sample(arr, inv, interpolation=interpolation, fill=fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate an HWC image by angle degrees counter-clockwise."""
+    arr = _to_numpy(img)
+    H, W = arr.shape[:2]
+    if center is None:
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    out_h, out_w = H, W
+    if expand:
+        import math
+        rad = math.radians(angle)
+        out_w = int(abs(W * math.cos(rad)) + abs(H * math.sin(rad)) + 0.5)
+        out_h = int(abs(W * math.sin(rad)) + abs(H * math.cos(rad)) + 0.5)
+    M = _affine_matrix(-angle, (0, 0), 1.0, (0.0, 0.0), center)
+    if expand:
+        M[0, 2] += (out_w - W) * 0.5
+        M[1, 2] += (out_h - H) * 0.5
+    inv = np.linalg.inv(M)[:2]
+    return _inverse_map_sample(arr, inv, out_h, out_w, interpolation, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Projective warp mapping startpoints -> endpoints (ref functional)."""
+    arr = _to_numpy(img)
+    A = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+    b = np.array([p for s in startpoints for p in s], np.float64)
+    h = np.linalg.solve(np.array(A, np.float64), b)
+    inv = np.concatenate([h, [1.0]]).reshape(3, 3)
+    return _inverse_map_sample(arr, inv, interpolation=interpolation, fill=fill)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _to_numpy(img)
+    p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    cfg = ((p[1], p[3]), (p[0], p[2])) + ((0, 0),) * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, cfg, constant_values=fill)
+    return np.pad(arr, cfg, mode={"reflect": "reflect", "edge": "edge",
+                                  "symmetric": "symmetric"}[padding_mode])
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase a region with value v. Works on HWC/CHW numpy or Tensor."""
+    if isinstance(img, Tensor):
+        arr = np.array(img._data)
+        arr[..., i:i + h, j:j + w] = v
+        return Tensor(arr)
+    arr = _to_numpy(img) if inplace is False else img
+    arr = np.array(arr)
+    if arr.ndim == 3 and arr.shape[0] in (1, 3):  # CHW
+        arr[:, i:i + h, j:j + w] = v
+    else:  # HWC
+        arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _to_numpy(img).astype(np.float32)
+    gray = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return out.astype(_to_numpy(img).dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _to_numpy(img).astype(np.float32)
+    hi = 255 if _to_numpy(img).dtype == np.uint8 or arr.max() > 1.5 else 1.0
+    return np.clip(arr * brightness_factor, 0, hi).astype(_to_numpy(img).dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _to_numpy(img).astype(np.float32)
+    hi = 255 if _to_numpy(img).dtype == np.uint8 or arr.max() > 1.5 else 1.0
+    mean = to_grayscale(arr)[..., 0].mean()
+    return np.clip((arr - mean) * contrast_factor + mean, 0,
+                   hi).astype(_to_numpy(img).dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _to_numpy(img).astype(np.float32)
+    hi = 255 if _to_numpy(img).dtype == np.uint8 or arr.max() > 1.5 else 1.0
+    gray = to_grayscale(arr)
+    return np.clip(arr * saturation_factor + gray.astype(np.float32)
+                   * (1 - saturation_factor), 0, hi).astype(_to_numpy(img).dtype)
+
+
+def _rgb_to_hsv(arr):
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = np.max(arr, -1)
+    minc = np.min(arr, -1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-9), 0)
+    rc = (maxc - r) / np.maximum(d, 1e-9)
+    gc = (maxc - g) / np.maximum(d, 1e-9)
+    bc = (maxc - b) / np.maximum(d, 1e-9)
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(d == 0, 0.0, (h / 6.0) % 1.0)
+    return np.stack([h, s, v], -1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(np.int64) % 6
+    table = np.stack([
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1)], 0)
+    return np.take_along_axis(table, i[None, ..., None], 0)[0]
+
+
+def adjust_hue(img, hue_factor):
+    assert -0.5 <= hue_factor <= 0.5
+    src = _to_numpy(img)
+    scale = 255.0 if src.dtype == np.uint8 or src.max() > 1.5 else 1.0
+    hsv = _rgb_to_hsv(src.astype(np.float32) / scale)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    out = _hsv_to_rgb(hsv) * scale
+    return np.clip(out, 0, scale).astype(src.dtype)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + (2 * _rand() - 1) * self.value
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        assert 0 <= value <= 0.5
+        self.value = value
+
+    def _apply_image(self, img):
+        f = (2 * _rand() - 1) * self.value
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in random order
+    (ref transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.tfs = []
+        if brightness:
+            self.tfs.append(BrightnessTransform(brightness))
+        if contrast:
+            self.tfs.append(ContrastTransform(contrast))
+        if saturation:
+            self.tfs.append(SaturationTransform(saturation))
+        if hue:
+            self.tfs.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = np.argsort([_rand() for _ in self.tfs])
+        for i in order:
+            img = self.tfs[i]._apply_image(img)
+        return img
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        H, W = arr.shape[:2]
+        ang = self.degrees[0] + _rand() * (self.degrees[1] - self.degrees[0])
+        tx = ty = 0.0
+        if self.translate:
+            tx = (2 * _rand() - 1) * self.translate[0] * W
+            ty = (2 * _rand() - 1) * self.translate[1] * H
+        sc = 1.0
+        if self.scale:
+            sc = self.scale[0] + _rand() * (self.scale[1] - self.scale[0])
+        sh = (0.0, 0.0)
+        if self.shear:
+            s = self.shear if isinstance(self.shear, (list, tuple)) else (-self.shear, self.shear)
+            if len(s) == 2:
+                sh = (s[0] + _rand() * (s[1] - s[0]), 0.0)
+            else:
+                sh = (s[0] + _rand() * (s[1] - s[0]),
+                      s[2] + _rand() * (s[3] - s[2]))
+        return affine(arr, ang, (tx, ty), sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5, interpolation="nearest",
+                 fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if _rand() > self.prob:
+            return _to_numpy(img)
+        arr = _to_numpy(img)
+        H, W = arr.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(H * d / 2), int(W * d / 2)
+        tl = (int(_rand() * half_w), int(_rand() * half_h))
+        tr = (W - 1 - int(_rand() * half_w), int(_rand() * half_h))
+        br = (W - 1 - int(_rand() * half_w), H - 1 - int(_rand() * half_h))
+        bl = (int(_rand() * half_w), H - 1 - int(_rand() * half_h))
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        return perspective(arr, start, [tl, tr, br, bl], self.interpolation,
+                           self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if _rand() > self.prob:
+            return arr
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        H, W = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        area = H * W
+        for _ in range(10):
+            target = area * (self.scale[0] + _rand()
+                             * (self.scale[1] - self.scale[0]))
+            logr = np.log(self.ratio[0]) + _rand() * (np.log(self.ratio[1])
+                                                      - np.log(self.ratio[0]))
+            r = np.exp(logr)
+            h = int(round(np.sqrt(target * r)))
+            w = int(round(np.sqrt(target / r)))
+            if h < H and w < W:
+                i = int(_rand() * (H - h))
+                j = int(_rand() * (W - w))
+                v = self.value if self.value != "random" else \
+                    np.random.rand(*((arr.shape[0], h, w) if chw else (h, w, arr.shape[-1]))).astype(np.float32)
+                return erase(arr, i, j, h, w, v)
+        return arr

@@ -1,0 +1,172 @@
+"""Vision long tail: detection ops, deform conv, photometric/geometric
+transforms, model variants, hub."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+from paddle_tpu.vision import transforms as T
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 4, 8, 8).astype(np.float32)
+        w = rs.randn(6, 4, 3, 3).astype(np.float32)
+        b = rs.randn(6).astype(np.float32)
+        off0 = np.zeros((2, 18, 8, 8), np.float32)
+        ours = _np(vops.deform_conv2d(paddle.to_tensor(x),
+                                      paddle.to_tensor(off0),
+                                      paddle.to_tensor(w), paddle.to_tensor(b),
+                                      stride=1, padding=1))
+        ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                         torch.tensor(b), 1, 1).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+    def test_mask_and_grad(self):
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.randn(1, 2, 6, 6).astype(np.float32))
+        x.stop_gradient = False
+        off = paddle.to_tensor((rs.randn(1, 18, 6, 6) * 0.3).astype(np.float32))
+        w = paddle.to_tensor(rs.randn(4, 2, 3, 3).astype(np.float32))
+        mask = paddle.to_tensor(rs.rand(1, 9, 6, 6).astype(np.float32))
+        out = vops.deform_conv2d(x, off, w, None, 1, 1, mask=mask)
+        (out ** 2).mean().backward()
+        assert np.isfinite(_np(x.grad)).all()
+
+    def test_layer_class(self):
+        layer = vops.DeformConv2D(2, 4, 3, padding=1)
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(1, 2, 6, 6).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+        assert list(layer(x, off).shape) == [1, 4, 6, 6]
+
+
+class TestDetectionOps:
+    def test_box_coder_roundtrip(self):
+        pb = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+        tb = np.array([[1, 1, 9, 11], [4, 6, 14, 18]], np.float32)
+        enc = _np(vops.box_coder(paddle.to_tensor(pb), None,
+                                 paddle.to_tensor(tb)))
+        dec = _np(vops.box_coder(paddle.to_tensor(pb), None,
+                                 paddle.to_tensor(np.stack([enc[0, 0],
+                                                            enc[1, 1]])),
+                                 code_type="decode_center_size"))
+        np.testing.assert_allclose(np.stack([dec[0, 0], dec[1, 1]]), tb,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_yolo_box_shapes_and_loss_grad(self):
+        rs = np.random.RandomState(0)
+        yb, ys = vops.yolo_box(
+            paddle.to_tensor(rs.randn(1, 21, 4, 4).astype(np.float32)),
+            paddle.to_tensor(np.array([[64, 64]], np.int32)),
+            anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+            conf_thresh=0.01, downsample_ratio=16)
+        assert list(yb.shape) == [1, 48, 4] and list(ys.shape) == [1, 48, 2]
+        xx = paddle.to_tensor(rs.randn(2, 21, 4, 4).astype(np.float32))
+        xx.stop_gradient = False
+        yl = vops.yolo_loss(
+            xx, paddle.to_tensor(rs.rand(2, 5, 4).astype(np.float32) * .5 + .2),
+            paddle.to_tensor(rs.randint(0, 2, (2, 5))),
+            anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+            class_num=2, ignore_thresh=0.5, downsample_ratio=16)
+        yl.sum().backward()
+        assert np.isfinite(_np(xx.grad)).all()
+
+    def test_prior_box_and_pools(self):
+        boxes, var = vops.prior_box(
+            paddle.to_tensor(np.zeros((1, 3, 4, 4), np.float32)),
+            paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32)),
+            min_sizes=[8.0], aspect_ratios=[2.0], flip=True, clip=True)
+        assert list(boxes.shape[:2]) == [4, 4]
+        assert (_np(boxes) >= 0).all() and (_np(boxes) <= 1).all()
+        rs = np.random.RandomState(1)
+        feat = paddle.to_tensor(rs.randn(1, 8, 16, 16).astype(np.float32))
+        rois = paddle.to_tensor(np.array([[0, 0, 8, 8], [4, 4, 12, 12]],
+                                         np.float32))
+        assert list(vops.roi_pool(feat, rois,
+                                  paddle.to_tensor(np.array([2])), 2).shape) \
+            == [2, 8, 2, 2]
+        assert list(vops.psroi_pool(feat, rois,
+                                    paddle.to_tensor(np.array([2])), 2).shape) \
+            == [2, 2, 2, 2]
+
+    def test_matrix_nms_suppresses_duplicates(self):
+        mb = paddle.to_tensor(np.array(
+            [[[0, 0, 10, 10], [0, 0, 10, 10], [20, 20, 30, 30]]], np.float32))
+        msc = paddle.to_tensor(np.array([[[0.9, 0.85, 0.7]]], np.float32))
+        out, nums = vops.matrix_nms(mb, msc, 0.1, 0.3, 10, 5,
+                                    background_label=-1)
+        dec = _np(out)
+        # duplicate box's score decays below the original
+        assert dec.shape[1] == 6
+        assert dec[:, 1].max() <= 0.9 + 1e-6
+
+    def test_fpn_distribute(self):
+        multi, restore = vops.distribute_fpn_proposals(
+            paddle.to_tensor(np.array([[0, 0, 16, 16], [0, 0, 200, 200]],
+                                      np.float32)), 2, 5, 4, 224)
+        assert len(multi) == 4
+        sizes = [int(np.asarray(m.shape)[0]) for m in multi]
+        assert sum(sizes) == 2
+
+
+class TestTransformsExtra:
+    img = (np.random.RandomState(0).rand(16, 16, 3) * 255).astype(np.uint8)
+
+    def test_identities(self):
+        np.testing.assert_allclose(
+            T.affine(self.img, 0, (0, 0), 1.0, (0, 0), "bilinear"), self.img)
+        start = [(0, 0), (15, 0), (15, 15), (0, 15)]
+        np.testing.assert_allclose(
+            T.perspective(self.img, start, start, "bilinear"), self.img)
+        np.testing.assert_allclose(T.adjust_brightness(self.img, 1.0), self.img)
+        np.testing.assert_allclose(T.adjust_hue(self.img, 0.0), self.img, atol=1)
+
+    def test_rotate90_matches_rot90(self):
+        f = self.img.astype(np.float32)
+        np.testing.assert_allclose(T.rotate(f, 90, "bilinear"),
+                                   np.rot90(f, 1, (0, 1)), atol=1e-2)
+
+    def test_hsv_roundtrip(self):
+        hsv = T._rgb_to_hsv(self.img.astype(np.float32) / 255)
+        np.testing.assert_allclose(T._hsv_to_rgb(hsv) * 255, self.img, atol=1.0)
+
+    def test_random_classes_run(self):
+        for t in [T.ColorJitter(.4, .4, .4, .1),
+                  T.RandomAffine(10, (.1, .1), (0.9, 1.1), 5),
+                  T.RandomPerspective(1.0, 0.3), T.RandomErasing(1.0),
+                  T.Grayscale(3)]:
+            out = np.asarray(t(self.img))
+            assert out.shape[0] == 16
+
+
+class TestModelsAndHub:
+    def test_new_variants_forward(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(1, 3, 64, 64).astype(np.float32))
+        m = paddle.vision.models.resnext50_64x4d(num_classes=10)
+        assert list(m(x).shape) == [1, 10]
+        assert list(paddle.vision.models.shufflenet_v2_x0_33(num_classes=7)(x)
+                    .shape) == [1, 7]
+
+    def test_hub_local(self):
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "hubconf.py"), "w") as f:
+            f.write("dependencies=['numpy']\n"
+                    "def lenet(**kw):\n"
+                    "    import paddle_tpu\n"
+                    "    return paddle_tpu.vision.models.LeNet(**kw)\n")
+        assert paddle.hub.list(d) == ["lenet"]
+        net = paddle.hub.load(d, "lenet")
+        assert hasattr(net, "forward")
+        with pytest.raises(RuntimeError):
+            paddle.hub.list(d, source="github")
