@@ -102,8 +102,62 @@ def run(model_name, batch, seq, steps=10, warmup=2):
     }
 
 
+def probe_backend():
+    """Decide which backend to use WITHOUT wedging the whole bench.
+
+    TPU plugin init can fail (UNAVAILABLE) or hang (a dead client's chip claim
+    takes minutes to expire server-side). Probe in a child process with a
+    timeout; on failure/timeout fall back to CPU in THIS process (which has not
+    initialized jax yet) so the JSON line always prints.
+    """
+    import subprocess
+    import tempfile
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "600"))
+    code = ("import jax; d = jax.devices()[0]; "
+            "print('BACKEND=' + jax.default_backend())")
+    out_path = tempfile.mktemp(prefix="bench_probe_")
+    try:
+        with open(out_path, "w") as out_f:
+            child = subprocess.Popen([sys.executable, "-c", code],
+                                     stdout=out_f, stderr=subprocess.DEVNULL)
+        try:
+            rc = child.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            # Do NOT kill: a TPU-attached child killed mid-claim wedges the
+            # tunnel for every later process. Orphan it — it exits on its own
+            # once the claim resolves (and releases it) — and fall back to cpu.
+            _log(f"backend probe still blocked after {timeout}s; leaving it "
+                 f"to exit on its own and falling back to cpu")
+            return None
+        with open(out_path) as f:
+            for line in f:
+                if line.startswith("BACKEND="):
+                    return line.split("=", 1)[1].strip()
+        _log(f"backend probe rc={rc}, no backend reported")
+    except Exception as e:  # noqa: BLE001
+        _log(f"backend probe failed: {e}")
+    return None
+
+
 def main():
+    backend = probe_backend()
+    if backend is None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+    if backend is None:
+        # jax.config.update is the only mechanism that reliably forces cpu
+        # here (the plugin's .pth hook overrides env vars). If it fails we
+        # must not risk initializing the wedged TPU backend — emit the
+        # fallback line and stop.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:  # noqa: BLE001
+            _log(f"could not force cpu platform ({e}); aborting")
+            print(json.dumps({"metric": "GPT pretrain tokens/sec/chip",
+                              "value": 0.0, "unit": "tokens/s/chip",
+                              "vs_baseline": 0.0,
+                              "error": f"cpu fallback unavailable: {e}"}))
+            return
     # persistent XLA compilation cache: the driver's end-of-round bench run
     # hits warm artifacts instead of paying the 1.3B-scan compile again
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -114,7 +168,11 @@ def main():
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:
         pass
-    on_tpu = jax.default_backend() == "tpu"
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception as e:  # noqa: BLE001
+        _log(f"default_backend() raised ({e}); assuming cpu")
+        on_tpu = False
     attempts = ([("gpt3-1.3B", 8, 2048), ("gpt3-1.3B", 4, 2048),
                  ("gpt3-760M", 8, 2048), ("gpt3-345M", 8, 2048)]
                 if on_tpu else [("gpt3-125M", 2, 256)])

@@ -86,15 +86,20 @@ class GradScaler:
             return
         params = optimizer._parameter_list or []
         inv = 1.0 / self._scale
-        found = False
+        # one device-side reduction over all grads, one host sync at the end
+        # (per-param bool() forced a device->host round trip per parameter)
+        finite_parts = []
         for p in params:
             if p._grad is None:
                 continue
             g = p._grad._data.astype(jnp.float32) * inv
-            finite = bool(jnp.all(jnp.isfinite(g)))
-            found = found or not finite
+            finite_parts.append(jnp.all(jnp.isfinite(g)))
             p._grad._data = g
-        self._found_inf = found
+        if finite_parts:
+            all_finite = jnp.stack(finite_parts).all()
+            self._found_inf = not bool(all_finite)
+        else:
+            self._found_inf = False
 
     def step(self, optimizer):
         if not self._enable:

@@ -15,9 +15,9 @@ from ...dispatch import apply as _apply
 from ...tensor_impl import Tensor
 
 
-def _sdpa_reference(q, k, v, mask=None, causal=False, scale=None, dropout_key=None,
-                    dropout_p=0.0):
-    """q,k,v: [B, S, H, D] (paddle flash_attention layout)."""
+def _sdpa_probs(q, k, mask=None, causal=False, scale=None):
+    """Softmax attention probabilities [B, H, Sq, Sk] in fp32 (shared by the
+    composed forward and the return_softmax debug path)."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     # compute in f32 for numerics, output in input dtype
@@ -31,7 +31,13 @@ def _sdpa_reference(q, k, v, mask=None, causal=False, scale=None, dropout_key=No
             logits = jnp.where(mask, logits, -1e30)
         else:
             logits = logits + mask.astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def _sdpa_reference(q, k, v, mask=None, causal=False, scale=None, dropout_key=None,
+                    dropout_p=0.0):
+    """q,k,v: [B, S, H, D] (paddle flash_attention layout)."""
+    probs = _sdpa_probs(q, k, mask=mask, causal=causal, scale=scale)
     if dropout_p > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
@@ -79,12 +85,20 @@ def _flash_ok(q):
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None, rng_name="",
                     training=True, name=None):
-    """ref: python/paddle/incubate/nn/functional flash_attention API."""
+    """ref: python/paddle/incubate/nn/functional flash_attention API.
+
+    With return_softmax the full probability matrix must be materialized, so
+    the composed (non-flash) path is used for it — same numerics, O(S^2) memory,
+    exactly like the reference's return_softmax=True debug mode.
+    """
     out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
                                        training)
-    if return_softmax:
+    if not return_softmax:
         return out, None
-    return out, None
+
+    softmax = _apply(lambda q, k: _sdpa_probs(q, k, causal=causal),
+                     query, key, op_name="softmax")
+    return out, softmax
 
 
 def flash_attn_unpadded(*args, **kwargs):
