@@ -115,27 +115,36 @@ def probe_backend():
     timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "600"))
     code = ("import jax; d = jax.devices()[0]; "
             "print('BACKEND=' + jax.default_backend())")
-    out_path = tempfile.mktemp(prefix="bench_probe_")
+    out_f = tempfile.NamedTemporaryFile("w+", prefix="bench_probe_",
+                                        delete=False)
+    child = None
     try:
-        with open(out_path, "w") as out_f:
-            child = subprocess.Popen([sys.executable, "-c", code],
-                                     stdout=out_f, stderr=subprocess.DEVNULL)
+        child = subprocess.Popen([sys.executable, "-c", code],
+                                 stdout=out_f, stderr=subprocess.DEVNULL)
         try:
             rc = child.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
             # Do NOT kill: a TPU-attached child killed mid-claim wedges the
             # tunnel for every later process. Orphan it — it exits on its own
             # once the claim resolves (and releases it) — and fall back to cpu.
+            # (The orphan keeps writing to the fd, so leave its file in place.)
             _log(f"backend probe still blocked after {timeout}s; leaving it "
                  f"to exit on its own and falling back to cpu")
             return None
-        with open(out_path) as f:
-            for line in f:
-                if line.startswith("BACKEND="):
-                    return line.split("=", 1)[1].strip()
+        out_f.seek(0)
+        for line in out_f:
+            if line.startswith("BACKEND="):
+                return line.split("=", 1)[1].strip()
         _log(f"backend probe rc={rc}, no backend reported")
     except Exception as e:  # noqa: BLE001
         _log(f"backend probe failed: {e}")
+    finally:
+        out_f.close()
+        if child is None or child.poll() is not None:
+            try:
+                os.unlink(out_f.name)
+            except OSError:
+                pass
     return None
 
 

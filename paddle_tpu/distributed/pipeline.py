@@ -47,23 +47,13 @@ def pipeline_spmd(block_fn, stage_params, x_mb, *, axis_name="pp"):
     T = M + S - 1
     perm = [(i, (i + 1) % S) for i in range(S)]
     local_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    full_stage_fn = _stage_fn_of(block_fn)
 
     def stage_fn(act):
-        def scan_layer(h, layer_params):
-            return block_fn(layer_params, h), None
-        out, _ = lax.scan(scan_layer, act, local_params)
-        return out
+        return full_stage_fn(local_params, act)
 
-    def _varying(a):
-        # mark carry values as device-varying over the pp axis (vma typing)
-        if hasattr(lax, "pcast"):
-            return lax.pcast(a, (axis_name,), to="varying")
-        if hasattr(lax, "pvary"):
-            return lax.pvary(a, (axis_name,))
-        return a
-
-    outputs0 = _varying(jnp.zeros_like(x_mb))
-    hold0 = _varying(jnp.zeros(x_mb.shape[1:], x_mb.dtype))
+    outputs0 = _varying(jnp.zeros_like(x_mb), axis_name)
+    hold0 = _varying(jnp.zeros(x_mb.shape[1:], x_mb.dtype), axis_name)
 
     def tick(carry, t):
         outputs, prev_out = carry
@@ -86,8 +76,325 @@ def pipeline_spmd(block_fn, stage_params, x_mb, *, axis_name="pp"):
     return lax.psum(masked, axis_name)
 
 
+def _stage_fn_of(block_fn):
+    def stage_fn(local_params, act):
+        def scan_layer(h, layer_params):
+            return block_fn(layer_params, h), None
+        out, _ = lax.scan(scan_layer, act, local_params)
+        return out
+    return stage_fn
+
+
+def _varying(a, axis_name):
+    try:
+        if hasattr(lax, "pcast"):
+            return lax.pcast(a, (axis_name,), to="varying")
+        if hasattr(lax, "pvary"):
+            return lax.pvary(a, (axis_name,))
+    except ValueError:
+        pass  # already varying over axis_name
+    return a
+
+
+def _gated_fwd(stage_fn, axis_name, active, pv, inp):
+    """stage forward, skipped entirely (lax.cond) on inactive schedule slots
+    so warmup/cooldown ticks don't burn MXU time on masked garbage."""
+    return lax.cond(
+        active,
+        lambda a: stage_fn(pv, a),
+        lambda a: _varying(jnp.zeros(inp.shape, inp.dtype), axis_name),
+        inp)
+
+
+def _gated_vjp(stage_fn, axis_name, active, pv, inp, gout):
+    """(param_grads, input_grad) of the stage at `inp`, cond-gated like
+    _gated_fwd."""
+    def run(args):
+        i, go = args
+        _, vjp_fn = jax.vjp(stage_fn, pv, i)
+        return vjp_fn(go)
+
+    def zero(args):
+        i, _ = args
+        return (jax.tree_util.tree_map(
+            lambda a: _varying(jnp.zeros_like(a), axis_name), pv),
+            _varying(jnp.zeros(i.shape, i.dtype), axis_name))
+
+    return lax.cond(active, run, zero, (inp, gout))
+
+
+def pipeline_spmd_1f1b(block_fn, stage_params, x_mb, *, axis_name="pp"):
+    """1F1B-scheduled pipeline (ref: fleet/meta_parallel/pipeline_parallel.py:230
+    `forward_backward_pipeline`, the "1f1b scheduling strategy").
+
+    Same contract as `pipeline_spmd`, but the backward pass is hand-scheduled
+    instead of autodiff'd through the forward scan. Why: autodiff of the GPipe
+    scan stores per-tick residuals for all T = M+S-1 ticks — activation
+    residency O(M). Here the backward runs its own combined schedule: each tick
+    does one forward (recomputing the activation stream) and one backward
+    microbatch per stage, with a circular stash of at most K = 2S-1 in-flight
+    stage *inputs* — residency O(S), independent of the microbatch count.
+
+    Scheduling (stage s, tick t, microbatch indices):
+      forward  of mb  fm = t - s
+      backward of mb  bm = t - 2(S-1) + s     (same tick as fm on last stage)
+      T = M + 2S - 2 ticks; stash slot = mb mod K, lifetime exactly <= K ticks.
+
+    Cost: stage-input checkpointing (Megatron "full recompute" mode) — the
+    backward recomputes each stage forward from the stashed input rather than
+    stashing per-layer residuals, because vjp residuals would carry K copies of
+    (cast) stage params. ~1 extra forward vs GPipe+autodiff, in exchange for
+    O(S) instead of O(M) activation memory.
+    """
+    S = lax.axis_size(axis_name)
+    M = x_mb.shape[0]
+    stage_fn = _stage_fn_of(block_fn)
+
+    @jax.custom_vjp
+    def pipe(sp, xm):
+        return pipeline_spmd(block_fn, sp, xm, axis_name=axis_name)
+
+    def pipe_fwd(sp, xm):
+        return pipe(sp, xm), (sp, xm)
+
+    def pipe_bwd(res, g):
+        sp, xm = res
+        local_params = jax.tree_util.tree_map(lambda a: a[0], sp)
+        stage = lax.axis_index(axis_name)
+        K = 2 * S - 1
+        T = M + 2 * S - 2
+        perm_down = [(i, (i + 1) % S) for i in range(S)]
+        perm_up = [(i, (i - 1) % S) for i in range(S)]
+        mb_shape = x_mb.shape[1:]
+
+        def vv(a):
+            return _varying(a, axis_name)
+
+        stash0 = vv(jnp.zeros((K,) + mb_shape, xm.dtype))
+        send_f0 = vv(jnp.zeros(mb_shape, xm.dtype))
+        send_b0 = vv(jnp.zeros(mb_shape, g.dtype))
+        pgrads0 = jax.tree_util.tree_map(
+            lambda a: vv(jnp.zeros(a.shape, a.dtype)), local_params)
+        gx0 = vv(jnp.zeros_like(xm))
+
+        def tick(carry, t):
+            stash, send_f, send_b, pgrads, gx = carry
+            recv_f = lax.ppermute(send_f, axis_name, perm_down)
+            recv_b = lax.ppermute(send_b, axis_name, perm_up)
+
+            # ---- forward sub-tick: recompute the activation stream
+            fm = t - stage
+            f_act = jnp.logical_and(fm >= 0, fm < M)
+            first_in = lax.dynamic_index_in_dim(
+                xm, jnp.clip(fm, 0, M - 1), 0, keepdims=False)
+            inp = jnp.where(stage == 0, first_in, recv_f)
+            out_f = _gated_fwd(stage_fn, axis_name, f_act, local_params, inp)
+            slot_f = jnp.mod(fm, K)
+            cur = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+            stash = lax.dynamic_update_index_in_dim(
+                stash, jnp.where(f_act, inp, cur), slot_f, 0)
+
+            # ---- backward sub-tick
+            bm = t - 2 * (S - 1) + stage
+            b_act = jnp.logical_and(bm >= 0, bm < M)
+            slot_b = jnp.mod(bm, K)
+            stashed_in = lax.dynamic_index_in_dim(
+                stash, slot_b, 0, keepdims=False)
+            g_last = lax.dynamic_index_in_dim(
+                g, jnp.clip(bm, 0, M - 1), 0, keepdims=False)
+            g_out = jnp.where(stage == S - 1, g_last.astype(send_b.dtype),
+                              recv_b)
+            gp, gi = _gated_vjp(stage_fn, axis_name, b_act, local_params,
+                                stashed_in, g_out.astype(stashed_in.dtype))
+            pgrads = jax.tree_util.tree_map(
+                lambda acc, gg: acc + gg.astype(acc.dtype), pgrads, gp)
+            write_gx = jnp.logical_and(b_act, stage == 0)
+            cur_gx = lax.dynamic_index_in_dim(
+                gx, jnp.clip(bm, 0, M - 1), 0, keepdims=False)
+            gx = lax.dynamic_update_index_in_dim(
+                gx, jnp.where(write_gx, gi.astype(gx.dtype), cur_gx),
+                jnp.clip(bm, 0, M - 1), 0)
+            return (stash, out_f, gi.astype(send_b.dtype), pgrads, gx), None
+
+        carry0 = (stash0, send_f0, send_b0, pgrads0, gx0)
+        (_, _, _, pgrads, gx), _ = lax.scan(tick, carry0, jnp.arange(T))
+        # grads wrt the [1, L, ...] per-device param slice; x grads live on
+        # stage 0 only (shard_map psums replicated-input cotangents).
+        g_sp = jax.tree_util.tree_map(lambda a: a[None], pgrads)
+        # xm entered replicated (in_spec P()), so its cotangent must leave
+        # replicated/invariant too: mask to stage 0's contribution and psum.
+        gx = lax.psum(jnp.where(stage == 0, gx, jnp.zeros_like(gx)), axis_name)
+        return g_sp, gx
+
+    pipe.defvjp(pipe_fwd, pipe_bwd)
+    return pipe(stage_params, x_mb)
+
+
+def pipeline_spmd_interleaved_1f1b(block_fn, stage_params, x_mb, *,
+                                   num_virtual, axis_name="pp"):
+    """Interleaved ("virtual pipeline") 1F1B (ref: fleet/meta_parallel/
+    pipeline_parallel.py:613 interleaved schedule / VPP).
+
+    Device s hosts V = num_virtual chunks — virtual stages p = s, s+S, ...,
+    s+(V-1)S of a flat S' = V*S stage pipeline. Per tick each device runs its
+    active virtual-stage chunks (fwd of mb t-p, bwd of mb t-2(S'-1)+p),
+    `lax.cond`-gated so inactive warmup/cooldown slots skip the matmuls
+    (interleaving only pays off when idle slots are cheap). All V streams
+    ride one stacked ppermute per direction; the lap boundary (device S-1 →
+    device 0, lap v → v+1) is a roll of the stacked recv buffer.
+
+    stage_params leaves: [1, V, L_chunk, ...] — this device's V chunks.
+    x_mb: [M, mb...]; returns [M, mb...] like pipeline_spmd.
+    """
+    S = lax.axis_size(axis_name)
+    V = num_virtual
+    Sv = V * S
+    M = x_mb.shape[0]
+    stage_fn = _stage_fn_of(block_fn)
+    mb_shape = x_mb.shape[1:]
+    perm_down = [(i, (i + 1) % S) for i in range(S)]
+    perm_up = [(i, (i - 1) % S) for i in range(S)]
+
+    def chunk_params(sp, v):
+        return jax.tree_util.tree_map(lambda a: a[0, v], sp)
+
+    def gated_fwd(active, pv, inp):
+        return _gated_fwd(stage_fn, axis_name, active, pv, inp)
+
+    @jax.custom_vjp
+    def pipe(sp, xm):
+        stage = lax.axis_index(axis_name)
+        T = M + Sv - 1
+
+        def vv(a):
+            return _varying(a, axis_name)
+
+        fsend0 = vv(jnp.zeros((V,) + mb_shape, xm.dtype))
+        outputs0 = vv(jnp.zeros_like(xm))
+
+        def tick(carry, t):
+            fsend, outputs = carry
+            recv = lax.ppermute(fsend, axis_name, perm_down)
+            # lap boundary: device 0's lap v reads device S-1's lap v-1
+            recv = jnp.where(stage == 0, jnp.roll(recv, 1, axis=0), recv)
+            outs = []
+            for v in range(V):
+                p = stage + v * S
+                fm = t - p
+                active = jnp.logical_and(fm >= 0, fm < M)
+                first_in = lax.dynamic_index_in_dim(
+                    xm, jnp.clip(fm, 0, M - 1), 0, keepdims=False)
+                inp = recv[v]
+                if v == 0:
+                    inp = jnp.where(stage == 0, first_in, inp)
+                outs.append(gated_fwd(active, chunk_params(sp, v), inp))
+            out_last = outs[V - 1]
+            out_idx = jnp.clip(t - (Sv - 1), 0, M - 1)
+            write = jnp.logical_and(
+                jnp.logical_and(stage == S - 1, t >= Sv - 1), t - (Sv - 1) < M)
+            cur = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(write, out_last, cur), out_idx, 0)
+            return (jnp.stack(outs), outputs), None
+
+        (_, outputs), _ = lax.scan(tick, (fsend0, outputs0), jnp.arange(T))
+        masked = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
+        return lax.psum(masked, axis_name)
+
+    def pipe_fwd(sp, xm):
+        return pipe(sp, xm), (sp, xm)
+
+    def pipe_bwd(res, g):
+        sp, xm = res
+        stage = lax.axis_index(axis_name)
+        K = 2 * Sv - 1
+        T = M + 2 * Sv - 2
+
+        def vv(a):
+            return _varying(a, axis_name)
+
+        stash0 = vv(jnp.zeros((V, K) + mb_shape, xm.dtype))
+        fsend0 = vv(jnp.zeros((V,) + mb_shape, xm.dtype))
+        bsend0 = vv(jnp.zeros((V,) + mb_shape, g.dtype))
+        pgrads0 = jax.tree_util.tree_map(
+            lambda a: vv(jnp.zeros(a.shape[1:], a.dtype)), sp)  # [V, Lc, ...]
+        gx0 = vv(jnp.zeros_like(xm))
+
+        def gated_vjp(active, pv, inp, gout):
+            return _gated_vjp(stage_fn, axis_name, active, pv, inp, gout)
+
+        def tick(carry, t):
+            stash, fsend, bsend, pgrads, gx = carry
+            recv_f = lax.ppermute(fsend, axis_name, perm_down)
+            recv_f = jnp.where(stage == 0, jnp.roll(recv_f, 1, axis=0), recv_f)
+            recv_b = lax.ppermute(bsend, axis_name, perm_up)
+            # lap boundary reversed: device S-1's lap v reads dev 0's lap v+1
+            recv_b = jnp.where(stage == S - 1, jnp.roll(recv_b, -1, axis=0),
+                               recv_b)
+
+            f_outs, b_outs = [], []
+            new_pgrads = []
+            for v in range(V):
+                p = stage + v * S
+                pv = chunk_params(sp, v)
+                # ---- forward sub-tick for chunk v
+                fm = t - p
+                f_act = jnp.logical_and(fm >= 0, fm < M)
+                first_in = lax.dynamic_index_in_dim(
+                    xm, jnp.clip(fm, 0, M - 1), 0, keepdims=False)
+                inp = recv_f[v]
+                if v == 0:
+                    inp = jnp.where(stage == 0, first_in, inp)
+                f_outs.append(gated_fwd(f_act, pv, inp))
+                slot_f = jnp.mod(fm, K)
+                cur = lax.dynamic_index_in_dim(stash[v], slot_f, 0,
+                                               keepdims=False)
+                stash = stash.at[v].set(lax.dynamic_update_index_in_dim(
+                    stash[v], jnp.where(f_act, inp, cur), slot_f, 0))
+
+                # ---- backward sub-tick for chunk v
+                bm = t - 2 * (Sv - 1) + p
+                b_act = jnp.logical_and(bm >= 0, bm < M)
+                slot_b = jnp.mod(bm, K)
+                stashed_in = lax.dynamic_index_in_dim(stash[v], slot_b, 0,
+                                                      keepdims=False)
+                g_last = lax.dynamic_index_in_dim(
+                    g, jnp.clip(bm, 0, M - 1), 0, keepdims=False)
+                gout = recv_b[v]
+                if v == V - 1:
+                    gout = jnp.where(stage == S - 1,
+                                     g_last.astype(gout.dtype), gout)
+                gp, gi = gated_vjp(b_act, pv, stashed_in,
+                                   gout.astype(stashed_in.dtype))
+                new_pgrads.append(gp)
+                b_outs.append(gi.astype(bsend.dtype))
+                if v == 0:
+                    write_gx = jnp.logical_and(b_act, stage == 0)
+                    cur_gx = lax.dynamic_index_in_dim(
+                        gx, jnp.clip(bm, 0, M - 1), 0, keepdims=False)
+                    gx = lax.dynamic_update_index_in_dim(
+                        gx, jnp.where(write_gx, gi.astype(gx.dtype), cur_gx),
+                        jnp.clip(bm, 0, M - 1), 0)
+
+            pgrads = jax.tree_util.tree_map(
+                lambda acc, *gs: acc + jnp.stack(gs).astype(acc.dtype),
+                pgrads, *new_pgrads)
+            return (stash, jnp.stack(f_outs), jnp.stack(b_outs), pgrads,
+                    gx), None
+
+        carry0 = (stash0, fsend0, bsend0, pgrads0, gx0)
+        (_, _, _, pgrads, gx), _ = lax.scan(tick, carry0, jnp.arange(T))
+        g_sp = jax.tree_util.tree_map(lambda a: a[None], pgrads)
+        gx = lax.psum(jnp.where(stage == 0, gx, jnp.zeros_like(gx)), axis_name)
+        return g_sp, gx
+
+    pipe.defvjp(pipe_fwd, pipe_bwd)
+    return pipe(stage_params, x_mb)
+
+
 def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
-                 axis_name="pp", data_spec=P()):
+                 axis_name="pp", data_spec=P(), schedule="gpipe",
+                 interleave=1):
     """Host-side wrapper: shard_map(manual over 'pp', auto elsewhere).
 
     stacked_params: pytree, leaves [S * local_L, ...] stacked layer params.
@@ -98,10 +405,18 @@ def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
     S = mesh.shape[axis_name]
     M = num_microbatches
     B = x.shape[0]
+    V = interleave
     assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
 
-    def reshape_stages(a):
-        return a.reshape((S, a.shape[0] // S) + a.shape[1:])
+    if V > 1:
+        # chunk c of V*S covers layers [c*Lc, (c+1)*Lc); device c%S, lap c//S
+        def reshape_stages(a):
+            Lc = a.shape[0] // (V * S)
+            vs_major = a.reshape((V, S, Lc) + a.shape[1:])
+            return jnp.swapaxes(vs_major, 0, 1)          # [S, V, Lc, ...]
+    else:
+        def reshape_stages(a):
+            return a.reshape((S, a.shape[0] // S) + a.shape[1:])
 
     staged = jax.tree_util.tree_map(reshape_stages, stacked_params)
     x_mb = x.reshape((M, B // M) + x.shape[1:])
@@ -109,7 +424,15 @@ def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
     param_specs = jax.tree_util.tree_map(
         lambda a: P("pp", *([None] * (a.ndim - 1))), staged)
 
-    inner = functools.partial(pipeline_spmd, block_fn, axis_name=axis_name)
+    if V > 1:
+        assert schedule == "1f1b", "interleaving requires the 1f1b schedule"
+        spmd = functools.partial(pipeline_spmd_interleaved_1f1b,
+                                 num_virtual=V)
+    elif schedule == "1f1b":
+        spmd = pipeline_spmd_1f1b
+    else:
+        spmd = pipeline_spmd
+    inner = functools.partial(spmd, block_fn, axis_name=axis_name)
     mapped = jax.shard_map(
         lambda p, xm: inner(p, xm),
         mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),

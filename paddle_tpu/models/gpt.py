@@ -46,6 +46,9 @@ class GPTConfig:
     compute_dtype: str = "bfloat16"
     remat: bool = True
     tie_embeddings: bool = False
+    # pipeline-parallel schedule: "1f1b" (O(stages) activation residency,
+    # ref fleet/meta_parallel/pipeline_parallel.py:230) or "gpipe"
+    pp_schedule: str = "1f1b"
 
 
 # headline model family (GPT-3 sizes; ref benchmark configs)

@@ -111,8 +111,10 @@ def gpt_forward(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
     if pp > 1:
         # NOTE: no per-block remat inside the pipelined region — the GPipe scan
         # already recomputes per-tick; remat's constant residuals break the
-        # shard_map vma typing of the reverse scan.
-        x = run_pipeline(block, params["blocks"], x, num_microbatches, mesh=mesh)
+        # shard_map vma typing of the reverse scan. The 1f1b schedule has its
+        # own hand-written backward with stage-input checkpointing.
+        x = run_pipeline(block, params["blocks"], x, num_microbatches, mesh=mesh,
+                         schedule=getattr(config, "pp_schedule", "1f1b"))
     else:
         def scan_body(h, layer_params):
             return jax.checkpoint(block)(layer_params, h), None

@@ -50,7 +50,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """paddle layout: [batch, seq, num_heads, head_dim]."""
     from ...framework.random import next_key
     dropout_key = next_key() if (dropout_p > 0.0 and training) else None
-    use_flash = _flash_ok(query)
+    # the pallas kernel has no dropout yet — keep backends numerically
+    # equivalent by routing dropout through the composed path
+    use_flash = _flash_ok(query) and dropout_key is None
 
     def f(q, k, v, *m):
         mask = m[0] if m else None
@@ -91,14 +93,25 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     the composed (non-flash) path is used for it — same numerics, O(S^2) memory,
     exactly like the reference's return_softmax=True debug mode.
     """
-    out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
-                                       training)
     if not return_softmax:
+        out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                           causal, training)
         return out, None
 
-    softmax = _apply(lambda q, k: _sdpa_probs(q, k, causal=causal),
-                     query, key, op_name="softmax")
-    return out, softmax
+    # compute probs once, reuse for both the output and the returned softmax
+    from ...framework.random import next_key
+    dropout_key = next_key() if (dropout > 0.0 and training) else None
+
+    def f(q, k, v):
+        probs = _sdpa_probs(q, k, causal=causal)
+        p = probs
+        if dropout_key is not None:
+            keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+        return out, probs
+
+    return _apply(f, query, key, value, op_name="flash_attention")
 
 
 def flash_attn_unpadded(*args, **kwargs):
