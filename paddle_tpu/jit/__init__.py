@@ -7,6 +7,8 @@ from .functional import (  # noqa: F401
     functional_call, functional_fn_call, capture_params, capture_buffers,
 )
 from .train_step import TrainStep  # noqa: F401
+from . import dy2static  # noqa: F401
+from .dy2static import convert_to_static  # noqa: F401
 
 
 def enable_to_static(flag=True):

@@ -26,8 +26,16 @@ class StaticFunction:
         self._target = target
         self._input_spec = input_spec
         self._is_layer = isinstance(target, Layer)
-        # capture the un-compiled forward BEFORE to_static rebinds it
-        self._orig_forward = target.forward if self._is_layer else None
+        # capture the un-compiled forward BEFORE to_static rebinds it, and
+        # AST-convert data-dependent control flow to lax.cond/while/scan
+        # (ref: jit/dy2static/ast_transformer.py); falls back to the original
+        # callable when there is nothing to convert or no source available
+        from .dy2static import convert_to_static
+        if self._is_layer:
+            self._orig_forward = convert_to_static(target.forward)
+        else:
+            self._orig_forward = None
+            self._target = convert_to_static(target)
         self._cache = {}  # training-mode -> jitted fn
         self._last_lowered = None
 
@@ -72,8 +80,20 @@ class StaticFunction:
         else:
             params, buffers, training = {}, {}, False
         jitted = self._get_jitted(training)
-        out, new_buffers = jitted(params, buffers, next_key(), arg_arrays,
-                                  kwarg_arrays)
+        try:
+            out, new_buffers = jitted(params, buffers, next_key(), arg_arrays,
+                                      kwarg_arrays)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerArrayConversionError) as e:
+            from .dy2static import ConversionError
+            raise ConversionError(
+                "to_static could not convert data-dependent Python control "
+                "flow in this function: a tensor was used as a bool in a "
+                "construct dy2static leaves as plain Python (break/continue, "
+                "early return inside a branch, global/nonlocal, or a "
+                "function without retrievable source). Restructure the "
+                "control flow (single exit per branch, no break/continue) so "
+                "it can lower to lax.cond/while_loop.") from e
         if self._is_layer and new_buffers:
             named_b = dict(self._target.named_buffers())
             for n, arr in new_buffers.items():

@@ -1,0 +1,736 @@
+"""dygraph→static control-flow conversion (dy2static).
+
+Re-design of the reference AST converter (ref: python/paddle/jit/dy2static/
+ast_transformer.py, convert_operators.py — convert_ifelse / convert_while_loop
+/ convert_for). The reference rewrites Python control flow into Program ops
+(cond / while blocks); here the same AST rewrite targets XLA's structured
+control flow: `lax.cond`, `lax.while_loop`, `lax.scan`.
+
+Semantics: every rewritten site calls a runtime helper that checks whether the
+condition/iterable is a jax tracer. Concrete values take the ordinary Python
+path (bit-identical eager semantics); traced values lower to the lax
+primitive. Unconvertible constructs (break/continue, early return inside a
+converted branch, global/nonlocal) are left as plain Python — fine eagerly,
+and under tracing they produce a ConversionError with guidance instead of a
+raw tracer-leak error.
+
+Value-vs-object deviation (same as the reference): converted branches merge
+variables by value; `and`/`or` on tensors evaluate both operands.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+import weakref
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor_impl import Tensor
+
+__all__ = [
+    "convert_to_static", "ConversionError", "convert_ifelse",
+    "convert_while_loop", "convert_for_range", "convert_for_iter",
+    "convert_logical_and", "convert_logical_or", "convert_logical_not",
+]
+
+
+class ConversionError(RuntimeError):
+    pass
+
+
+class _Undefined:
+    """Placeholder for variables not yet bound before a converted branch
+    (ref: dy2static UndefinedVar). Any use raises a NameError-like message."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name="<var>"):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        raise ConversionError(
+            f"variable '{self.name}' is used before assignment along a "
+            f"converted control-flow path")
+
+    __call__ = __add__ = __radd__ = __sub__ = __mul__ = __bool__ = _raise
+
+    def __getattr__(self, item):
+        if item in ("name", "_raise"):
+            raise AttributeError(item)
+        self._raise()
+
+    def __repr__(self):
+        return f"<undefined {self.name}>"
+
+
+_UNDEF = _Undefined()
+
+
+def get_local(loc, name):
+    v = loc.get(name, _UNDEF)
+    return _Undefined(name) if v is _UNDEF else v
+
+
+# ---------------------------------------------------------------------------
+# runtime type tests / carry packing
+
+def _data_of(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_data_of(x), jax.core.Tracer)
+
+
+def _truth(x):
+    x = _data_of(x)
+    if isinstance(x, _Undefined):
+        x._raise()
+    return bool(x)
+
+
+def _is_dynamic(v):
+    d = _data_of(v)
+    return isinstance(d, (jax.Array, jax.core.Tracer)) or \
+        isinstance(d, (bool, int, float, complex)) or \
+        type(d).__module__ == "numpy"
+
+
+def _pack(vals):
+    """Split a tuple of python values into (dyn_arrays, rebuild)."""
+    dyn_idx = [i for i, v in enumerate(vals) if _is_dynamic(v)]
+    was_tensor = [isinstance(vals[i], Tensor) for i in dyn_idx]
+    statics = list(vals)
+
+    def extract(vs):
+        return tuple(jnp.asarray(_data_of(vs[i])) for i in dyn_idx)
+
+    def rebuild(dyn):
+        out = list(statics)
+        for slot, (i, wt) in enumerate(zip(dyn_idx, was_tensor)):
+            out[i] = Tensor(dyn[slot]) if wt else dyn[slot]
+        return tuple(out)
+
+    return extract, rebuild, dyn_idx
+
+
+def _check_statics(name, before, after, dyn_idx):
+    dyn = set(dyn_idx)
+    for i, (b, a) in enumerate(zip(before, after)):
+        if i in dyn:
+            continue
+        if isinstance(b, _Undefined):
+            # body-local temporary (first bound inside the loop/branch):
+            # stays undefined in the carry; reading it after raises clearly
+            continue
+        if b is not a and b != a:
+            raise ConversionError(
+                f"converted {name} rebinds a non-tensor variable to a "
+                f"different object under tracing (position {i}: {b!r} -> "
+                f"{a!r}); hoist it out of the control flow or make it a "
+                f"tensor")
+
+
+# ---------------------------------------------------------------------------
+# runtime conversion helpers (targets of the AST rewrite)
+
+def convert_ifelse(pred, true_fn, false_fn):
+    """ref: convert_operators.py convert_ifelse."""
+    if not _is_traced(pred):
+        return true_fn() if _truth(pred) else false_fn()
+    out_t = list(true_fn())
+    out_f = list(false_fn())
+    if len(out_t) != len(out_f):
+        raise ConversionError("converted if/else branches assign different "
+                              "variable sets")
+    # a variable bound in only one branch stays undefined after the cond
+    # (ref: dy2static UndefinedVar) — reading it later raises clearly
+    for i in range(len(out_t)):
+        if isinstance(out_t[i], _Undefined) or isinstance(out_f[i], _Undefined):
+            u = out_t[i] if isinstance(out_t[i], _Undefined) else out_f[i]
+            out_t[i] = out_f[i] = u
+    out_t, out_f = tuple(out_t), tuple(out_f)
+    ext_t, rebuild, dyn_t = _pack(out_t)
+    ext_f, _, dyn_f = _pack(out_f)
+    if dyn_t != dyn_f:
+        raise ConversionError(
+            "converted if/else branches disagree on which variables are "
+            "tensors; make both branches assign tensor values")
+    _check_statics("if/else", out_t, out_f, dyn_t)
+    pred_arr = jnp.asarray(_data_of(pred)).reshape(()).astype(bool)
+    # branches are traced twice: the probe above (for structure/static
+    # checks; its dynamic outputs are dead and XLA DCEs them) and inside
+    # lax.cond so only ONE branch executes at runtime. Closing over the
+    # probe outputs instead would degrade cond to a select that computes
+    # both branches every step.
+    try:
+        dyn = lax.cond(pred_arr,
+                       lambda _: ext_t(true_fn()),
+                       lambda _: ext_f(false_fn()), 0)
+    except TypeError as e:
+        raise ConversionError(
+            f"converted if/else branches produce mismatched shapes/dtypes: "
+            f"{e}") from e
+    return rebuild(dyn)
+
+
+def convert_ifelse_expr(pred, true_thunk, false_thunk):
+    if not _is_traced(pred):
+        return true_thunk() if _truth(pred) else false_thunk()
+    a, b = true_thunk(), false_thunk()
+    da, db = _data_of(a), _data_of(b)
+    out = lax.cond(jnp.asarray(_data_of(pred)).reshape(()).astype(bool),
+                   lambda o: jnp.asarray(o[0]), lambda o: jnp.asarray(o[1]),
+                   (da, db))
+    return Tensor(out) if isinstance(a, Tensor) or isinstance(b, Tensor) else out
+
+
+def convert_while_loop(cond_fn, body_fn, init):
+    """ref: convert_operators.py convert_while_loop."""
+    c0 = cond_fn(*init)
+    if not _is_traced(c0) and not any(_is_traced(v) for v in init):
+        vals = init
+        cond_v = c0
+        while _truth(cond_v):
+            vals = tuple(body_fn(*vals))
+            cond_v = cond_fn(*vals)
+        return vals
+    extract, rebuild, dyn_idx = _pack(init)
+    probe = tuple(body_fn(*init))
+    _check_statics("while", init, probe, dyn_idx)
+
+    def cond_w(dyn):
+        return jnp.asarray(_data_of(cond_fn(*rebuild(dyn)))).reshape(()) \
+            .astype(bool)
+
+    def body_w(dyn):
+        return extract(tuple(body_fn(*rebuild(dyn))))
+
+    init_dyn = extract(init)
+    # canonicalize init leaves to the dtypes the body produces (a python-int
+    # counter becomes int32 on the first iteration)
+    specs = tuple(jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
+                  for a in init_dyn)
+    probe_dyn = jax.eval_shape(body_w, specs)
+    init_dyn = tuple(jnp.asarray(a, s.dtype)
+                     for a, s in zip(init_dyn, probe_dyn))
+    try:
+        out_dyn = lax.while_loop(cond_w, body_w, init_dyn)
+    except TypeError as e:
+        raise ConversionError(
+            f"converted while loop carry changes shape/dtype across "
+            f"iterations: {e}") from e
+    return rebuild(out_dyn)
+
+
+def convert_for_range(range_args, body_fn, init):
+    """`for i in range(...)` — python loop when bounds are concrete,
+    lax.while_loop otherwise. Returns (final_i, vars)."""
+    args = tuple(range_args)
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    else:
+        start, stop, step = args
+    if not any(_is_traced(v) for v in (start, stop, step)) \
+            and not any(_is_traced(v) for v in init):
+        i_final = _Undefined("<loop var>")
+        vals = tuple(init)
+        for i in range(int(_data_of(start)), int(_data_of(stop)),
+                       int(_data_of(step))):
+            vals = tuple(body_fn(i, *vals))
+            i_final = i
+        return i_final, vals
+
+    start = jnp.asarray(_data_of(start), jnp.int32)
+    stop = jnp.asarray(_data_of(stop), jnp.int32)
+    step = jnp.asarray(_data_of(step), jnp.int32)
+    extract, rebuild, dyn_idx = _pack(init)
+    probe = tuple(body_fn(0, *init))
+    _check_statics("for", init, probe, dyn_idx)
+
+    def cond_w(carry):
+        i, dyn = carry
+        return jnp.where(step > 0, i < stop, i > stop)
+
+    def body_w(carry):
+        i, dyn = carry
+        out = extract(tuple(body_fn(i, *rebuild(dyn))))
+        return (i + step, out)
+
+    init_dyn = extract(init)
+    specs = (jax.ShapeDtypeStruct((), jnp.int32),
+             tuple(jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
+                   for a in init_dyn))
+    probe_c = jax.eval_shape(body_w, specs)
+    init_dyn = tuple(jnp.asarray(a, s.dtype)
+                     for a, s in zip(init_dyn, probe_c[1]))
+    i_end, out_dyn = lax.while_loop(cond_w, body_w, (start, init_dyn))
+    # python leaves the loop var at its last taken value
+    return i_end - step, rebuild(out_dyn)
+
+
+def convert_for_iter(iterable, body_fn, init):
+    """`for x in xs` — lax.scan over axis 0 for tensors, python otherwise.
+    Returns (final_x, vars)."""
+    data = _data_of(iterable)
+    if isinstance(data, (jax.Array, jax.core.Tracer)) and jnp.ndim(data) > 0:
+        wrap = isinstance(iterable, Tensor)
+        extract, rebuild, dyn_idx = _pack(init)
+        x0 = Tensor(data[0]) if wrap else data[0]
+        probe = tuple(body_fn(x0, *init))
+        _check_statics("for", init, probe, dyn_idx)
+
+        def step(dyn, x):
+            xv = Tensor(x) if wrap else x
+            return extract(tuple(body_fn(xv, *rebuild(dyn)))), None
+
+        init_dyn = extract(init)
+        specs = tuple(jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
+                      for a in init_dyn)
+        probe_dyn = jax.eval_shape(lambda d: step(d, data[0])[0], specs)
+        init_dyn = tuple(jnp.asarray(a, s.dtype)
+                         for a, s in zip(init_dyn, probe_dyn))
+        out_dyn, _ = lax.scan(step, init_dyn, data)
+        last = Tensor(data[-1]) if wrap else data[-1]
+        return last, rebuild(out_dyn)
+    x_final = _Undefined("<loop var>")
+    vals = tuple(init)
+    for x in iterable:
+        vals = tuple(body_fn(x, *vals))
+        x_final = x
+    return x_final, vals
+
+
+def convert_logical_and(lhs_thunk, rhs_thunk):
+    a = lhs_thunk()
+    if _is_traced(a) or isinstance(_data_of(a), jax.Array):
+        b = rhs_thunk()
+        out = jnp.logical_and(jnp.asarray(_data_of(a)).astype(bool),
+                              jnp.asarray(_data_of(b)).astype(bool))
+        return Tensor(out) if isinstance(a, Tensor) else out
+    return rhs_thunk() if a else a
+
+
+def convert_logical_or(lhs_thunk, rhs_thunk):
+    a = lhs_thunk()
+    if _is_traced(a) or isinstance(_data_of(a), jax.Array):
+        b = rhs_thunk()
+        out = jnp.logical_or(jnp.asarray(_data_of(a)).astype(bool),
+                             jnp.asarray(_data_of(b)).astype(bool))
+        return Tensor(out) if isinstance(a, Tensor) else out
+    return a if a else rhs_thunk()
+
+
+def convert_logical_not(x):
+    if _is_traced(x) or isinstance(_data_of(x), jax.Array):
+        out = jnp.logical_not(jnp.asarray(_data_of(x)).astype(bool))
+        return Tensor(out) if isinstance(x, Tensor) else out
+    return not x
+
+
+# ---------------------------------------------------------------------------
+# AST analysis
+
+def _assigned_names(nodes):
+    out = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                out.add(n.id)
+
+        def visit_FunctionDef(self, n):
+            out.add(n.name)  # the def binds the name; don't descend
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, n):
+            out.add(n.name)
+
+        def visit_Lambda(self, n):
+            pass  # separate scope
+
+        def visit_ListComp(self, n):
+            pass
+
+        visit_SetComp = visit_DictComp = visit_GeneratorExp = visit_ListComp
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return {n for n in out if not n.startswith("__jst")}
+
+
+def _loaded_names(nodes):
+    out = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, ast.Load):
+                out.add(n.id)
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return out
+
+
+def _has_escape(nodes):
+    """True if converting these statements into a separate function would
+    change semantics: a `return` in THIS scope, a break/continue belonging to
+    an enclosing loop, or global/nonlocal anywhere (incl. nested defs, which
+    could rebind our hoisted locals)."""
+    found = False
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_depth = 0
+            self.fn_depth = 0
+
+        def visit_Return(self, n):
+            nonlocal found
+            if self.fn_depth == 0:
+                found = True
+
+        def visit_Break(self, n):
+            nonlocal found
+            if self.fn_depth == 0 and self.loop_depth == 0:
+                found = True
+
+        visit_Continue = visit_Break
+
+        def visit_Global(self, n):
+            nonlocal found
+            found = True
+
+        visit_Nonlocal = visit_Global
+
+        def visit_While(self, n):
+            self.loop_depth += 1
+            self.generic_visit(n)
+            self.loop_depth -= 1
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, n):
+            self.fn_depth += 1
+            self.generic_visit(n)
+            self.fn_depth -= 1
+
+        visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for node in nodes:
+        v.visit(node)
+    return found
+
+
+def _ends_with_return(body):
+    return len(body) > 0 and isinstance(body[-1], ast.Return) \
+        and body[-1].value is not None
+
+
+_JST = "__jst_rt"
+
+
+def _jst_call(fn_name, *args):
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                           attr=fn_name, ctx=ast.Load()),
+        args=list(args), keywords=[])
+
+
+def _get_local_default(name):
+    # __jst_rt.get_local(locals(), 'name')
+    return _jst_call("get_local",
+                     ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                              args=[], keywords=[]),
+                     ast.Constant(value=name))
+
+
+def _function_def(name, args, body):
+    kwargs = dict(name=name, args=args, body=body, decorator_list=[],
+                  returns=None)
+    try:
+        return ast.FunctionDef(type_params=[], **kwargs)  # py >= 3.12
+    except TypeError:
+        return ast.FunctionDef(**kwargs)
+
+
+def _make_branch_fn(name, params, body, outputs):
+    """def name(p1=get_local(locals(),'p1'), ...): body; return (o1, ...)"""
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[_get_local_default(p) for p in params])
+    ret = ast.Return(value=ast.Tuple(
+        elts=[ast.Name(id=o, ctx=ast.Load()) for o in outputs],
+        ctx=ast.Load()))
+    return _function_def(name, args, list(body) + [ret])
+
+
+def _tuple_store(names):
+    if not names:
+        return ast.Name(id="__jst_void", ctx=ast.Store())
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                     ctx=ast.Store())
+
+
+class _Dy2Static(ast.NodeTransformer):
+    def __init__(self, fn_locals):
+        self.fn_locals = fn_locals
+        self.n = 0
+
+    def _uid(self):
+        self.n += 1
+        return self.n
+
+    def _vars_for(self, bodies, extra_reads=()):
+        assigned = _assigned_names([s for b in bodies for s in b])
+        loaded = _loaded_names([s for b in bodies for s in b]) | \
+            set(extra_reads)
+        inputs = sorted(assigned | (loaded & self.fn_locals))
+        return inputs, sorted(assigned)
+
+    # --- if / elif / else ---------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse
+        both_return = _ends_with_return(body) and _ends_with_return(orelse)
+        uid = self._uid()
+        ret_name = f"__jst_ret{uid}"
+        if both_return:
+            # rewrite the trailing returns into an extra merged output
+            body = body[:-1] + [ast.Assign(
+                targets=[ast.Name(id=ret_name, ctx=ast.Store())],
+                value=body[-1].value)]
+            orelse = orelse[:-1] + [ast.Assign(
+                targets=[ast.Name(id=ret_name, ctx=ast.Store())],
+                value=orelse[-1].value)]
+        if _has_escape(body) or _has_escape(orelse):
+            return node  # python fallback; traced conds raise a clear error
+        inputs, outputs = self._vars_for(
+            [body, orelse], extra_reads=_loaded_names([node.test]))
+        if both_return:
+            outputs = sorted(set(outputs) | {ret_name})
+        tname, fname = f"__jst_true{uid}", f"__jst_false{uid}"
+        tdef = _make_branch_fn(tname, inputs, body, outputs)
+        fdef = _make_branch_fn(fname, inputs, orelse or [ast.Pass()], outputs)
+        call = ast.Assign(
+            targets=[_tuple_store(outputs)],
+            value=_jst_call("convert_ifelse", node.test,
+                            ast.Name(id=tname, ctx=ast.Load()),
+                            ast.Name(id=fname, ctx=ast.Load())))
+        stmts = [tdef, fdef, call]
+        if both_return:
+            stmts.append(ast.Return(
+                value=ast.Name(id=ret_name, ctx=ast.Load())))
+        return stmts
+
+    # --- while --------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_escape(node.body):
+            return node
+        uid = self._uid()
+        inputs, assigned = self._vars_for(
+            [node.body], extra_reads=_loaded_names([node.test]))
+        carry = inputs  # cond + body see the full carry
+        cname, bname = f"__jst_wcond{uid}", f"__jst_wbody{uid}"
+        cargs = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=p) for p in carry],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cdef = _function_def(cname, cargs, [ast.Return(value=node.test)])
+        bdef = _make_branch_fn(bname, carry, node.body, carry)
+        # body fn takes carry positionally (no locals() defaults): strip them
+        bdef.args.defaults = []
+        init = ast.Tuple(elts=[_get_local_default(p) for p in carry],
+                         ctx=ast.Load())
+        call = ast.Assign(
+            targets=[_tuple_store(carry)],
+            value=_jst_call("convert_while_loop",
+                            ast.Name(id=cname, ctx=ast.Load()),
+                            ast.Name(id=bname, ctx=ast.Load()), init))
+        return [cdef, bdef, call]
+
+    # --- for ----------------------------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_escape(node.body):
+            return node
+        uid = self._uid()
+        iter_param = f"__jst_x{uid}"
+        # loop target: simple name binds directly; tuple target unpacks inside
+        target_names = _assigned_names(
+            [ast.Assign(targets=[node.target], value=ast.Constant(value=0))])
+        prelude = []
+        if isinstance(node.target, ast.Name):
+            bind = node.target.id
+        else:
+            bind = iter_param
+            prelude = [ast.Assign(
+                targets=[node.target],
+                value=ast.Name(id=iter_param, ctx=ast.Load()))]
+        body = prelude + node.body
+        inputs, assigned = self._vars_for([body])
+        carry = [v for v in inputs if v not in target_names and
+                 v != iter_param]
+        bname = f"__jst_fbody{uid}"
+        bdef = _make_branch_fn(bname, [bind] + carry, body, carry)
+        bdef.args.defaults = []
+        init = ast.Tuple(elts=[_get_local_default(p) for p in carry],
+                         ctx=ast.Load())
+        is_range = isinstance(node.iter, ast.Call) and \
+            isinstance(node.iter.func, ast.Name) and \
+            node.iter.func.id == "range" and not node.iter.keywords and \
+            not any(isinstance(a, ast.Starred) for a in node.iter.args)
+        if is_range:
+            rargs = ast.Tuple(elts=list(node.iter.args), ctx=ast.Load())
+            value = _jst_call("convert_for_range", rargs,
+                              ast.Name(id=bname, ctx=ast.Load()), init)
+        else:
+            value = _jst_call("convert_for_iter", node.iter,
+                              ast.Name(id=bname, ctx=ast.Load()), init)
+        lv = f"__jst_lv{uid}"
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[ast.Name(id=lv, ctx=ast.Store()),
+                                     _tuple_store(carry)],
+                               ctx=ast.Store())],
+            value=value)
+        # python semantics: the loop target keeps its prior value when the
+        # loop body never ran
+        restore = ast.Assign(
+            targets=[ast.Name(id=bind, ctx=ast.Store())],
+            value=_jst_call("pick", ast.Name(id=lv, ctx=ast.Load()),
+                            _get_local_default(bind)))
+        stmts = [bdef, call, restore]
+        if prelude and target_names:
+            # re-expose tuple loop targets after the loop
+            stmts.append(ast.If(
+                test=_jst_call("is_defined",
+                               ast.Name(id=bind, ctx=ast.Load())),
+                body=[ast.Assign(targets=[node.target],
+                                 value=ast.Name(id=bind, ctx=ast.Load()))],
+                orelse=[]))
+        return stmts
+
+    # --- boolean operators / conditional expressions ------------------------
+    def _thunk(self, expr):
+        return ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=expr)
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = "convert_logical_and" if isinstance(node.op, ast.And) \
+            else "convert_logical_or"
+        expr = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            expr = _jst_call(fn, self._thunk(v), self._thunk(expr))
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", node.operand)
+        return node
+
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        return _jst_call("convert_ifelse_expr", node.test,
+                         self._thunk(node.body), self._thunk(node.orelse))
+
+
+def is_defined(x):
+    return not isinstance(x, _Undefined)
+
+
+def pick(new, old):
+    return old if isinstance(new, _Undefined) else new
+
+
+class _Runtime:
+    """Namespace object injected as __jst_rt into converted code."""
+    get_local = staticmethod(get_local)
+    is_defined = staticmethod(is_defined)
+    pick = staticmethod(pick)
+    convert_ifelse = staticmethod(convert_ifelse)
+    convert_ifelse_expr = staticmethod(convert_ifelse_expr)
+    convert_while_loop = staticmethod(convert_while_loop)
+    convert_for_range = staticmethod(convert_for_range)
+    convert_for_iter = staticmethod(convert_for_iter)
+    convert_logical_and = staticmethod(convert_logical_and)
+    convert_logical_or = staticmethod(convert_logical_or)
+    convert_logical_not = staticmethod(convert_logical_not)
+
+
+_conversion_cache = weakref.WeakKeyDictionary()
+
+
+def convert_to_static(fn):
+    """AST-convert a function/bound method's control flow. Returns the
+    converted callable, or `fn` unchanged when conversion is impossible
+    (no source, lambdas, closures over cells we cannot rebind safely)."""
+    bound_self = None
+    target = fn
+    if isinstance(fn, types.MethodType):
+        bound_self = fn.__self__
+        target = fn.__func__
+    try:
+        return _make_converted(target, bound_self)
+    except (OSError, TypeError, SyntaxError, ValueError):
+        return fn
+
+
+def _make_converted(target, bound_self):
+    cached = _conversion_cache.get(target)
+    if cached is None:
+        if "__class__" in target.__code__.co_freevars:
+            # zero-arg super() needs the real __class__ cell, which cannot be
+            # snapshotted into exec globals — leave such forwards unconverted
+            raise TypeError("cannot convert functions using zero-arg super()")
+        src = textwrap.dedent(inspect.getsource(target))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            raise TypeError("not a function def")
+        fdef.decorator_list = []
+        arg_names = {a.arg for a in fdef.args.args + fdef.args.kwonlyargs}
+        if fdef.args.vararg:
+            arg_names.add(fdef.args.vararg.arg)
+        if fdef.args.kwarg:
+            arg_names.add(fdef.args.kwarg.arg)
+        fn_locals = arg_names | _assigned_names(fdef.body)
+        transformer = _Dy2Static(fn_locals)
+        new_tree = transformer.visit(tree)
+        ast.fix_missing_locations(new_tree)
+        glb = dict(target.__globals__)
+        glb[_JST] = _Runtime
+        # snapshot closure cells into the exec globals (read-only capture)
+        if target.__closure__:
+            for name, cell in zip(target.__code__.co_freevars,
+                                  target.__closure__):
+                try:
+                    glb[name] = cell.cell_contents
+                except ValueError:
+                    raise TypeError("empty closure cell")
+        code = compile(new_tree, filename=f"<dy2static {target.__qualname__}>",
+                       mode="exec")
+        ns = {}
+        exec(code, glb, ns)  # noqa: S102 — compiling our own transform
+        converted = ns[fdef.name]
+        converted.__dy2static_original__ = target
+        _conversion_cache[target] = converted
+        cached = converted
+    if bound_self is not None:
+        return types.MethodType(cached, bound_self)
+    return cached
