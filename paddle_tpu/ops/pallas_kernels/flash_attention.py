@@ -6,6 +6,15 @@ gpu/flash_attn_kernel.cu capability) with a TPU-native kernel: the grid walks
 lives in VMEM scratch across the k-block sweep, scores are computed on the MXU
 in fp32, and causal q<k blocks are skipped entirely (predicated grid steps).
 
+TPU layout notes (Mosaic (8,128) tiling rule): every pallas output/input block
+must have its last two dims divisible by (8, 128) or equal to the full array
+dims.  Per-row statistics (LSE) therefore travel lane-broadcast as
+[bq, 128] tiles — shaped (BH, Sq, 128) with all 128 lanes equal — exactly the
+layout the reference-quality TPU kernels use; the wrapper slices lane 0 off to
+hand a compact (BH, Sq) LSE to the backward, which re-broadcasts.  The LSE
+output only exists when residuals are requested, so inference pays no extra
+HBM traffic.
+
 Backward: pallas kernels in flash_attention_bwd.py (LSE saved by this
 forward, scores recomputed blockwise on the MXU). The differentiable blockwise
 XLA path (ops/blockwise_attention.py) remains as the interpret/fallback
@@ -21,7 +30,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..blockwise_attention import blockwise_attention
-from .flash_attention_bwd import flash_attention_backward
+from .flash_attention_bwd import LANES, flash_attention_backward
 
 _NEG_INF = -1e30
 
@@ -71,8 +80,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l = jnp.maximum(jnp.max(l_scr[:, :], axis=1, keepdims=True),
                         jnp.float32(1e-30))
         o_ref[0, :, :] = (acc_scr[:, :] / l).astype(o_ref.dtype)
-        m = jnp.max(m_scr[:, :], axis=1)
-        lse_ref[0, :] = m + jnp.log(jnp.max(l_scr[:, :], axis=1))
+        if lse_ref is not None:
+            m = jnp.max(m_scr[:, :], axis=1, keepdims=True)   # [bq, 1]
+            lse = m + jnp.log(jnp.maximum(
+                jnp.max(l_scr[:, :], axis=1, keepdims=True), 1e-30))
+            # lane-broadcast write: (bq, 128) tile, every lane equal
+            lse_ref[0, :, :] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _fwd_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, None, m_scr, l_scr, acc_scr, **kw)
 
 
 def _pallas_forward(q, k, v, causal, block_q=256, block_k=256,
@@ -104,32 +121,50 @@ def _pallas_forward(q, k, v, causal, block_q=256, block_k=256,
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
     grid = (B * H, nq, nk)
     interpret = interpret or jax.default_backend() != "tpu"
-    kernel = functools.partial(_fwd_kernel, causal=causal, nk=nk, bq=block_q,
-                               bk=block_k, scale=scale)
+    kw = dict(causal=causal, nk=nk, bq=block_q, bk=block_k, scale=scale)
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+    ]
+    scratch = [
+        pltpu.VMEM((block_q, LANES), jnp.float32),
+        pltpu.VMEM((block_q, LANES), jnp.float32),
+        pltpu.VMEM((block_q, D), jnp.float32),
+    ]
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
     # Mosaic rejects x64-typed index math; the framework enables x64 globally
     # for dtype parity, so pin 32-bit types inside the kernel trace.
+    o_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    if with_residuals:
+        kernel = functools.partial(_fwd_kernel, **kw)
+        # lane-broadcast LSE: (8,128)-tileable; lane 0 sliced off below so
+        # the saved residual is the compact (BH, Sq)
+        out_shape = (jax.ShapeDtypeStruct(qb.shape, q.dtype),
+                     jax.ShapeDtypeStruct((B * H, Sq, LANES), jnp.float32))
+        out_specs = (o_spec, pl.BlockSpec((1, block_q, LANES),
+                                          lambda b, i, j: (b, i, 0)))
+    else:
+        kernel = functools.partial(_fwd_kernel_nolse, **kw)
+        out_shape = jax.ShapeDtypeStruct(qb.shape, q.dtype)
+        out_specs = o_spec
     with jax.enable_x64(False):
-        out, lse = pl.pallas_call(
+        result = pl.pallas_call(
             kernel,
-            out_shape=(jax.ShapeDtypeStruct(qb.shape, q.dtype),
-                       jax.ShapeDtypeStruct(qb.shape[:2], jnp.float32)),
+            out_shape=out_shape,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            ],
-            out_specs=(
-                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((block_q, 128), jnp.float32),
-                pltpu.VMEM((block_q, 128), jnp.float32),
-                pltpu.VMEM((block_q, D), jnp.float32),
-            ],
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
+            compiler_params=params,
             interpret=interpret,
         )(qb, kb, vb)
+    if with_residuals:
+        out, lse = result
+        lse = lse[:, :, 0]
+    else:
+        out, lse = result, None
     res = (qb, kb, vb, out, lse, scale) if with_residuals else None
     out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
     out = out[..., :D0] if D0 != D else out

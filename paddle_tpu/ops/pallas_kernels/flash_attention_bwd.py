@@ -8,6 +8,11 @@ scratch). Nothing S×S ever touches HBM, and causal off-diagonal blocks are
 skipped via predicated grid steps — same blocking discipline as the forward
 kernel in flash_attention.py.
 
+Per-row vectors (LSE, delta) are fed lane-broadcast as (BH, Sq, 128) tiles —
+Mosaic's (8,128) tiling rule forbids a (1, block_q) block over a (BH, Sq)
+array — and reduced back to [bq, 1] inside the kernel with a lane-max (all
+lanes equal).
+
 Replaces the reference's fused CUDA flash_attn_grad kernel (ref: paddle/phi/
 kernels/gpu/flash_attn_grad_kernel.cu capability).
 """
@@ -21,6 +26,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+LANES = 128
+
+
+def _row_stat(ref):
+    """Collapse a lane-broadcast [bq, LANES] block to [bq, 1] (lanes equal)."""
+    return jnp.max(ref[0, :, :].astype(jnp.float32), axis=1, keepdims=True)
 
 
 def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, bq, bk, scale, causal):
@@ -33,8 +44,8 @@ def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, bq, bk, scale, causal):
         q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(k_pos <= q_pos, s, jnp.float32(_NEG_INF))
-    lse = lse_ref[0, :].astype(jnp.float32)             # [bq]
-    return q, k, jnp.exp(s - lse[:, None])              # p: [bq, bk]
+    lse = _row_stat(lse_ref)                            # [bq, 1]
+    return q, k, jnp.exp(s - lse)                       # p: [bq, bk]
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -54,10 +65,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                causal)
         do = do_ref[0, :, :].astype(jnp.float32)        # [bq, D]
         v = v_ref[0, :, :].astype(jnp.float32)          # [bk, D]
-        delta = delta_ref[0, :].astype(jnp.float32)     # [bq]
+        delta = _row_stat(delta_ref)                    # [bq, 1]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * jnp.float32(scale)
+        ds = p * (dp - delta) * jnp.float32(scale)
         dq_scr[:, :] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -85,12 +96,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                causal)
         do = do_ref[0, :, :].astype(jnp.float32)        # [bq, D]
         v = v_ref[0, :, :].astype(jnp.float32)          # [bk, D]
-        delta = delta_ref[0, :].astype(jnp.float32)     # [bq]
+        delta = _row_stat(delta_ref)                    # [bq, 1]
         dv_scr[:, :] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * jnp.float32(scale)
+        ds = p * (dp - delta) * jnp.float32(scale)
         dk_scr[:, :] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -117,7 +128,17 @@ def flash_attention_backward(q, k, v, o, lse, do, scale, causal,
     # delta[b, i] = rowsum(dO ∘ O): one fused elementwise+reduce in XLA
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
+    # lane-broadcast the per-row stats so their blocks satisfy (8,128) tiling
+    lse_b = jnp.broadcast_to(lse.astype(jnp.float32)[:, :, None],
+                             (BH, Sq, LANES))
+    delta_b = jnp.broadcast_to(delta[:, :, None], (BH, Sq, LANES))
+
     common = dict(causal=causal, bq=block_q, bk=block_k, scale=scale)
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    def row_spec(index_map):
+        return pl.BlockSpec((1, block_q, LANES), index_map)
 
     with jax.enable_x64(False):
         dq = pl.pallas_call(
@@ -129,13 +150,14 @@ def flash_attention_backward(q, k, v, o, lse, do, scale, causal,
                 pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
                 pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
                 pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+                row_spec(lambda b, i, j: (b, i, 0)),
+                row_spec(lambda b, i, j: (b, i, 0)),
             ],
             out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            compiler_params=params,
             interpret=interpret,
-        )(q, k, v, do, lse, delta)
+        )(q, k, v, do, lse_b, delta_b)
 
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_kernel, nq=nq, **common),
@@ -147,8 +169,8 @@ def flash_attention_backward(q, k, v, o, lse, do, scale, causal,
                 pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
                 pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
                 pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+                row_spec(lambda b, j, i: (b, i, 0)),
+                row_spec(lambda b, j, i: (b, i, 0)),
             ],
             out_specs=(
                 pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
@@ -156,6 +178,7 @@ def flash_attention_backward(q, k, v, o, lse, do, scale, causal,
             ),
             scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                             pltpu.VMEM((block_k, D), jnp.float32)],
+            compiler_params=params,
             interpret=interpret,
-        )(q, k, v, do, lse, delta)
+        )(q, k, v, do, lse_b, delta_b)
     return dq, dk, dv

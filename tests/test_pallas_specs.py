@@ -1,0 +1,135 @@
+"""Static Mosaic tiling-rule validation for every pallas_call in the repo.
+
+Round-2 lesson: interpret mode validates numerics but NOT TPU lowering — the
+flash forward's LSE block spec ``(1, block_q)`` over a ``(B*H, Sq)`` array
+passed every CPU test and then failed Mosaic's (8,128) tiling rule on
+hardware, zeroing the round's bench. This test intercepts ``pl.pallas_call``
+and statically checks each block spec against the rule Mosaic enforces
+(ref error text: "the last two dimensions of your block shape are divisible
+by 8 and 128 respectively, or be equal to the respective dimensions of the
+overall array"), so the bug class is caught on CPU-only CI.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.experimental import pallas as pl
+
+import paddle_tpu  # noqa: F401  (conftest sets up the 8-device CPU mesh)
+
+
+def _assert_tileable(block_shape, arr_shape, what):
+    if block_shape is None:  # whole-array block: trivially fine
+        return
+    bs = tuple(block_shape)
+    ash = tuple(arr_shape)
+    assert len(bs) == len(ash), f"{what}: rank mismatch {bs} vs {ash}"
+    if len(bs) == 0:
+        return
+    if len(bs) == 1:
+        ok = bs[-1] % 128 == 0 or bs[-1] == ash[-1]
+        assert ok, f"{what}: 1-D block {bs} over {ash} violates lane tiling"
+        return
+    lane_ok = bs[-1] % 128 == 0 or bs[-1] == ash[-1]
+    sub_ok = bs[-2] % 8 == 0 or bs[-2] == ash[-2]
+    assert lane_ok, (
+        f"{what}: block {bs} over array {ash} — last dim {bs[-1]} not a "
+        f"multiple of 128 nor equal to array dim {ash[-1]}")
+    assert sub_ok, (
+        f"{what}: block {bs} over array {ash} — 2nd-to-last dim {bs[-2]} not "
+        f"a multiple of 8 nor equal to array dim {ash[-2]}")
+
+
+def _spec_block(spec):
+    if spec is None:
+        return None
+    return getattr(spec, "block_shape", None)
+
+
+@pytest.fixture
+def strict_pallas(monkeypatch):
+    """Patch pl.pallas_call (as seen by the kernel modules) to validate every
+    in/out block spec against the Mosaic (8,128) rule at call time."""
+    seen = []
+    real = pl.pallas_call
+
+    def checked(kernel, *, out_shape, in_specs=None, out_specs=None, **kw):
+        inner = real(kernel, out_shape=out_shape, in_specs=in_specs,
+                     out_specs=out_specs, **kw)
+        name = getattr(kernel, "func", kernel)
+        name = getattr(name, "__name__", str(kernel))
+
+        @functools.wraps(inner)
+        def run(*args):
+            if in_specs is not None:
+                flat_args = jax.tree_util.tree_leaves(args)
+                flat_specs = list(in_specs)
+                assert len(flat_specs) == len(flat_args)
+                for i, (s, a) in enumerate(zip(flat_specs, flat_args)):
+                    _assert_tileable(_spec_block(s), a.shape,
+                                     f"{name} inputs[{i}]")
+            outs = jax.tree_util.tree_leaves(
+                out_shape, is_leaf=lambda x: hasattr(x, "shape"))
+            specs = (jax.tree_util.tree_leaves(
+                out_specs, is_leaf=lambda s: isinstance(s, pl.BlockSpec))
+                if out_specs is not None else [None] * len(outs))
+            for i, (s, o) in enumerate(zip(specs, outs)):
+                _assert_tileable(_spec_block(s), o.shape,
+                                 f"{name} outputs[{i}]")
+            seen.append(name)
+            return inner(*args)
+
+        return run
+
+    import paddle_tpu.ops.pallas_kernels.flash_attention as fa
+    import paddle_tpu.ops.pallas_kernels.flash_attention_bwd as fab
+    monkeypatch.setattr(fa.pl, "pallas_call", checked)
+    monkeypatch.setattr(fab.pl, "pallas_call", checked)
+    return seen
+
+
+def test_flash_forward_specs_tileable(strict_pallas):
+    from paddle_tpu.ops.pallas_kernels.flash_attention import (
+        flash_attention_interpret)
+    q = jnp.ones((1, 256, 2, 64), jnp.float32)
+    out, res = flash_attention_interpret(q, q, q, causal=True,
+                                         block_q=128, block_k=128)
+    assert out.shape == q.shape
+    assert any("_fwd_kernel" in s for s in strict_pallas)
+
+
+def test_flash_forward_noresidual_specs_tileable(strict_pallas):
+    from paddle_tpu.ops.pallas_kernels import flash_attention as fa
+    q = jnp.ones((1, 256, 2, 64), jnp.float32)
+    out = fa._pallas_forward(q, q, q, causal=True, block_q=128, block_k=128,
+                             interpret=True)
+    assert out.shape == q.shape
+    assert any("_fwd_kernel_nolse" in s for s in strict_pallas)
+
+
+def test_flash_backward_specs_tileable(strict_pallas):
+    from paddle_tpu.ops.pallas_kernels.flash_attention import (
+        flash_attention_interpret)
+    from paddle_tpu.ops.pallas_kernels.flash_attention_bwd import (
+        flash_attention_backward)
+    q = jnp.ones((1, 256, 2, 64), jnp.float32)
+    _, (qb, kb, vb, ob, lse, scale) = flash_attention_interpret(
+        q, q, q, causal=True, block_q=128, block_k=128)
+    do = jnp.ones_like(qb)
+    dq, dk, dv = flash_attention_backward(qb, kb, vb, ob, lse, do, scale,
+                                          True, block_q=128, block_k=128,
+                                          interpret=True)
+    assert dq.shape == qb.shape
+    assert any("_dq_kernel" in s for s in strict_pallas)
+    assert any("_dkv_kernel" in s for s in strict_pallas)
+
+
+def test_validator_catches_round2_bug():
+    """The exact round-2 failure — a (1, block_q) block over a (BH, Sq)
+    array — must be rejected by the validator."""
+    with pytest.raises(AssertionError, match="not a multiple of 8"):
+        _assert_tileable((1, 128), (8, 1024), "lse out")
+    # and the fixed lane-broadcast layout passes
+    _assert_tileable((1, 128, 128), (8, 1024, 128), "lse out fixed")
+    _assert_tileable((1, 128, 64), (8, 1024, 64), "full-lane-dim block")
