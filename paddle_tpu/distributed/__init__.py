@@ -12,7 +12,7 @@ from . import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, Shard, Replicate, Partial, shard_tensor, reshard, shard_layer,
-    shard_optimizer, dtensor_from_fn,
+    shard_optimizer, dtensor_from_fn, dtensor_from_local, to_static, DistModel,
 )
 from .pipeline import pipeline_spmd, run_pipeline, PipelineLayer, LayerDesc  # noqa: F401
 from .ring_attention import (  # noqa: F401

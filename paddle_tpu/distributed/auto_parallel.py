@@ -1,14 +1,27 @@
 """Semi-automatic parallelism (ref: python/paddle/distributed/auto_parallel/).
 
-The reference's shard_tensor annotations + partitioner + reshard pipeline maps
-almost one-to-one onto GSPMD: `shard_tensor` attaches a PartitionSpec, the XLA
-partitioner propagates shardings and inserts resharding collectives. ProcessMesh
-wraps jax.sharding.Mesh.
+The reference pipeline — shard_tensor annotations -> partitioner -> reshard
+pass -> distributed Program (ref: auto_parallel/interface.py,
+static/engine.py, static/reshard.py) — maps onto GSPMD: `shard_tensor`
+attaches placements and physically places the data, the XLA partitioner
+propagates shardings and inserts the collectives the reference's reshard
+pass would have emitted, and `to_static` bridges a (layer, loader, loss,
+optimizer) tuple into one compiled SPMD train step (`DistModel`).
+
+Placement semantics:
+  Shard(d)   — dim d split over the mesh axis at the placement's position.
+  Replicate  — full copy on every device along that axis.
+  Partial    — each device holds a partial term; the global value is the
+               axis-reduction of the locals. Physically the locals live in a
+               stacked (axis_size, *shape) buffer sharded over the mesh axis;
+               the logical value is reduced ON READ (jnp.sum over the sharded
+               axis == psum over ICI) — see PartialTensor/_materialize.
 """
 from __future__ import annotations
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..tensor_impl import Tensor, Parameter
@@ -46,39 +59,119 @@ class Shard:
     def __init__(self, dim):
         self.dim = dim
 
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
     def __repr__(self):
         return f"Shard(dim={self.dim})"
 
 
 class Replicate:
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
     def __repr__(self):
         return "Replicate()"
 
 
 class Partial:
     def __init__(self, reduce_type="sum"):
+        if reduce_type not in ("sum", "avg", "max", "min"):
+            raise ValueError(f"unsupported reduce_type {reduce_type}")
         self.reduce_type = reduce_type
+
+    def __eq__(self, other):
+        return (isinstance(other, Partial)
+                and other.reduce_type == self.reduce_type)
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+_REDUCERS = {"sum": jnp.sum, "avg": jnp.mean, "max": jnp.max, "min": jnp.min}
 
 
 def _placements_to_spec(placements, ndim, mesh):
     spec = [None] * ndim
     for axis_i, pl in enumerate(placements):
         if isinstance(pl, Shard):
-            spec[pl.dim] = mesh.dim_names[axis_i]
+            if spec[pl.dim] is not None:
+                spec[pl.dim] = (*_as_tuple(spec[pl.dim]),
+                                mesh.dim_names[axis_i])
+            else:
+                spec[pl.dim] = mesh.dim_names[axis_i]
     return P(*spec)
 
 
-def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None, stop_gradient=None):
-    """Attach a distribution annotation and place the data (ref:
-    auto_parallel/api.py shard_tensor)."""
+def _as_tuple(x):
+    return x if isinstance(x, tuple) else (x,)
+
+
+def _normalize_placements(placements, mesh):
+    pls = list(placements)
+    while len(pls) < len(mesh.shape):
+        pls.append(Replicate())
+    return pls
+
+
+def _partial_axes(placements, mesh):
+    return [(mesh.dim_names[i], pl.reduce_type)
+            for i, pl in enumerate(placements) if isinstance(pl, Partial)]
+
+
+def _materialize(stack, axis_name, reduce_type, mesh, spec):
+    """Reduce a (axis_size, *shape) buffer sharded over `axis_name` to the
+    logical value — XLA lowers the reduction over the device-sharded axis to
+    a psum/pmax over ICI (the reference's r_to_p/partial->replicated reshard,
+    ref: auto_parallel/static/reshard_funcs/p_to_r_reshard_func.py)."""
+    out_sharding = NamedSharding(mesh, spec)
+    red = _REDUCERS[reduce_type]
+    return jax.jit(lambda s: red(s, axis=0),
+                   out_shardings=out_sharding)(stack)
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None,
+                 stop_gradient=None):
+    """Attach placements and physically place the data (ref:
+    auto_parallel/api.py shard_tensor).
+
+    Shard/Replicate place via GSPMD NamedSharding. Partial stores the global
+    value on the axis's first device and zeros elsewhere (the reference's
+    replicated->partial convention), keeping the stacked locals in
+    `_partial_stack`; the logical `_data` is the on-read reduction.
+    """
     t = x if isinstance(x, Tensor) else Tensor(x)
+    if dtype is not None:
+        t = Tensor(t._data.astype(dtype), stop_gradient=t.stop_gradient) \
+            if not isinstance(t, Parameter) else t
+    placements = _normalize_placements(placements, mesh)
     spec = _placements_to_spec(placements, t._data.ndim, mesh)
-    sharding = NamedSharding(mesh.mesh, spec)
-    t._data = jax.device_put(t._data, sharding)
-    if isinstance(t, Parameter) or hasattr(t, "dist_spec"):
-        t.dist_spec = spec
+    partials = _partial_axes(placements, mesh)
+    if partials:
+        if len(partials) > 1:
+            raise NotImplementedError("at most one Partial axis")
+        axis_name, reduce_type = partials[0]
+        n = mesh.shape[mesh.dim_names.index(axis_name)]
+        # global value on local rank 0, identity elsewhere (zeros for sum)
+        if reduce_type in ("max", "min"):
+            fill = t._data  # max/min identity: replicate the value
+            stack = jnp.stack([t._data] + [fill] * (n - 1))
+        else:
+            stack = jnp.concatenate(
+                [t._data[None], jnp.zeros((n - 1,) + t._data.shape,
+                                          t._data.dtype)])
+        stack = jax.device_put(
+            stack, NamedSharding(mesh.mesh, P(axis_name, *spec)))
+        t._data = _materialize(stack, axis_name, reduce_type, mesh.mesh, spec)
+        t._partial_stack = (stack, axis_name, reduce_type)
     else:
-        t._placeholder = spec
+        t._data = jax.device_put(t._data, NamedSharding(mesh.mesh, spec))
+        t._partial_stack = None
+    t.dist_spec = spec
+    t.placements = placements
+    t.process_mesh = mesh
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
     return t
 
 
@@ -86,29 +179,207 @@ def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
     return shard_tensor(fn(*args, **kwargs), mesh, placements)
 
 
+def dtensor_from_local(local, mesh: ProcessMesh, placements):
+    """Build a dist tensor from per-device local values (ref:
+    auto_parallel/api.py dtensor_from_local).
+
+    For a Partial placement, `local` carries a leading (axis_size,) dim of
+    per-device partial terms; the logical value is their on-read reduction
+    (psum over the sharded axis). For Shard/Replicate, `local` is the global
+    value and this is shard_tensor.
+    """
+    t = local if isinstance(local, Tensor) else Tensor(local)
+    placements = _normalize_placements(placements, mesh)
+    partials = _partial_axes(placements, mesh)
+    if not partials:
+        return shard_tensor(t, mesh, placements)
+    if len(partials) > 1:
+        raise NotImplementedError("at most one Partial axis")
+    axis_name, reduce_type = partials[0]
+    n = mesh.shape[mesh.dim_names.index(axis_name)]
+    if t._data.shape[0] != n:
+        raise ValueError(
+            f"local leading dim {t._data.shape[0]} != axis size {n}")
+    spec = _placements_to_spec(placements, t._data.ndim - 1, mesh)
+    stack = jax.device_put(t._data,
+                           NamedSharding(mesh.mesh, P(axis_name, *spec)))
+    out = Tensor(_materialize(stack, axis_name, reduce_type, mesh.mesh, spec),
+                 stop_gradient=t.stop_gradient)
+    out._partial_stack = (stack, axis_name, reduce_type)
+    out.dist_spec = spec
+    out.placements = placements
+    out.process_mesh = mesh
+    return out
+
+
 def reshard(x, mesh: ProcessMesh, placements):
+    """Redistribute to new placements (ref: auto_parallel/api.py reshard;
+    static/reshard_funcs/*). All transitions are supported:
+      Shard/Replicate -> Shard/Replicate : GSPMD device_put (XLA moves data)
+      Partial -> Replicate               : reduce the stacked locals (psum)
+      Partial -> Shard(d)                : reduce + split (reduce-scatter)
+      * -> Partial                       : value on axis rank 0, zeros rest
+    """
     t = x if isinstance(x, Tensor) else Tensor(x)
+    placements = _normalize_placements(placements, mesh)
+    partial_src = getattr(t, "_partial_stack", None)
+    want_partial = bool(_partial_axes(placements, mesh))
     spec = _placements_to_spec(placements, t._data.ndim, mesh)
-    t2 = Tensor(jax.device_put(t._data, NamedSharding(mesh.mesh, spec)),
-                stop_gradient=t.stop_gradient)
+
+    if want_partial:
+        out = shard_tensor(Tensor(t._data), mesh, placements,
+                           stop_gradient=t.stop_gradient)
+        return out
+    data = t._data
+    if partial_src is not None:
+        stack, axis_name, reduce_type = partial_src
+        data = _materialize(stack, axis_name, reduce_type, mesh.mesh, spec)
+    else:
+        data = jax.device_put(data, NamedSharding(mesh.mesh, spec))
+    t2 = Tensor(data, stop_gradient=t.stop_gradient)
+    t2.dist_spec = spec
+    t2.placements = placements
+    t2.process_mesh = mesh
+    t2._partial_stack = None
     return t2
 
 
-def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=None):
-    """Annotate a layer's params via shard_fn(name, layer, mesh) or replicate."""
-    for name, sub in layer.named_sublayers(include_self=True):
-        if shard_fn is not None:
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Shard a layer's parameters in place (ref: auto_parallel/api.py
+    shard_layer). shard_fn(sublayer_name, sublayer, mesh) may call
+    shard_tensor on the sublayer's params; without one, every param is
+    replicated onto the mesh (dist_spec set so TrainStep honors it)."""
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
             shard_fn(name, sub, process_mesh)
+    else:
+        for _, p in layer.named_parameters():
+            shard_tensor(p, process_mesh, [Replicate()])
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
     return layer
 
 
-def shard_optimizer(optimizer, shard_fn=None):
-    optimizer._shard_opt_states_axis = getattr(optimizer, "_shard_opt_states_axis",
-                                               None)
+def shard_optimizer(optimizer, shard_fn=None, axis="dp"):
+    """Shard optimizer slot states over a mesh axis (ref: auto_parallel/
+    api.py shard_optimizer; fleet sharding stage-1 state partitioning).
+
+    Two integration points:
+      * compiled path: TrainStep/HybridTrainStep read
+        `_shard_opt_states_axis` and emit GSPMD shardings that split every
+        replicated param's slots over the axis (ZeRO-1).
+      * eager path: slot creation is wrapped so each new slot is placed
+        sharded (shard_fn(param, slot_name, array) -> placements may
+        override).
+    """
+    optimizer._shard_opt_states_axis = axis
+    mesh = env.get_mesh()
+    if mesh is None or axis not in getattr(mesh, "axis_names", ()):
+        return optimizer
+    n = mesh.shape[axis]
+    orig_create = optimizer._create_slots
+
+    def sharded_create(p_data):
+        slots = orig_create(p_data)
+        out = {}
+        for name, arr in slots.items():
+            if shard_fn is not None:
+                pl = shard_fn(name, arr)
+                if pl is not None:
+                    out[name] = jax.device_put(arr, NamedSharding(
+                        mesh, _placements_to_spec(pl, arr.ndim,
+                                                  _MeshView(mesh))))
+                    continue
+            if arr.ndim >= 1 and arr.shape[0] % n == 0:
+                out[name] = jax.device_put(arr, NamedSharding(
+                    mesh, P(axis, *([None] * (arr.ndim - 1)))))
+            else:
+                out[name] = arr
+        return out
+
+    optimizer._create_slots = sharded_create
     return optimizer
 
 
+class _MeshView:
+    """Duck-typed ProcessMesh view over a raw jax Mesh (for helpers that
+    only need dim_names/shape)."""
+
+    def __init__(self, mesh):
+        self.dim_names = list(mesh.axis_names)
+        self.shape = [mesh.shape[a] for a in mesh.axis_names]
+        self._jax_mesh = mesh
+
+    @property
+    def mesh(self):
+        return self._jax_mesh
+
+
+class DistModel:
+    """Compiled semi-auto training handle (ref: auto_parallel/api.py
+    DistModel / static/engine.py Engine).
+
+    Wraps jit.TrainStep: parameters keep the shardings their `shard_tensor`
+    annotations attached (dist_spec), XLA partitions the step, and each
+    __call__ runs one SPMD train (or eval) step returning the loss.
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, mesh=None):
+        from ..jit.train_step import TrainStep
+        self._layer = layer
+        self._loader = loader
+        self._mode = "train"
+        if mesh is None:
+            mesh = env.get_mesh()
+        self._mesh = mesh
+        self._train_step = None
+        if loss is not None and optimizer is not None:
+            self._train_step = TrainStep(layer, loss, optimizer, mesh=mesh)
+        self._loss = loss
+
+    def train(self):
+        self._mode = "train"
+        self._layer.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self._layer.eval()
+
+    def __call__(self, *batch):
+        inputs, labels = batch[:-1], batch[-1]
+        if self._mode == "train":
+            if self._train_step is None:
+                raise ValueError("DistModel needs loss+optimizer to train")
+            return self._train_step(list(inputs), labels)
+        out = self._layer(*inputs)
+        if self._loss is not None:
+            return self._loss(out, labels)
+        return out
+
+    def state_dict(self, mode="all"):
+        self._sync()
+        return self._layer.state_dict()
+
+    def _sync(self):
+        if self._train_step is not None and self._train_step._jitted is not None:
+            self._train_step.sync_to_model()
+
+    @property
+    def dist_main_program(self):
+        """HLO text of the compiled step (the Program analog)."""
+        if self._train_step is None or self._train_step._jitted is None:
+            return None
+        return "<compiled XLA SPMD step>"
+
+
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
-    raise NotImplementedError(
-        "auto_parallel.to_static: use paddle_tpu.jit.TrainStep with a mesh; "
-        "GSPMD performs the partitioning that the reference's planner does.")
+    """Bridge dygraph semi-auto annotations into one compiled SPMD step
+    (ref: auto_parallel/api.py to_static -> DistModel)."""
+    return DistModel(layer, loader=loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy)

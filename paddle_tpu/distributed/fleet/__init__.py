@@ -159,6 +159,12 @@ class Fleet:
         optimizer._shard_opt_states_axis = (
             "sharding" if self._zero_stage >= 1 and
             (get_mesh() and get_mesh().shape.get("sharding", 1) > 1) else None)
+        strategy = strategy or self._strategy
+        if strategy is not None and getattr(strategy, "gradient_merge", False):
+            # ref: fleet/meta_optimizers/gradient_merge_optimizer.py —
+            # TrainStep fuses the k-step accumulation into the compiled step
+            optimizer._gradient_merge_k = int(
+                strategy.gradient_merge_configs.get("k_steps", 1))
         return optimizer
 
 

@@ -64,8 +64,10 @@ GPT_CONFIGS = {
 
 
 def _attention(q, k, v, use_flash, causal=True):
-    """q,k,v arrays [B,S,H,D] -> [B,S,H,D]."""
-    if use_flash and jax.default_backend() == "tpu" and q.shape[1] % 256 == 0:
+    """q,k,v arrays [B,S,H,D] -> [B,S,H,D]. Routed by the same logged
+    predicate as nn.functional (flash_supported) so gating can't drift."""
+    from ..ops.pallas_kernels.flash_attention import flash_supported
+    if use_flash and flash_supported(q.shape, kv_seq=k.shape[1], why="gpt"):
         from ..ops.pallas_kernels.flash_attention import flash_attention_bshd
         return flash_attention_bshd(q, k, v, causal)
     return blockwise_attention(q, k, v, causal=causal)

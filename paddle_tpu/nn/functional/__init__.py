@@ -12,5 +12,5 @@ from .norm import (  # noqa: F401
 from .loss import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
-    scaled_dot_product_attention, flash_attention,
+    scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
 )

@@ -1,10 +1,14 @@
 """Attention functionals.
 
 Parity target: paddle.nn.functional.scaled_dot_product_attention and the
-incubate fused flash_attention ops (ref: python/paddle/incubate/nn/functional).
-On TPU the hot path routes to a pallas flash-attention kernel
-(paddle_tpu/ops/pallas_kernels/flash_attention.py); elsewhere (CPU tests) it
-uses the composed XLA path below.
+incubate fused flash_attention ops (ref: python/paddle/nn/functional/
+flash_attention.py, python/paddle/incubate/nn/functional). On TPU the hot
+path routes to a pallas flash-attention kernel
+(paddle_tpu/ops/pallas_kernels/flash_attention.py) — including masked
+(bias), dropout, and varlen (`flash_attn_unpadded`) forms; elsewhere (CPU
+tests) it uses the composed XLA path below. Routing goes through ONE logged
+predicate (`flash_supported`) shared with the model code so gating can't
+drift between callers.
 """
 from __future__ import annotations
 
@@ -12,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ...dispatch import apply as _apply
+from ...ops.pallas_kernels.flash_attention import flash_supported
 from ...tensor_impl import Tensor
 
 
@@ -27,11 +32,21 @@ def _sdpa_probs(q, k, mask=None, causal=False, scale=None):
         cm = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
         logits = jnp.where(cm, logits, -1e30)
     if mask is not None:
-        if mask.dtype == jnp.bool_:
-            logits = jnp.where(mask, logits, -1e30)
-        else:
-            logits = logits + mask.astype(jnp.float32)
+        logits = logits + _mask_to_bias(mask)
     return jax.nn.softmax(logits, axis=-1)
+
+
+def _mask_to_bias(mask):
+    """Normalize a paddle-style attn_mask (bool keep-mask or additive float,
+    any broadcastable rank) to an additive fp32 bias of rank 4."""
+    m = mask
+    if m.dtype == jnp.bool_:
+        m = jnp.where(m, jnp.float32(0), jnp.float32(-1e30))
+    else:
+        m = m.astype(jnp.float32)
+    while m.ndim < 4:
+        m = m[None]
+    return m
 
 
 def _sdpa_reference(q, k, v, mask=None, causal=False, scale=None, dropout_key=None,
@@ -47,39 +62,54 @@ def _sdpa_reference(q, k, v, mask=None, causal=False, scale=None, dropout_key=No
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, name=None):
-    """paddle layout: [batch, seq, num_heads, head_dim]."""
+    """paddle layout: [batch, seq, num_heads, head_dim].
+
+    On TPU with MXU-friendly shapes this runs the pallas flash kernel for
+    masked, dropout, and plain forms alike; otherwise the composed XLA path
+    (identical semantics; dropout bits differ since the kernel uses the TPU
+    PRNG)."""
     from ...framework.random import next_key
-    dropout_key = next_key() if (dropout_p > 0.0 and training) else None
-    # the pallas kernel has no dropout yet — keep backends numerically
-    # equivalent by routing dropout through the composed path
-    use_flash = _flash_ok(query) and dropout_key is None
+    p = dropout_p if training else 0.0
+    dropout_key = next_key() if p > 0.0 else None
 
     def f(q, k, v, *m):
         mask = m[0] if m else None
-        if use_flash and mask is None:
-            from ...ops.pallas_kernels.flash_attention import flash_attention_bshd
-            return flash_attention_bshd(q, k, v, causal=is_causal)
+        if _flash_ok(q, k):
+            from ...ops.pallas_kernels.flash_attention import (
+                flash_attention_bshd)
+            bias = None
+            if mask is not None:
+                # keep (B|1, H|1) broadcast dims; force full trailing (Sq, Sk)
+                m4 = _mask_to_bias(mask)
+                bias = jnp.broadcast_to(
+                    m4, m4.shape[:2] + (q.shape[1], k.shape[1]))
+            seed = None
+            if p > 0.0:
+                seed = jax.random.randint(dropout_key, (), -2 ** 31,
+                                          2 ** 31 - 1, jnp.int32)
+            return flash_attention_bshd(q, k, v, is_causal, bias,
+                                        None, p, seed)
         return _sdpa_reference(q, k, v, mask=mask, causal=is_causal,
-                               dropout_key=dropout_key,
-                               dropout_p=dropout_p if training else 0.0)
+                               dropout_key=dropout_key, dropout_p=p)
 
     args = [attn_mask] if attn_mask is not None else []
     return _apply(f, query, key, value, *args, op_name="flash_attention")
 
 
-def _flash_ok(q):
-    """Route to the pallas kernel when on TPU with MXU-friendly shapes."""
+def _flash_ok(q, k=None):
+    """Route to the pallas kernel when on TPU with MXU-friendly shapes
+    (single shared predicate: ops/pallas_kernels/flash_attention.py
+    flash_supported — logs every fallback)."""
     try:
-        import jax as _j
-        if _j.default_backend() != "tpu":
-            return False
-        from ..  import functional  # noqa
         from ...flags import get_flags
         if not get_flags(["FLAGS_use_flash_attention"])["FLAGS_use_flash_attention"]:
             return False
         shape = q.shape if not isinstance(q, Tensor) else q._data.shape
-        d = shape[-1]
-        return d in (64, 128, 256) and shape[1] % 128 == 0
+        kv_seq = None
+        if k is not None:
+            kshape = k.shape if not isinstance(k, Tensor) else k._data.shape
+            kv_seq = kshape[1]
+        return flash_supported(shape, kv_seq=kv_seq, why="sdpa")
     except Exception:
         return False
 
@@ -114,7 +144,52 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return _apply(f, query, key, value, op_name="flash_attention")
 
 
-def flash_attn_unpadded(*args, **kwargs):
-    raise NotImplementedError(
-        "varlen flash attention is not provided; TPU path uses dense batches "
-        "with masks (see scaled_dot_product_attention)")
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen (packed) flash attention over cu_seqlens boundaries.
+
+    ref: python/paddle/nn/functional/flash_attention.py:269
+    (flash_attn_unpadded). q/k/v: [total_tokens, num_heads, head_dim]; the
+    cu_seqlens arrays give cumulative sequence offsets. On TPU the packed
+    batch runs through the pallas kernel with per-token segment ids; off-TPU
+    an equivalent segment-masked dense path keeps numerics testable.
+    """
+    if return_softmax:
+        raise NotImplementedError(
+            "flash_attn_unpadded(return_softmax=True) is a debug mode the "
+            "TPU path does not provide; unpack and use flash_attention")
+    from ...framework.random import next_key
+    p = dropout if training else 0.0
+    dropout_key = next_key() if p > 0.0 else None
+
+    def f(q, k, v, cu_q, cu_k):
+        d = q.shape[-1]
+        sm_scale = scale if scale is not None else 1.0 / (d ** 0.5)
+        if flash_supported((1,) + q.shape, why="varlen", varlen=True):
+            from ...ops.pallas_kernels.flash_attention import (
+                flash_attention_varlen)
+            seed = None
+            if p > 0.0:
+                seed = jax.random.randint(dropout_key, (), -2 ** 31,
+                                          2 ** 31 - 1, jnp.int32)
+            return flash_attention_varlen(q, k, v, cu_q, cu_k, causal=causal,
+                                          scale=sm_scale, dropout_p=p,
+                                          dropout_seed=seed)
+        # composed fallback: dense attention with a segment mask
+        Tq, Tk = q.shape[0], k.shape[0]
+        tq = jnp.arange(Tq, dtype=jnp.int32)
+        tk = jnp.arange(Tk, dtype=jnp.int32)
+        qseg = jnp.searchsorted(cu_q, tq, side="right")
+        kseg = jnp.searchsorted(cu_k, tk, side="right")
+        mask = (qseg[:, None] == kseg[None, :])
+        return _sdpa_reference(q[None], k[None], v[None],
+                               mask=mask[None, None], causal=causal,
+                               scale=sm_scale, dropout_key=dropout_key,
+                               dropout_p=p)[0]
+
+    out = _apply(f, query, key, value, cu_seqlens_q, cu_seqlens_k,
+                 op_name="flash_attn_unpadded")
+    return out, None

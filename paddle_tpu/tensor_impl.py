@@ -23,6 +23,8 @@ class Tensor:
     __slots__ = (
         "_data", "stop_gradient", "_grad", "_node", "_out_idx", "name",
         "persistable", "_placeholder", "_leaf_hooks", "__weakref__",
+        # auto_parallel distribution metadata (ref: dist tensor attrs)
+        "dist_spec", "placements", "process_mesh", "_partial_stack",
     )
 
     _name_counter = 0
@@ -232,7 +234,7 @@ class Tensor:
 
 
 class Parameter(Tensor):
-    __slots__ = ("trainable", "regularizer", "need_clip", "dist_spec",
+    __slots__ = ("trainable", "regularizer", "need_clip",
                  "is_distributed", "optimize_attr", "no_sync")
 
     _name_counter = [0]

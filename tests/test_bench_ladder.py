@@ -1,0 +1,70 @@
+"""Forced-failure tests of bench.py's attention fallback ladder.
+
+Round-2 lesson: the pallas kernel failed to lower on TPU and the bench
+recorded 0.0 even though the working blockwise XLA path existed. The ladder
+must walk flash -> blockwise -> smaller configs and report which path ran.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def _runner_factory(fail_pred, record):
+    def runner(model, batch, seq, use_flash):
+        record.append((model, batch, seq, use_flash))
+        if fail_pred(model, batch, seq, use_flash):
+            raise RuntimeError(f"forced failure {model} bs={batch} "
+                               f"flash={use_flash}")
+        return {"metric": "x", "value": 1.0, "unit": "tokens/s/chip",
+                "vs_baseline": 0.5,
+                "attention": "pallas" if use_flash else "blockwise",
+                "model": model, "batch": batch}
+    return runner
+
+
+def test_ladder_happy_path_uses_flash_first():
+    attempts = bench.build_attempts(on_tpu=True)
+    assert attempts[0][3] is True  # pallas first
+    rec = []
+    out = bench.run_ladder(attempts, _runner_factory(lambda *a: False, rec))
+    assert out["attention"] == "pallas"
+    assert len(rec) == 1
+
+
+def test_ladder_falls_back_to_blockwise_on_kernel_failure():
+    """The round-2 scenario: every flash config dies at lowering. The ladder
+    must recover with the blockwise path on the SAME (model, bs) config."""
+    attempts = bench.build_attempts(on_tpu=True)
+    rec = []
+    out = bench.run_ladder(
+        attempts, _runner_factory(lambda m, b, s, f: f, rec))
+    assert out["attention"] == "blockwise"
+    assert out["value"] > 0
+    # fell back within the top config, not all the way down the ladder
+    assert out["model"] == attempts[0][0] and out["batch"] == attempts[0][1]
+
+
+def test_ladder_oom_walks_to_smaller_batch():
+    attempts = bench.build_attempts(on_tpu=True)
+    big = attempts[0][1]
+    rec = []
+    out = bench.run_ladder(
+        attempts, _runner_factory(lambda m, b, s, f: b == big, rec))
+    assert out["value"] > 0 and out["batch"] < big
+
+
+def test_ladder_total_failure_still_emits_json_shape():
+    attempts = bench.build_attempts(on_tpu=True)
+    out = bench.run_ladder(attempts, _runner_factory(lambda *a: True, []))
+    assert out["value"] == 0.0 and "error" in out
+    assert out["unit"] == "tokens/s/chip"
+
+
+def test_every_tpu_config_has_blockwise_fallback():
+    attempts = bench.build_attempts(on_tpu=True)
+    flash_cfgs = {(m, b, s) for m, b, s, f in attempts if f}
+    blockwise_cfgs = {(m, b, s) for m, b, s, f in attempts if not f}
+    assert flash_cfgs == blockwise_cfgs

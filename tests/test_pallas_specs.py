@@ -105,7 +105,7 @@ def test_flash_forward_noresidual_specs_tileable(strict_pallas):
     out = fa._pallas_forward(q, q, q, causal=True, block_q=128, block_k=128,
                              interpret=True)
     assert out.shape == q.shape
-    assert any("_fwd_kernel_nolse" in s for s in strict_pallas)
+    assert any("_fwd_kernel" in s for s in strict_pallas)
 
 
 def test_flash_backward_specs_tileable(strict_pallas):
