@@ -107,46 +107,89 @@ def run(model_name, batch, seq, steps=10, warmup=2, use_flash=True):
 def probe_backend():
     """Decide which backend to use WITHOUT wedging the whole bench.
 
-    TPU plugin init can fail (UNAVAILABLE) or hang (a dead client's chip claim
-    takes minutes to expire server-side). Probe in a child process with a
-    timeout; on failure/timeout fall back to CPU in THIS process (which has not
-    initialized jax yet) so the JSON line always prints.
+    TPU plugin init can fail fast (UNAVAILABLE) or hang (a dead client's
+    chip claim takes minutes to expire server-side). Round-3 lesson: ONE
+    600s probe then permanent cpu fallback threw the round's hardware
+    evidence away over a transient wedge. Now: a single claimant child at a
+    time (two concurrent clients would contend for the chip), waited on in
+    60s slices across a long window (BENCH_PROBE_TIMEOUT_S, default 1800s —
+    the var keeps its old meaning of total probe budget). A hung child is
+    simply waited on — the claim resolves server-side and the child then
+    finishes on its own; a child that exits with an error is relaunched
+    after a short backoff. cpu fallback only when the window is exhausted.
     """
     import subprocess
     import tempfile
-    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "600"))
+    window = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "1800"))
     code = ("import jax; d = jax.devices()[0]; "
             "print('BACKEND=' + jax.default_backend())")
-    out_f = tempfile.NamedTemporaryFile("w+", prefix="bench_probe_",
-                                        delete=False)
+    t0 = time.time()
     child = None
+    out_f = None
+    attempt = 0
+    fast_fails = 0
     try:
-        child = subprocess.Popen([sys.executable, "-c", code],
-                                 stdout=out_f, stderr=subprocess.DEVNULL)
-        try:
-            rc = child.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            # Do NOT kill: a TPU-attached child killed mid-claim wedges the
-            # tunnel for every later process. Orphan it — it exits on its own
-            # once the claim resolves (and releases it) — and fall back to cpu.
-            # (The orphan keeps writing to the fd, so leave its file in place.)
-            _log(f"backend probe still blocked after {timeout}s; leaving it "
-                 f"to exit on its own and falling back to cpu")
-            return None
-        out_f.seek(0)
-        for line in out_f:
-            if line.startswith("BACKEND="):
-                return line.split("=", 1)[1].strip()
-        _log(f"backend probe rc={rc}, no backend reported")
-    except Exception as e:  # noqa: BLE001
-        _log(f"backend probe failed: {e}")
-    finally:
-        out_f.close()
-        if child is None or child.poll() is not None:
+        while time.time() - t0 < window:
+            if child is None:
+                attempt += 1
+                _log(f"backend probe attempt {attempt} "
+                     f"({window - (time.time() - t0):.0f}s left)...")
+                out_f = tempfile.NamedTemporaryFile(
+                    "w+", prefix="bench_probe_", delete=False)
+                launched = time.time()
+                child = subprocess.Popen([sys.executable, "-c", code],
+                                         stdout=out_f,
+                                         stderr=subprocess.STDOUT)
             try:
-                os.unlink(out_f.name)
-            except OSError:
-                pass
+                rc = child.wait(timeout=min(
+                    60.0, max(1.0, window - (time.time() - t0))))
+            except subprocess.TimeoutExpired:
+                continue  # still claiming; keep waiting on the SAME child
+            out_f.seek(0)
+            backend = None
+            tail = []
+            for line in out_f:
+                tail.append(line.rstrip())
+                if line.startswith("BACKEND="):
+                    backend = line.split("=", 1)[1].strip()
+            out_f.close()
+            os.unlink(out_f.name)
+            out_f = None
+            if backend is not None:
+                _log(f"backend probe succeeded: {backend}")
+                return backend
+            _log(f"probe child exited rc={rc} without a backend; "
+                 f"output tail: {' | '.join(tail[-3:])[:400]}")
+            # A fast non-zero exit is deterministic breakage, not a wedge —
+            # don't burn the whole window relaunching it.
+            if time.time() - launched < 30.0:
+                fast_fails += 1
+                if fast_fails >= 3:
+                    _log("3 consecutive fast failures; falling back to cpu")
+                    return None
+            else:
+                fast_fails = 0
+            child = None
+            time.sleep(min(15.0, max(0.0, window - (time.time() - t0))))
+    except Exception as e:  # noqa: BLE001  (the JSON line must always print)
+        _log(f"backend probe failed: {e}")
+        return None
+    finally:
+        # Never kill a TPU-attached child (killing mid-claim wedges the
+        # tunnel); if one is still claiming at window end, orphan it — it
+        # exits on its own once the claim resolves (it holds its own
+        # inherited fd, so the parent's handle closes unconditionally).
+        if out_f is not None:
+            out_f.close()
+            if child is None or child.poll() is not None:
+                try:
+                    os.unlink(out_f.name)
+                except OSError:
+                    pass
+            else:
+                _log("orphaning still-blocked probe child (exits on its own)")
+    _log(f"backend probe window ({window:.0f}s) exhausted after "
+         f"{attempt} attempts; falling back to cpu")
     return None
 
 

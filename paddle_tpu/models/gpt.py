@@ -184,6 +184,33 @@ class GPTForCausalLM(Layer):
         lb = M.reshape(labels[:, 1:], [-1])
         return F.cross_entropy(lg, lb)
 
+    def fused_loss(self, input_ids):
+        """Next-token LM loss straight from hidden states — the LM-head
+        matmul and softmax-CE are fused so the fp32 [B,S,V] logits buffer
+        never exists (ref fused softmax_with_cross_entropy capability,
+        python/paddle/nn/functional/loss.py). Routed through dispatch.apply
+        so eager ``loss.backward()`` records the op (via its custom_vjp) on
+        the tape. Under tensor parallelism (mp>1) the head is vocab-sharded
+        and the chunked scan would defeat that sharding, so this falls back
+        to the plain sharded-logits path — same guard as gpt_hybrid."""
+        from ..ops.fused_ce import fused_lm_loss
+        from ..distributed import env as dist_env
+        from ..tensor_impl import as_tensor_data
+        mesh = dist_env.get_mesh()
+        if mesh is not None and mesh.shape.get("mp", 1) > 1:
+            return self.loss(self(input_ids), input_ids)
+        hidden = self.gpt(input_ids)
+        w = self.gpt.wte.weight if self.lm_head is None else self.lm_head.weight
+        ids = as_tensor_data(input_ids)
+        transpose = self.lm_head is None
+
+        def f(h, w_):
+            if transpose:
+                w_ = w_.T
+            return fused_lm_loss(h, w_.astype(h.dtype), ids)
+
+        return _apply(f, hidden, w, op_name="fused_lm_loss")
+
     def num_params(self):
         return sum(p.size for p in self.parameters())
 

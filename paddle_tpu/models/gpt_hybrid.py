@@ -89,7 +89,10 @@ def gpt_param_specs(config: GPTConfig, pp=1):
 
 
 def _lm_loss(logits, ids):
-    """Shifted next-token CE in fp32. logits [B,S,V], ids [B,S]."""
+    """Shifted next-token CE in fp32. logits [B,S,V], ids [B,S].
+
+    Kept for the mp>1 path (vocab-sharded logits: per-chip memory is already
+    V/mp) and as the numeric reference for the fused loss below."""
     lg = logits[:, :-1].astype(jnp.float32)
     lb = ids[:, 1:]
     logz = jax.scipy.special.logsumexp(lg, axis=-1)
@@ -97,8 +100,9 @@ def _lm_loss(logits, ids):
     return jnp.mean(logz - gold)
 
 
-def gpt_forward(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
-    """Pure forward to logits. Under a mesh with pp>1 uses the pipeline."""
+def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
+    """Pure forward to final-layernorm hidden states [B,S,H] (compute dtype).
+    Under a mesh with pp>1 uses the pipeline."""
     compute = jnp.dtype(config.compute_dtype or "float32")
     B, S = ids.shape
     x = params["wte"].astype(compute)[ids] + \
@@ -124,8 +128,14 @@ def gpt_forward(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
     var = jnp.var(xf, -1, keepdims=True)
     xn = (xf - mu) * jax.lax.rsqrt(var + config.layer_norm_epsilon)
     xn = xn * params["lnf_g"].astype(jnp.float32) + params["lnf_b"].astype(jnp.float32)
-    logits = xn.astype(compute) @ params["head_w"].astype(compute)
-    return logits
+    return xn.astype(compute)
+
+
+def gpt_forward(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
+    """Pure forward to logits (inference / mp-sharded loss path)."""
+    compute = jnp.dtype(config.compute_dtype or "float32")
+    xn = gpt_hidden(params, ids, config, mesh, num_microbatches)
+    return xn @ params["head_w"].astype(compute)
 
 
 @dataclass
@@ -195,9 +205,20 @@ class HybridTrainStep:
         unflat = self._unflat
         flat = self._flat
 
+        mp = mesh.shape.get("mp", 1) if mesh is not None else 1
+
         def step_fn(flat_params, opt_state, ids, lr):
             def loss_fn(fp):
-                logits = gpt_forward(unflat(fp), ids, config, mesh, M)
+                p = unflat(fp)
+                if mp == 1:
+                    # fused head+CE: never materializes fp32 [B,S,V] logits
+                    from ..ops.fused_ce import fused_lm_loss
+                    hidden = gpt_hidden(p, ids, config, mesh, M)
+                    return fused_lm_loss(
+                        hidden, p["head_w"].astype(hidden.dtype), ids)
+                # mp>1: logits are vocab-sharded (V/mp per chip) — the plain
+                # logsumexp stays within budget and XLA keeps it sharded
+                logits = gpt_forward(p, ids, config, mesh, M)
                 return _lm_loss(logits, ids)
             loss, grads = jax.value_and_grad(loss_fn)(flat_params)
             clip = getattr(optimizer, "_grad_clip", None)

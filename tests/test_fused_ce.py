@@ -1,0 +1,168 @@
+"""Fused LM-head + cross-entropy: numeric parity with the naive path.
+
+The fused op (ops/fused_ce.py) must match a plain fp32
+logits -> logsumexp -> CE computation in value AND gradients, because it
+replaces that computation on the flagship bench path (gpt_hybrid).
+Ref capability: python/paddle/nn/functional/loss.py fused
+softmax_with_cross_entropy.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.fused_ce import (
+    fused_linear_cross_entropy, fused_lm_loss, _chunking)
+
+
+def naive_ce(hidden, head_w, labels):
+    logits = (hidden.astype(jnp.float32) @ head_w.astype(jnp.float32))
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return logz - gold
+
+
+@pytest.mark.parametrize("V", [100, 512, 1000, 50304])
+def test_forward_parity(V):
+    if V > 5000:
+        N, H = 16, 64
+    else:
+        N, H = 64, 32
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    hidden = jax.random.normal(k1, (N, H), jnp.float32)
+    head_w = jax.random.normal(k2, (H, V), jnp.float32) * 0.05
+    labels = jax.random.randint(k3, (N,), 0, V, jnp.int32)
+    got = fused_linear_cross_entropy(hidden, head_w, labels, num_chunks=7)
+    want = naive_ce(hidden, head_w, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grad_parity():
+    N, H, V = 32, 48, 700
+    k1, k2, k3 = jax.random.split(jax.random.key(1), 3)
+    hidden = jax.random.normal(k1, (N, H), jnp.float32)
+    head_w = jax.random.normal(k2, (H, V), jnp.float32) * 0.05
+    labels = jax.random.randint(k3, (N,), 0, V, jnp.int32)
+
+    def f_fused(h, w):
+        return jnp.mean(fused_linear_cross_entropy(h, w, labels, 5))
+
+    def f_naive(h, w):
+        return jnp.mean(naive_ce(h, w, labels))
+
+    (gh1, gw1) = jax.grad(f_fused, argnums=(0, 1))(hidden, head_w)
+    (gh2, gw2) = jax.grad(f_naive, argnums=(0, 1))(hidden, head_w)
+    np.testing.assert_allclose(np.asarray(gh1), np.asarray(gh2),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_bf16_inputs_fp32_stats():
+    """bf16 hidden/weights (the TPU bench path) still give fp32-quality
+    loss statistics (accumulation is fp32 via preferred_element_type)."""
+    N, H, V = 24, 64, 600
+    k1, k2, k3 = jax.random.split(jax.random.key(2), 3)
+    hidden = jax.random.normal(k1, (N, H), jnp.float32)
+    head_w = jax.random.normal(k2, (H, V), jnp.float32) * 0.05
+    labels = jax.random.randint(k3, (N,), 0, V, jnp.int32)
+    got = fused_linear_cross_entropy(hidden.astype(jnp.bfloat16),
+                                     head_w.astype(jnp.bfloat16), labels, 4)
+    assert got.dtype == jnp.float32
+    want = naive_ce(hidden.astype(jnp.bfloat16).astype(jnp.float32),
+                    head_w.astype(jnp.bfloat16).astype(jnp.float32), labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_masking_outside():
+    """ignore_index semantics live at the caller: a zero cotangent on masked
+    positions must zero their weight gradient."""
+    N, H, V = 16, 32, 300
+    k1, k2, k3 = jax.random.split(jax.random.key(3), 3)
+    hidden = jax.random.normal(k1, (N, H), jnp.float32)
+    head_w = jax.random.normal(k2, (H, V), jnp.float32) * 0.05
+    labels = jax.random.randint(k3, (N,), 0, V, jnp.int32)
+    mask = (jnp.arange(N) % 2 == 0).astype(jnp.float32)
+
+    def f(h, w):
+        losses = fused_linear_cross_entropy(h, w, labels, 4)
+        return jnp.sum(losses * mask) / jnp.sum(mask)
+
+    loss = f(hidden, head_w)
+    want = naive_ce(hidden, head_w, labels)
+    want = jnp.sum(want * mask) / jnp.sum(mask)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+    gh = jax.grad(f)(hidden, head_w)
+    # masked rows get exactly zero hidden-gradient
+    np.testing.assert_allclose(np.asarray(gh[1::2]), 0.0, atol=1e-8)
+
+
+def test_chunking_lane_aligned():
+    C, n = _chunking(50304, 8)
+    assert C % 128 == 0
+    assert C * n >= 50304
+    assert C * (n - 1) < 50304
+
+
+def test_hybrid_step_loss_matches_old_path():
+    """The flagship HybridTrainStep with the fused loss must produce the
+    same first-step loss as the explicit logits path it replaced."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models.gpt_hybrid import (
+        HybridTrainStep, init_gpt_params, gpt_forward, _lm_loss)
+
+    cfg = GPTConfig(vocab_size=257, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=32, compute_dtype="float32", use_flash=False)
+    opt = paddle.optimizer.AdamW(1e-3)
+    step = HybridTrainStep(cfg, opt)
+    ids = jax.random.randint(jax.random.key(9), (2, 16), 0, cfg.vocab_size,
+                             jnp.int32)
+    loss = float(np.asarray(jax.device_get(step(ids))))
+
+    params = init_gpt_params(cfg, jax.random.key(0), jnp.float32)
+    want = float(_lm_loss(gpt_forward(params, ids, cfg), ids))
+    np.testing.assert_allclose(loss, want, rtol=1e-5)
+
+
+def test_fused_lm_loss_gpt_model():
+    """GPTForCausalLM.fused_loss == loss(forward(ids), ids)."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=300, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=32, compute_dtype="float32", use_flash=False,
+                    remat=False)
+    model = GPTForCausalLM(cfg)
+    import paddle_tpu as paddle
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 300, (2, 16)).astype("int32"))
+    want = float(model.loss(model(ids), ids).numpy())
+    got = float(model.fused_loss(ids).numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_fused_loss_eager_backward():
+    """fused_loss must record on the eager tape: backward() produces the
+    same parameter grads as the explicit logits path."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    import paddle_tpu as paddle
+    cfg = GPTConfig(vocab_size=200, hidden_size=32, num_layers=1, num_heads=2,
+                    max_seq_len=16, compute_dtype="float32", use_flash=False,
+                    remat=False)
+    ids_np = np.random.default_rng(1).integers(0, 200, (2, 12)).astype("int32")
+
+    model = GPTForCausalLM(cfg)
+    sd = model.state_dict()
+    loss = model.fused_loss(paddle.to_tensor(ids_np))
+    loss.backward()
+    g_fused = np.asarray(model.lm_head.weight.grad.numpy())
+    assert np.abs(g_fused).sum() > 0
+
+    model2 = GPTForCausalLM(cfg)
+    model2.set_state_dict(sd)
+    ids = paddle.to_tensor(ids_np)
+    loss2 = model2.loss(model2(ids), ids)
+    loss2.backward()
+    g_ref = np.asarray(model2.lm_head.weight.grad.numpy())
+    np.testing.assert_allclose(g_fused, g_ref, rtol=1e-4, atol=1e-6)
