@@ -16,7 +16,9 @@ from ..tensor_impl import Tensor, as_tensor_data
 from ..dispatch import apply as _apply, apply_inplace
 from . import creation, random, math, manipulation, linalg, logic, search, stat
 from . import extras
+from . import inplace
 from .einsum import einsum  # noqa: F401
+from .inplace import *  # noqa: F401,F403
 
 from .creation import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
@@ -152,26 +154,11 @@ def _install_tensor_methods():
     Tensor.numel = lambda self: self.size
     Tensor.element_size = lambda self: jnp.dtype(self.dtype).itemsize
 
-    # paddle-style in-place aliases: x.add_(y) etc. rebind data on the object
-    def _make_inplace(fn):
-        def op(self, *args, **kw):
-            snap = Tensor(self._data, stop_gradient=self.stop_gradient)
-            snap._node = self._node
-            snap._out_idx = self._out_idx
-            out = fn(snap, *args, **kw)
-            self._data = out._data
-            self._node = out._node
-            self._out_idx = out._out_idx
-            if out._node is not None:
-                self.stop_gradient = False
-            return self
-        return op
-
-    for base in ("add", "subtract", "multiply", "divide", "clip", "scale", "exp",
-                 "sqrt", "rsqrt", "floor", "ceil", "round", "reciprocal", "abs",
-                 "tanh", "sigmoid", "pow"):
-        fn = getattr(math, base)
-        setattr(Tensor, base + "_", _make_inplace(fn))
+    # paddle-style in-place variants: x.add_(y) etc. rebind data on the
+    # object. Single source of truth is tensor/inplace.py, whose free
+    # functions already take the tensor first — install them directly.
+    for _name in inplace.__all__:
+        setattr(Tensor, _name, getattr(inplace, _name))
 
 
 _install_tensor_methods()

@@ -184,3 +184,10 @@ def _shape(shape):
         return (int(shape),)
     return tuple(int(as_tensor_data(s)) if not isinstance(s, (int, np.integer)) else int(s)
                  for s in shape)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Create an (empty) Tensor of the given dtype, to be filled later with
+    set_value / assignment (ref: python/paddle/tensor/creation.py
+    create_tensor)."""
+    return Tensor(jnp.zeros((0,), dtype=_norm_dtype(dtype)))

@@ -1,0 +1,98 @@
+"""In-place tensor op variants (``add_``, ``clip_``, ...).
+
+Mirrors the reference's inplace API surface (ref:
+python/paddle/tensor/__init__.py export list — `add_`, `subtract_`,
+`multiply_`, `clip_`, `exp_`, `sqrt_`, `scale_`, `lerp_`,
+`put_along_axis_`, `index_put_`, ...). The reference mutates the dense
+tensor's buffer in its C++ kernels; XLA arrays are immutable, so "in-place"
+here means REBIND: compute out-of-place, then swap the result's buffer and
+tape node onto the original Tensor object and return it. User-visible
+semantics match (returns the same object, later reads see the new value,
+autograd records the op); what differs is only that XLA's buffer reuse is
+decided by the compiler (donation), not by the op.
+
+The tape must reference the *pre-mutation* value, so the input is
+snapshotted before the op runs (same rule as dispatch.apply_inplace).
+"""
+from __future__ import annotations
+
+import functools
+
+from ..tensor_impl import Tensor
+from . import manipulation, math, random as _random
+
+
+def _rebind(target: Tensor, out: Tensor):
+    target._data = out._data
+    target._node = out._node
+    target._out_idx = out._out_idx
+    if out._node is not None:
+        target.stop_gradient = False
+    return target
+
+
+def _snapshot(x: Tensor) -> Tensor:
+    snap = Tensor(x._data, stop_gradient=x.stop_gradient)
+    snap._node = x._node
+    snap._out_idx = x._out_idx
+    return snap
+
+
+def inplace_variant(fn, name=None):
+    """Build the ``op_`` free function from an out-of-place ``op``."""
+
+    @functools.wraps(fn)
+    def op_(x, *args, **kwargs):
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        out = fn(_snapshot(x), *args, **kwargs)
+        return _rebind(x, out)
+
+    op_.__name__ = name or fn.__name__ + "_"
+    op_.__qualname__ = op_.__name__
+    op_.__doc__ = (f"In-place variant of `{fn.__name__}` (rebinds the "
+                   f"result onto the input Tensor and returns it).")
+    return op_
+
+
+add_ = inplace_variant(math.add)
+subtract_ = inplace_variant(math.subtract)
+multiply_ = inplace_variant(math.multiply)
+divide_ = inplace_variant(math.divide)
+remainder_ = inplace_variant(math.remainder, name="remainder_")
+clip_ = inplace_variant(math.clip)
+scale_ = inplace_variant(math.scale)
+exp_ = inplace_variant(math.exp)
+sqrt_ = inplace_variant(math.sqrt)
+rsqrt_ = inplace_variant(math.rsqrt)
+reciprocal_ = inplace_variant(math.reciprocal)
+floor_ = inplace_variant(math.floor)
+ceil_ = inplace_variant(math.ceil)
+round_ = inplace_variant(math.round)
+abs_ = inplace_variant(math.abs)
+tanh_ = inplace_variant(math.tanh)
+sigmoid_ = inplace_variant(math.sigmoid)
+pow_ = inplace_variant(math.pow)
+lerp_ = inplace_variant(math.lerp)
+erfinv_ = inplace_variant(math.erfinv, name="erfinv_")
+
+flatten_ = inplace_variant(manipulation.flatten)
+squeeze_ = inplace_variant(manipulation.squeeze)
+unsqueeze_ = inplace_variant(manipulation.unsqueeze)
+scatter_ = inplace_variant(manipulation.scatter)
+put_along_axis_ = inplace_variant(manipulation.put_along_axis)
+index_put_ = inplace_variant(manipulation.index_put)
+index_add_ = inplace_variant(manipulation.index_add)
+# reshape_ already exists in manipulation; re-export for a single surface
+reshape_ = manipulation.reshape_
+# random fills are already in-place by construction
+uniform_ = _random.uniform_
+exponential_ = _random.exponential_
+
+__all__ = [
+    "add_", "subtract_", "multiply_", "divide_", "remainder_", "clip_",
+    "scale_", "exp_", "sqrt_", "rsqrt_", "reciprocal_", "floor_", "ceil_",
+    "round_", "abs_", "tanh_", "sigmoid_", "pow_", "lerp_", "erfinv_",
+    "flatten_", "squeeze_", "unsqueeze_", "scatter_", "put_along_axis_",
+    "index_put_", "index_add_", "reshape_", "uniform_", "exponential_",
+]

@@ -95,7 +95,11 @@ def exponential_(x, lam=1.0):
     a = as_tensor_data(x)
     out = jax.random.exponential(next_key(), a.shape, dtype=a.dtype) / lam
     if isinstance(x, Tensor):
+        # random fill severs any autograd history: the new value does not
+        # derive from the old one, so the stale node must not survive
         x._data = out
+        x._node = None
+        x._out_idx = 0
         return x
     return Tensor(out)
 
@@ -104,7 +108,11 @@ def normal_(x, mean=0.0, std=1.0):
     a = as_tensor_data(x)
     out = mean + std * jax.random.normal(next_key(), a.shape, dtype=a.dtype)
     if isinstance(x, Tensor):
+        # random fill severs any autograd history: the new value does not
+        # derive from the old one, so the stale node must not survive
         x._data = out
+        x._node = None
+        x._out_idx = 0
         return x
     return Tensor(out)
 
@@ -113,6 +121,10 @@ def uniform_(x, min=-1.0, max=1.0):
     a = as_tensor_data(x)
     out = jax.random.uniform(next_key(), a.shape, dtype=a.dtype, minval=min, maxval=max)
     if isinstance(x, Tensor):
+        # random fill severs any autograd history: the new value does not
+        # derive from the old one, so the stale node must not survive
         x._data = out
+        x._node = None
+        x._out_idx = 0
         return x
     return Tensor(out)

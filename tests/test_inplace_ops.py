@@ -1,0 +1,168 @@
+"""In-place (`*_`) tensor op variants + full tensor_method_func parity.
+
+Ref: python/paddle/tensor/__init__.py `tensor_method_func` (254 entries,
+snapshotted literally below) — every name must resolve as a Tensor method
+or module-level function; the `*_` variants must rebind in place (same
+object, new value) and stay on the autograd tape.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor_impl import Tensor
+
+# snapshot of the reference's tensor_method_func list
+REF_TENSOR_METHODS = [
+    'create_parameter', 'create_tensor', 'matmul', 'dot', 'cov', 'corrcoef',
+    'norm', 'cond', 'transpose', 'lstsq', 'dist', 't', 'cross', 'cholesky',
+    'bmm', 'histogram', 'bincount', 'mv', 'matrix_power', 'qr', 'eigvals',
+    'eigvalsh', 'abs', 'acos', 'all', 'any', 'asin', 'atan', 'ceil', 'ceil_',
+    'cos', 'cosh', 'cumsum', 'cumprod', 'logcumsumexp', 'logit', 'exp',
+    'exp_', 'expm1', 'floor', 'floor_', 'increment', 'logaddexp', 'log',
+    'log2', 'log10', 'logsumexp', 'multiplex', 'pow', 'prod', 'reciprocal',
+    'reciprocal_', 'round', 'round_', 'rsqrt', 'rsqrt_', 'scale', 'scale_',
+    'sign', 'sin', 'sinh', 'sqrt', 'sqrt_', 'square', 'stanh', 'sum',
+    'nan_to_num', 'nansum', 'nanmean', 'count_nonzero', 'tanh', 'tanh_',
+    'add_n', 'max', 'amax', 'maximum', 'min', 'amin', 'minimum', 'fmax',
+    'fmin', 'mm', 'inner', 'outer', 'divide', 'floor_divide', 'remainder',
+    'remainder_', 'mod', 'floor_mod', 'multiply', 'multiply_', 'add', 'add_',
+    'subtract', 'subtract_', 'inverse', 'log1p', 'erf', 'addmm', 'clip',
+    'clip_', 'trace', 'kron', 'kthvalue', 'isfinite', 'isinf', 'isnan',
+    'broadcast_shape', 'conj', 'neg', 'lgamma', 'equal', 'equal_all',
+    'greater_equal', 'greater_than', 'is_empty', 'less_equal', 'less_than',
+    'logical_and', 'logical_not', 'logical_or', 'logical_xor', 'not_equal',
+    'allclose', 'isclose', 'is_tensor', 'cast', 'concat', 'expand',
+    'broadcast_to', 'expand_as', 'flatten', 'flatten_', 'gather',
+    'gather_nd', 'reshape', 'reshape_', 'reverse', 'scatter', 'scatter_',
+    'scatter_nd_add', 'scatter_nd', 'shard_index', 'slice', 'split',
+    'vsplit', 'chunk', 'tensordot', 'squeeze', 'squeeze_', 'stack',
+    'strided_slice', 'transpose', 'unique', 'unique_consecutive',
+    'unsqueeze', 'unsqueeze_', 'unstack', 'flip', 'rot90', 'unbind', 'roll',
+    'tile', 'argmax', 'argmin', 'argsort', 'masked_select', 'topk', 'where',
+    'index_select', 'nonzero', 'sort', 'index_sample', 'mean', 'std', 'var',
+    'numel', 'median', 'nanmedian', 'quantile', 'nanquantile', 'is_complex',
+    'is_integer', 'rank', 'shape', 'real', 'imag', 'is_floating_point',
+    'digamma', 'diagonal', 'trunc', 'frac', 'bitwise_and', 'bitwise_or',
+    'bitwise_xor', 'bitwise_not', 'broadcast_tensors', 'eig', 'uniform_',
+    'multi_dot', 'solve', 'cholesky_solve', 'triangular_solve', 'asinh',
+    'atanh', 'acosh', 'lu', 'lu_unpack', 'cdist', 'as_complex', 'as_real',
+    'rad2deg', 'deg2rad', 'gcd', 'lcm', 'diff', 'mode', 'lerp', 'lerp_',
+    'erfinv', 'erfinv_', 'angle', 'moveaxis', 'repeat_interleave',
+    'take_along_axis', 'put_along_axis', 'put_along_axis_', 'exponential_',
+    'heaviside', 'index_add', 'index_add_', 'index_put', 'index_put_',
+    'take', 'bucketize', 'sgn', 'frexp', 'ldexp', 'trapezoid',
+    'cumulative_trapezoid', 'polar', 'sigmoid', 'sigmoid_', 'vander',
+    'nextafter', 'unflatten', 'i0', 'i0e', 'i1', 'i1e', 'polygamma',
+]
+
+
+def test_tensor_method_parity():
+    missing = [n for n in REF_TENSOR_METHODS
+               if not (hasattr(Tensor, n) or hasattr(paddle, n)
+                       or hasattr(paddle.tensor, n))]
+    assert not missing, f"missing {len(missing)} tensor exports: {missing}"
+
+
+def test_inplace_module_exports():
+    for n in ['add_', 'subtract_', 'multiply_', 'clip_', 'exp_', 'sqrt_',
+              'scale_', 'lerp_', 'put_along_axis_', 'index_put_',
+              'remainder_', 'erfinv_', 'flatten_', 'squeeze_', 'unsqueeze_',
+              'scatter_', 'reshape_', 'uniform_', 'exponential_', 'ceil_',
+              'floor_', 'round_', 'rsqrt_', 'reciprocal_', 'tanh_',
+              'sigmoid_']:
+        assert hasattr(paddle, n), n
+        assert hasattr(Tensor, n), n
+
+
+def test_inplace_rebinds_same_object():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    y = paddle.add_(x, paddle.to_tensor(np.array([1.0, 1.0, 1.0], np.float32)))
+    assert y is x
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0, 4.0])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4.0, 6.0, 8.0])
+    x.clip_(min=5.0)
+    np.testing.assert_allclose(x.numpy(), [5.0, 6.0, 8.0])
+
+
+def test_inplace_shape_ops():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.reshape_([3, 2])
+    assert tuple(x.shape) == (3, 2)
+    x.flatten_()
+    assert tuple(x.shape) == (6,)
+    x.unsqueeze_(0)
+    assert tuple(x.shape) == (1, 6)
+    x.squeeze_()
+    assert tuple(x.shape) == (6,)
+
+
+def test_inplace_math_values():
+    x = paddle.to_tensor(np.array([4.0, 9.0], np.float32))
+    paddle.sqrt_(x)
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    paddle.multiply_(x, paddle.to_tensor(np.array([2.0, 2.0], np.float32)))
+    np.testing.assert_allclose(x.numpy(), [4.0, 6.0])
+    paddle.remainder_(x, paddle.to_tensor(np.array([3.0, 4.0], np.float32)))
+    np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+    y = paddle.to_tensor(np.array([0.5], np.float32))
+    paddle.erfinv_(y)
+    np.testing.assert_allclose(y.numpy(), [0.476936], rtol=1e-4)
+
+
+def test_inplace_lerp_put_index():
+    x = paddle.to_tensor(np.zeros((4,), np.float32))
+    y = paddle.to_tensor(np.ones((4,), np.float32))
+    paddle.lerp_(x, y, 0.25)
+    np.testing.assert_allclose(x.numpy(), [0.25] * 4)
+
+    a = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    idx = paddle.to_tensor(np.array([[0, 1, 2]], np.int64))
+    val = paddle.to_tensor(np.array([[9.0, 8.0, 7.0]], np.float32))
+    paddle.put_along_axis_(a, idx, val, axis=0)
+    np.testing.assert_allclose(a.numpy()[0, 0], 9.0)
+
+    b = paddle.to_tensor(np.zeros((3,), np.float32))
+    paddle.index_put_(b, [paddle.to_tensor(np.array([1], np.int64))],
+                      paddle.to_tensor(np.array([5.0], np.float32)))
+    np.testing.assert_allclose(b.numpy(), [0.0, 5.0, 0.0])
+
+
+def test_inplace_on_tape():
+    """In-place ops must keep autograd correct: grad flows to the ORIGINAL
+    pre-mutation value (the snapshot rule)."""
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = x * x          # y = x^2, on tape
+    z = paddle.exp_(y)  # rebinds exp(y) onto y's object
+    loss = z.sum()
+    loss.backward()
+    # dloss/dx = exp(x^2) * 2x
+    want = np.exp([4.0, 9.0]) * np.array([4.0, 6.0])
+    np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-5)
+
+
+def test_random_fill_severs_tape():
+    """uniform_ overwrites the value with one that does NOT derive from the
+    inputs — any stale autograd history must be dropped, so backward through
+    the filled tensor contributes no gradient to the old graph."""
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = x * x
+    y.uniform_(0.0, 1.0)
+    assert y._node is None
+    loss = (y * y).sum() if not y.stop_gradient else None
+    # the old x*x graph must be unreachable: a fresh backward from anything
+    # built on y cannot touch x
+    if loss is not None:
+        loss.backward()
+    assert x.grad is None or float(np.abs(x.grad.numpy()).sum()) == 0.0
+
+
+def test_random_inplace():
+    x = paddle.to_tensor(np.zeros((100,), np.float32))
+    paddle.uniform_(x, min=2.0, max=3.0)
+    assert float(x.numpy().min()) >= 2.0
+    assert float(x.numpy().max()) <= 3.0
+    paddle.exponential_(x, lam=1.0)
+    assert float(x.numpy().min()) >= 0.0
