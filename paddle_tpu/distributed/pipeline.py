@@ -392,14 +392,31 @@ def pipeline_spmd_interleaved_1f1b(block_fn, stage_params, x_mb, *,
     return pipe(stage_params, x_mb)
 
 
+def vpp_storage_perm(L, S, V):
+    """Stage-major storage order for interleaved VPP: storage slot
+    s*(V*Lc)+v*Lc+p holds logical layer (v*S+s)*Lc+p. Stacked params
+    pre-permuted this way shard over 'pp' as a plain contiguous split —
+    no cross-device reshard at the shard_map boundary (the layout the
+    swapaxes in run_pipeline would otherwise create on the fly)."""
+    Lc = L // (S * V)
+    assert Lc * S * V == L, f"layers {L} != pp {S} x interleave {V} x chunk"
+    return [(v * S + s) * Lc + p
+            for s in range(S) for v in range(V) for p in range(Lc)]
+
+
 def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
                  axis_name="pp", data_spec=P(), schedule="gpipe",
-                 interleave=1):
+                 interleave=1, vpp_stage_major=False):
     """Host-side wrapper: shard_map(manual over 'pp', auto elsewhere).
 
     stacked_params: pytree, leaves [S * local_L, ...] stacked layer params.
     x: [B, ...] activations entering the pipelined blocks.
     Returns [B, ...] outputs of the last stage (broadcast to all stages).
+
+    With ``vpp_stage_major`` the caller stores stacked params in
+    `vpp_storage_perm` order so the interleaved reshape is contiguous and
+    the 'pp' sharding of storage matches chunk placement exactly (avoids
+    XLA's involuntary full rematerialization of every block param).
     """
     mesh = mesh or env.get_mesh()
     S = mesh.shape[axis_name]
@@ -408,7 +425,11 @@ def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
     V = interleave
     assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
 
-    if V > 1:
+    if V > 1 and vpp_stage_major:
+        def reshape_stages(a):
+            Lc = a.shape[0] // (V * S)
+            return a.reshape((S, V, Lc) + a.shape[1:])  # contiguous
+    elif V > 1:
         # chunk c of V*S covers layers [c*Lc, (c+1)*Lc); device c%S, lap c//S
         def reshape_stages(a):
             Lc = a.shape[0] // (V * S)

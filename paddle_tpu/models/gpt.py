@@ -49,6 +49,13 @@ class GPTConfig:
     # pipeline-parallel schedule: "1f1b" (O(stages) activation residency,
     # ref fleet/meta_parallel/pipeline_parallel.py:230) or "gpipe"
     pp_schedule: str = "1f1b"
+    # virtual pipeline stages per device (interleaved 1F1B / VPP,
+    # ref fleet/meta_parallel/pipeline_parallel.py:613)
+    pp_interleave: int = 1
+    # True when stacked block params are stored in vpp_storage_perm order
+    # (set by HybridTrainStep after permuting; callers passing logical-order
+    # params to gpt_forward must leave it False)
+    vpp_stage_major: bool = False
 
 
 # headline model family (GPT-3 sizes; ref benchmark configs)

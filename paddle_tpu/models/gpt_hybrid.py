@@ -67,23 +67,31 @@ def init_gpt_params(config: GPTConfig, key, param_dtype=jnp.float32):
     }
 
 
-def gpt_param_specs(config: GPTConfig, pp=1):
+def gpt_param_specs(config: GPTConfig, pp=1, zero_stage=1):
     """PartitionSpecs per param. Block leaves get a leading 'pp' axis when
-    pipelining; matmul weights shard over 'mp' Megatron-style."""
+    pipelining; matmul weights shard over 'mp' Megatron-style.
+
+    zero_stage >= 3 (ref: fleet/meta_parallel/sharding/
+    group_sharded_stage3.py capability): block matrices additionally shard
+    their non-'mp' dim over ('dp','sharding') — FSDP-style. Inside the
+    layer scan GSPMD inserts the per-layer all-gather on use (the
+    reference's stage-3 prefetch) and turns the weight-grad psum into a
+    reduce-scatter; persistent per-chip param bytes drop by dpxsharding."""
     lead = ("pp",) if pp > 1 else (None,)
+    z3 = ("dp", "sharding") if zero_stage >= 3 else None
     blocks = {
         "ln1_g": P(*lead, None), "ln1_b": P(*lead, None),
-        "qkv_w": P(*lead, None, "mp"), "qkv_b": P(*lead, "mp"),
-        "out_w": P(*lead, "mp", None), "out_b": P(*lead, None),
+        "qkv_w": P(*lead, z3, "mp"), "qkv_b": P(*lead, "mp"),
+        "out_w": P(*lead, "mp", z3), "out_b": P(*lead, None),
         "ln2_g": P(*lead, None), "ln2_b": P(*lead, None),
-        "up_w": P(*lead, None, "mp"), "up_b": P(*lead, "mp"),
-        "down_w": P(*lead, "mp", None), "down_b": P(*lead, None),
+        "up_w": P(*lead, z3, "mp"), "up_b": P(*lead, "mp"),
+        "down_w": P(*lead, "mp", z3), "down_b": P(*lead, None),
     }
     return {
-        "wte": P("mp", None),
+        "wte": P("mp", z3),
         "wpe": P(),
         "lnf_g": P(), "lnf_b": P(),
-        "head_w": P(None, "mp"),
+        "head_w": P(z3, "mp"),
         "blocks": blocks,
     }
 
@@ -117,8 +125,14 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
         # already recomputes per-tick; remat's constant residuals break the
         # shard_map vma typing of the reverse scan. The 1f1b schedule has its
         # own hand-written backward with stage-input checkpointing.
+        # Under VPP the hybrid step stores blocks in vpp_storage_perm order
+        # (see HybridTrainStep.__post_init__), so reshaping to chunks is
+        # contiguous and needs no cross-device reshard.
         x = run_pipeline(block, params["blocks"], x, num_microbatches, mesh=mesh,
-                         schedule=getattr(config, "pp_schedule", "1f1b"))
+                         schedule=getattr(config, "pp_schedule", "1f1b"),
+                         interleave=getattr(config, "pp_interleave", 1),
+                         vpp_stage_major=getattr(config, "vpp_stage_major",
+                                                 False))
     else:
         def scan_body(h, layer_params):
             return jax.checkpoint(block)(layer_params, h), None
@@ -147,10 +161,25 @@ class HybridTrainStep:
     num_microbatches: int = 1
     param_dtype: object = jnp.float32
     seed: int = 0
+    # ZeRO stage on the flagship path: 1 = optimizer slots sharded (via
+    # optimizer._shard_opt_states_axis), 3 = + params FSDP-sharded over
+    # ('dp','sharding') with per-layer all-gather in the scan
+    zero_stage: int = 1
 
     def __post_init__(self):
         key = jax.random.key(self.seed)
         self.params = init_gpt_params(self.config, key, self.param_dtype)
+        pp = self.mesh.shape.get("pp", 1) if self.mesh is not None else 1
+        V = getattr(self.config, "pp_interleave", 1)
+        if pp > 1 and V > 1:
+            # stage-major storage so VPP chunk placement == 'pp' sharding;
+            # the config flag records the layout for gpt_hidden/run_pipeline
+            from ..distributed.pipeline import vpp_storage_perm
+            perm = jnp.asarray(
+                vpp_storage_perm(self.config.num_layers, pp, V))
+            self.params["blocks"] = jax.tree_util.tree_map(
+                lambda a: a[perm], self.params["blocks"])
+            self.config.vpp_stage_major = True
         flat, self._treedef = jax.tree_util.tree_flatten_with_path(self.params)
         self._names = ["/".join(str(p) for p in path) for path, _ in flat]
         self.opt_state = self.optimizer.init_state(self._flat(self.params))
@@ -169,7 +198,7 @@ class HybridTrainStep:
 
     def _specs(self):
         pp = self.mesh.shape.get("pp", 1) if self.mesh is not None else 1
-        return gpt_param_specs(self.config, pp=pp)
+        return gpt_param_specs(self.config, pp=pp, zero_stage=self.zero_stage)
 
     def _place(self):
         specs = self._specs()

@@ -45,7 +45,12 @@ def inplace_variant(fn, name=None):
     def op_(x, *args, **kwargs):
         if not isinstance(x, Tensor):
             x = Tensor(x)
-        out = fn(_snapshot(x), *args, **kwargs)
+        snap = _snapshot(x)
+        # EVERY input aliasing x must become the snapshot, or the rebound
+        # node would be its own parent (same rule as dispatch.apply_inplace)
+        args = tuple(snap if a is x else a for a in args)
+        kwargs = {k: (snap if v is x else v) for k, v in kwargs.items()}
+        out = fn(snap, *args, **kwargs)
         return _rebind(x, out)
 
     op_.__name__ = name or fn.__name__ + "_"
@@ -79,12 +84,13 @@ erfinv_ = inplace_variant(math.erfinv, name="erfinv_")
 flatten_ = inplace_variant(manipulation.flatten)
 squeeze_ = inplace_variant(manipulation.squeeze)
 unsqueeze_ = inplace_variant(manipulation.unsqueeze)
-scatter_ = inplace_variant(manipulation.scatter)
 put_along_axis_ = inplace_variant(manipulation.put_along_axis)
 index_put_ = inplace_variant(manipulation.index_put)
 index_add_ = inplace_variant(manipulation.index_add)
-# reshape_ already exists in manipulation; re-export for a single surface
+# reshape_/scatter_ already exist in manipulation; re-export so the module
+# function and the Tensor method are the same object
 reshape_ = manipulation.reshape_
+scatter_ = manipulation.scatter_
 # random fills are already in-place by construction
 uniform_ = _random.uniform_
 exponential_ = _random.exponential_

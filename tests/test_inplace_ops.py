@@ -142,6 +142,17 @@ def test_inplace_on_tape():
     np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-5)
 
 
+def test_inplace_self_aliasing():
+    """y.add_(y): the aliased second operand must also be snapshotted, or
+    the rebound node becomes its own parent and half the gradient is lost."""
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = x * x
+    paddle.add_(y, y)          # y <- 2*x^2
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0, 12.0], rtol=1e-6)  # 4x
+
+
 def test_random_fill_severs_tape():
     """uniform_ overwrites the value with one that does NOT derive from the
     inputs — any stale autograd history must be dropped, so backward through

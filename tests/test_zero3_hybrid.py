@@ -1,0 +1,107 @@
+"""ZeRO-3 on the flagship HybridTrainStep: params FSDP-shard over
+('dp','sharding'), per-layer all-gather inside the scan, numerics unchanged.
+
+Ref capability: fleet/meta_parallel/sharding/group_sharded_stage3.py (param
+sharding + prefetch); here GSPMD inserts the gathers from the PartitionSpecs
+in gpt_param_specs(zero_stage=3).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.models.gpt_hybrid import HybridTrainStep
+
+
+def _cfg(layers=2):
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=layers,
+                     num_heads=4, max_seq_len=64, compute_dtype="float32",
+                     use_flash=False)
+
+
+def _ids(batch=8):
+    return jnp.tile(jnp.arange(32, dtype=jnp.int32)[None, :] % 16, (batch, 1))
+
+
+def _step(mesh, stage, seed=0):
+    opt = paddle.optimizer.AdamW(
+        1e-3, grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    if mesh is not None:
+        opt._shard_opt_states_axis = "sharding"
+    return HybridTrainStep(_cfg(), opt, mesh=mesh, zero_stage=stage,
+                           seed=seed)
+
+
+def test_zero3_param_shards_quarter_bytes():
+    """On dp2 x sharding2 each chip holds 1/4 of every FSDP-sharded block
+    matrix (and of its fp32 Adam moments)."""
+    mesh = dist_env.create_hybrid_mesh(dp=2, sharding=2, mp=2)
+    step = _step(mesh, stage=3)
+    loss = step(_ids())
+    assert np.isfinite(float(np.asarray(jax.device_get(loss))))
+    qkv = step.params["blocks"]["qkv_w"]
+    spec = qkv.sharding.spec
+    assert ("dp", "sharding") in tuple(spec), spec
+    local = qkv.addressable_shards[0].data
+    assert local.size * 8 == qkv.size, (local.shape, qkv.shape)  # /4 fsdp /2 mp
+    # Adam moments follow the param sharding
+    m = step.opt_state["slots"]["['blocks']['qkv_w']"]["moment1"] \
+        if "['blocks']['qkv_w']" in step.opt_state["slots"] else None
+    if m is None:  # name formatting differs; find by shape
+        cand = [s["moment1"] for s in step.opt_state["slots"].values()
+                if "moment1" in s and s["moment1"].shape == qkv.shape]
+        m = cand[0]
+    assert m.addressable_shards[0].data.size * 8 == m.size
+
+
+def test_zero3_matches_zero1_numerics():
+    """Sharding is a layout, not a math change: stage-3 losses track the
+    stage-1 (replicated-param) trajectory."""
+    mesh = dist_env.create_hybrid_mesh(dp=2, sharding=2, mp=2)
+    ids = _ids()
+    s3 = _step(mesh, stage=3, seed=5)
+    s1 = _step(mesh, stage=1, seed=5)
+    for _ in range(3):
+        l3 = float(np.asarray(jax.device_get(s3(ids))))
+        l1 = float(np.asarray(jax.device_get(s1(ids))))
+    np.testing.assert_allclose(l3, l1, rtol=1e-5)
+
+
+def test_zero3_compiled_arg_bytes_shrink():
+    """The compiled executable's per-device argument residency drops when
+    params shard (the memory-analysis proof, as in test_zero_gradaccum)."""
+    mesh = dist_env.create_hybrid_mesh(dp=2, sharding=2, mp=2)
+    ids = _ids()
+
+    def compiled_arg_bytes(step):
+        step(ids)  # builds + caches the jit
+        lowered = step._jitted.lower(
+            step._flat(step.params), step.opt_state, ids,
+            jnp.asarray(1e-3, jnp.float32))
+        mem = lowered.compile().memory_analysis()
+        return None if mem is None else mem.argument_size_in_bytes
+
+    b3 = compiled_arg_bytes(_step(mesh, stage=3))
+    b1 = compiled_arg_bytes(_step(mesh, stage=1))
+    if b3 is not None and b1 is not None:
+        assert b3 < b1, (b3, b1)
+
+
+def test_zero3_large_config_initializes_sharded():
+    """A GPT config whose replicated fp32 params would be ~8x a single
+    chip's share initializes with per-chip bytes = total/8 on an 8-way
+    ('dp','sharding') product mesh — the capability that unlocks 6.7B+ on
+    real pods (per-chip HBM is the binding constraint there)."""
+    mesh = dist_env.create_hybrid_mesh(dp=2, sharding=4, mp=1)
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                    num_heads=8, max_seq_len=64, compute_dtype="float32",
+                    use_flash=False)
+    opt = paddle.optimizer.AdamW(1e-3)
+    opt._shard_opt_states_axis = "sharding"
+    step = HybridTrainStep(cfg, opt, mesh=mesh, zero_stage=3)
+    qkv = step.params["blocks"]["qkv_w"]
+    assert qkv.addressable_shards[0].data.size * 8 == qkv.size
+    loss = step(_ids(8))
+    assert np.isfinite(float(np.asarray(jax.device_get(loss))))
