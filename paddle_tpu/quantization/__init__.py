@@ -51,7 +51,8 @@ class QuantizedLinear(Layer):
     Stores int8 weight + per-channel scale; dequantizes in-graph so XLA fuses
     the scale multiply into the MXU matmul epilogue."""
 
-    def __init__(self, linear_or_in, out_features=None):
+    def __init__(self, linear_or_in, out_features=None, weight_scale=None,
+                 quant_axis=1):
         super().__init__()
         if isinstance(linear_or_in, Layer):
             lin = linear_or_in
@@ -60,9 +61,10 @@ class QuantizedLinear(Layer):
         else:
             w = jnp.zeros((linear_or_in, out_features), jnp.float32)
             self.bias = None
-        q, scale = quantize_weight(w, axis=1)  # per-out-channel on [in, out]
-        self.qweight = q
-        self.scale = scale
+        from .qat_layers import quantize_with_scale
+        # default: per-out-channel on [in, out] (axis 1)
+        self.qweight, self.scale = quantize_with_scale(
+            w, weight_scale, quant_axis)
 
     def forward(self, x):
         w = dequantize_weight(self.qweight, self.scale)
@@ -73,37 +75,32 @@ class QuantizedLinear(Layer):
         return wrap(out)
 
 
-class QAT:
-    """Quantization-aware-training wrapper: replaces Linear forwards with
-    fake-quant weights (ref quantization/qat.py capability)."""
-
-    def __init__(self, config=None):
-        self.config = config
-
-    def quantize(self, model):
-        for layer in model.sublayers(include_self=True):
-            if isinstance(layer, nn.Linear):
-                orig = layer.forward
-
-                def fq_forward(x, _orig=orig, _layer=layer):
-                    w = _layer.weight
-                    _layer.weight = type(w)(as_tensor_data(fake_quant(w)))
-                    try:
-                        return _orig(x)
-                    finally:
-                        _layer.weight = w
-                layer.forward = fq_forward
-        return model
+# full observer/quanter/config QAT+PTQ framework (ref quantization/*)
+from .observers import (ObserverFactory, BaseObserver, AbsmaxObserver,  # noqa: E402
+                        MovingAverageAbsmaxObserver, PerChannelAbsmaxObserver)
+from .quanters import (QuanterFactory, quanter, BaseQuanter,  # noqa: E402
+                       FakeQuanterWithAbsMaxObserver,
+                       FakeQuanterChannelWiseAbsMax)
+from .qconfig import (QuantConfig, SingleLayerConfig,  # noqa: E402
+                      DEFAULT_QAT_LAYER_MAPPINGS)
+from .qat_layers import (QuantedLinear, QuantedConv2D, ObserveWrapper,  # noqa: E402
+                         QuantizedConv2D)
+from .quantize import Quantization, QAT, PTQ  # noqa: E402
 
 
 def quanted_model_size_bytes(model):
-    """Report quantized parameter footprint."""
+    """Report quantized parameter footprint (int8 weights count 1 byte;
+    every other parameter counts once at its dtype width)."""
+    from .qat_layers import QuantizedConv2D
     total = 0
+    seen = set()
     for layer in model.sublayers(include_self=True):
-        if isinstance(layer, QuantizedLinear):
+        if isinstance(layer, (QuantizedLinear, QuantizedConv2D)):
             total += int(np.prod(layer.qweight.shape))
             total += int(np.prod(layer.scale.shape)) * 4
-        else:
-            for p in layer.parameters(include_sublayers=False):
-                total += int(np.prod(p.shape)) * 4
+        for p in layer.parameters(include_sublayers=False):
+            if id(p) in seen:
+                continue
+            seen.add(id(p))
+            total += int(np.prod(p.shape)) * jnp.dtype(p.dtype).itemsize
     return total

@@ -1,0 +1,122 @@
+"""Observers: collect activation/weight statistics for calibration.
+
+Ref: python/paddle/quantization/base_observer.py (BaseObserver),
+observers/abs_max.py (AbsmaxObserver). Observers are Layers that pass
+inputs through unchanged while recording range statistics; after
+calibration `cal_thresholds()` finalizes, and `scales()` / `zero_points()`
+feed the convert pass. TPU note: statistics live host-side (python
+floats/ndarrays) — observation is an eager-mode calibration phase, the
+quantized model that comes out of convert() is pure XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..nn.layer_base import Layer
+from ..tensor_impl import Tensor, as_tensor_data
+
+
+class ObserverFactory:
+    """Deferred constructor: holds (cls, kwargs); `_instance(layer)` builds
+    the observer bound to a layer (ref: quantization/factory.py)."""
+
+    def __init__(self, cls=None, **kwargs):
+        self._cls = cls if cls is not None else getattr(self, "_CLS", None)
+        self._kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self._cls(layer=layer, **self._kwargs)
+
+
+class BaseObserver(Layer):
+    """ref base_observer.py: forward observes + returns input unchanged."""
+
+    def __init__(self, quant_bits=8, layer=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._layer = layer
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1  # per-tensor
+
+    def observe(self, x):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def cal_thresholds(self):
+        pass
+
+    def scales(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def zero_points(self):
+        return 0.0  # symmetric by default
+
+    def forward(self, x):
+        self.observe(x)
+        return x
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| over all observed batches (per-tensor symmetric),
+    ref observers/abs_max.py."""
+
+    def __init__(self, quant_bits=8, layer=None):
+        super().__init__(quant_bits, layer)
+        self._max = 1e-9
+
+    def observe(self, x):
+        self._max = max(self._max,
+                        float(jnp.abs(as_tensor_data(x)).max()))
+
+    def scales(self):
+        return self._max / (2.0 ** (self._quant_bits - 1) - 1)
+
+    @classmethod
+    def factory(cls, **kw):
+        return ObserverFactory(cls, **kw)
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    """EMA of per-batch absmax (the PTQ counterpart of the reference's
+    moving-average quanter state)."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, layer=None):
+        super().__init__(quant_bits, layer)
+        self._rate = moving_rate
+        self._state = None
+
+    def observe(self, x):
+        cur = float(jnp.abs(as_tensor_data(x)).max())
+        self._state = cur if self._state is None else (
+            self._rate * self._state + (1 - self._rate) * cur)
+
+    def scales(self):
+        s = self._state if self._state is not None else 1e-9
+        return max(s, 1e-9) / (2.0 ** (self._quant_bits - 1) - 1)
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Per-channel |x| max along `quant_axis` (weights), ref channel-wise
+    abs-max observer capability."""
+
+    def __init__(self, quant_axis=0, quant_bits=8, layer=None):
+        super().__init__(quant_bits, layer)
+        self._axis = quant_axis
+        self._max = None
+
+    def quant_axis(self):
+        return self._axis
+
+    def observe(self, x):
+        arr = as_tensor_data(x)
+        reduce_axes = tuple(i for i in range(arr.ndim) if i != self._axis)
+        cur = np.asarray(jnp.abs(arr).max(axis=reduce_axes))
+        self._max = cur if self._max is None else np.maximum(self._max, cur)
+
+    def scales(self):
+        m = np.maximum(self._max, 1e-9)
+        return m / (2.0 ** (self._quant_bits - 1) - 1)

@@ -1,0 +1,129 @@
+"""QAT wrapper layers + observer wrapper + converted (deploy) layers.
+
+Ref: python/paddle/nn/quant/qat/ (QuantedLinear, QuantedConv2D),
+python/paddle/quantization/wrapper.py (ObserveWrapper). A Quanted* layer
+shares the wrapped layer's parameters and fake-quants weight/activation in
+forward; `convert()` (see qat.py/ptq.py) swaps them for int8 deploy layers
+whose dequant scale XLA fuses into the matmul/conv epilogue.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer_base import Layer
+from ..nn import functional as F
+from ..tensor_impl import Tensor, as_tensor_data, wrap
+
+
+def _make(factory, layer):
+    return None if factory is None else factory._instance(layer)
+
+
+class QuantedLinear(Layer):
+    """QAT Linear: y = (fq_a(x)) @ fq_w(W) + b (ref nn/quant/qat linear)."""
+
+    def __init__(self, linear, q_config):
+        super().__init__()
+        self._linear = linear
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.weight_quanter = _make(q_config.weight, linear)
+        self.activation_quanter = _make(q_config.activation, linear)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    """QAT Conv2D (ref nn/quant/qat conv)."""
+
+    def __init__(self, conv, q_config):
+        super().__init__()
+        self._conv = conv
+        self.weight = conv.weight
+        self.bias = conv.bias
+        self.weight_quanter = _make(q_config.weight, conv)
+        self.activation_quanter = _make(q_config.activation, conv)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        c = self._conv
+        return F.conv2d(x, w, self.bias, c._stride, c._padding, c._dilation,
+                        c._groups, c._data_format)
+
+
+class ObserveWrapper(Layer):
+    """PTQ calibration wrapper: observe input activations, then run the
+    wrapped layer unchanged (ref quantization/wrapper.py)."""
+
+    def __init__(self, observed, q_config, observe_weight=True):
+        super().__init__()
+        self._observed = observed
+        self.activation_observer = _make(q_config.activation, observed)
+        self.weight_observer = (_make(q_config.weight, observed)
+                                if observe_weight and
+                                getattr(observed, "weight", None) is not None
+                                else None)
+
+    def forward(self, *args, **kwargs):
+        if self.activation_observer is not None and args:
+            self.activation_observer(args[0])
+        if self.weight_observer is not None:
+            self.weight_observer(self._observed.weight)
+        return self._observed(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# converted / deploy layers (int8 weights + static scales)
+def quantize_with_scale(w, weight_scale, quant_axis):
+    """int8-quantize w: with an explicit scale (broadcast to w.ndim along
+    quant_axis), or computed per-channel (quant_axis >= 0) / per-tensor
+    (quant_axis < 0) from the live weight."""
+    w = as_tensor_data(w).astype(jnp.float32)
+    if weight_scale is not None:
+        scale = jnp.asarray(weight_scale, jnp.float32)
+        if scale.ndim != w.ndim and scale.size > 1:
+            shape = [1] * w.ndim
+            shape[quant_axis] = -1
+            scale = scale.reshape(shape)
+    elif quant_axis is None or quant_axis < 0:
+        scale = jnp.maximum(jnp.abs(w).max(), 1e-9) / 127.0
+    else:
+        reduce_axes = tuple(i for i in range(w.ndim) if i != quant_axis)
+        amax = jnp.abs(w).max(axis=reduce_axes, keepdims=True)
+        scale = jnp.maximum(amax, 1e-9) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -128, 127).astype(jnp.int8)
+    return q, scale
+
+
+class QuantizedConv2D(Layer):
+    """int8-weight conv for deploy; dequant scale folds into the epilogue.
+    Does NOT retain the fp32 source conv — only its int8 weight, scale,
+    bias, and geometry survive conversion."""
+
+    def __init__(self, conv, weight_scale=None, quant_axis=0):
+        super().__init__()
+        self.qweight, self.scale = quantize_with_scale(
+            conv.weight, weight_scale, quant_axis)
+        self.bias = conv.bias
+        self._stride = conv._stride
+        self._padding = conv._padding
+        self._dilation = conv._dilation
+        self._groups = conv._groups
+        self._data_format = conv._data_format
+
+    def forward(self, x):
+        w = self.qweight.astype(jnp.float32) * self.scale
+        return F.conv2d(x, wrap(w), self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)

@@ -1,0 +1,130 @@
+"""QuantConfig: map layers to quantization strategies.
+
+Ref: python/paddle/quantization/config.py — global config plus
+by-layer / by-name-prefix / by-type overrides, a QAT layer mapping
+(Linear -> QuantedLinear, Conv2D -> QuantedConv2D), and `_specify`
+which walks the model annotating each layer with its SingleLayerConfig.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+class SingleLayerConfig:
+    """ref config.py SingleLayerConfig: (activation factory, weight factory)."""
+
+    def __init__(self, activation, weight):
+        self._activation = activation
+        self._weight = weight
+
+    @property
+    def activation(self):
+        return self._activation
+
+    @property
+    def weight(self):
+        return self._weight
+
+    def __str__(self):
+        return f"activation: {self._activation}\nweight: {self._weight}"
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global_config = (SingleLayerConfig(activation, weight)
+                               if (activation is not None or
+                                   weight is not None) else None)
+        self._layer2config = {}
+        self._prefix2config = {}
+        self._type2config = {}
+        self._qat_layer_mapping = {
+            k: v for k, v in DEFAULT_QAT_LAYER_MAPPINGS.items()}
+        self._customized_leaves = []
+
+    # -- strategy setters (ref config.py API names) -------------------------
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for lyr in layers:
+            self._layer2config[id(lyr)] = SingleLayerConfig(activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = (layer_name if isinstance(layer_name, (list, tuple))
+                 else [layer_name])
+        for n in names:
+            self._prefix2config[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type2config[t] = SingleLayerConfig(activation, weight)
+
+    def add_qat_layer_mapping(self, source, target):
+        self._qat_layer_mapping[source] = target
+
+    def add_customized_leaf(self, layer_type):
+        self._customized_leaves.append(layer_type)
+
+    @property
+    def customized_leaves(self):
+        return self._customized_leaves
+
+    # -- resolution ---------------------------------------------------------
+    def _config_for(self, name, layer):
+        if id(layer) in self._layer2config:
+            return self._layer2config[id(layer)]
+        for prefix, cfg in self._prefix2config.items():
+            if name == prefix or name.startswith(prefix + "."):
+                return cfg
+        for t, cfg in self._type2config.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._global_config
+
+    def _specify(self, model):
+        """Annotate every sublayer with its resolved config
+        (ref config.py _specify)."""
+        for name, layer in model.named_sublayers(include_self=True):
+            layer._quant_config = self._config_for(name, layer)
+
+    def _needs_quant(self, layer):
+        cfg = getattr(layer, "_quant_config", None)
+        return cfg is not None and (cfg.activation is not None or
+                                    cfg.weight is not None)
+
+
+def _default_mappings():
+    from .qat_layers import QuantedLinear, QuantedConv2D
+    return {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
+
+
+class _LazyMapping(dict):
+    """DEFAULT_QAT_LAYER_MAPPINGS without a circular import at module load."""
+
+    def __init__(self):
+        super().__init__()
+        self._loaded = False
+
+    def _ensure(self):
+        if not self._loaded:
+            self.update(_default_mappings())
+            self._loaded = True
+
+    def items(self):
+        self._ensure()
+        return super().items()
+
+    def __iter__(self):
+        self._ensure()
+        return super().__iter__()
+
+    def __getitem__(self, k):
+        self._ensure()
+        return super().__getitem__(k)
+
+    def get(self, k, default=None):
+        self._ensure()
+        return super().get(k, default)
+
+
+DEFAULT_QAT_LAYER_MAPPINGS = _LazyMapping()

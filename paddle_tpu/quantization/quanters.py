@@ -1,0 +1,130 @@
+"""Fake quanters: simulate int quantization during QAT with a
+straight-through gradient estimator.
+
+Ref: python/paddle/quantization/base_quanter.py, quanters/abs_max.py
+(FakeQuanterWithAbsMaxObserver). A quanter is a Layer whose forward
+returns ``x + stop_gradient(dequant(quant(x)) - x)`` — the forward sees
+quantized values, the backward passes through untouched (STE). All value
+math runs through paddle ops so the eager tape records it; under
+jit.to_static the same ops trace into XLA (with the scale frozen to its
+calibrated value, since python-side EMA state cannot update in-graph).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..nn.layer_base import Layer
+from ..tensor_impl import Tensor, as_tensor_data, wrap
+
+
+class QuanterFactory:
+    def __init__(self, cls=None, **kwargs):
+        self._cls = cls
+        self._kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self._cls(layer=layer, **self._kwargs)
+
+
+def quanter(cls):
+    """Decorator: make `Cls(**kw)` usable directly as a factory in
+    QuantConfig (ref: quantization/factory.py `quanter`)."""
+    def build(**kwargs):
+        return QuanterFactory(cls, **kwargs)
+    build._cls = cls
+    return build
+
+
+class BaseQuanter(Layer):
+    def __init__(self, quant_bits=8, layer=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def zero_points(self):
+        return 0.0
+
+    def _qmax(self):
+        return 2.0 ** (self._quant_bits - 1) - 1
+
+    @staticmethod
+    def _ste(x, scale, qmax):
+        """x (Tensor or array) -> fake-quantized Tensor with STE grad."""
+        t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        arr = t._data
+        s = jnp.maximum(jnp.asarray(scale, arr.dtype), 1e-9)
+        q = jnp.clip(jnp.round(arr / s), -qmax - 1, qmax) * s
+        from ..dispatch import apply as _apply
+        import jax
+        return _apply(lambda a: a + jax.lax.stop_gradient(
+            q.astype(a.dtype) - a), t, op_name="fake_quant")
+
+
+def _is_tracer(a):
+    import jax
+    return isinstance(a, jax.core.Tracer)
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-average absmax fake quanter (ref quanters/abs_max.py
+    FakeQuanterWithAbsMaxObserverLayer): in training, updates an EMA of the
+    batch absmax then fake-quants with it; in eval, uses the stored EMA.
+    Under jit tracing the host-side EMA cannot update: the calibrated scale
+    is frozen into the graph (or, if never calibrated, computed in-graph
+    from the live tensor)."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, layer=None):
+        super().__init__(quant_bits, layer)
+        self._rate = moving_rate
+        self._state = None
+
+    def forward(self, x):
+        arr = as_tensor_data(x)
+        if _is_tracer(arr):
+            if self._state is not None:
+                scale = max(self._state, 1e-9) / self._qmax()
+            else:
+                scale = jnp.maximum(jnp.abs(arr).max(), 1e-9) / self._qmax()
+            return self._ste(x, scale, self._qmax())
+        if self.training or self._state is None:
+            cur = float(jnp.abs(arr).max())
+            self._state = cur if self._state is None else (
+                self._rate * self._state + (1 - self._rate) * cur)
+        scale = max(self._state, 1e-9) / self._qmax()
+        return self._ste(x, scale, self._qmax())
+
+    def scales(self):
+        return max(self._state if self._state is not None else 1e-9,
+                   1e-9) / self._qmax()
+
+
+class FakeQuanterChannelWiseAbsMax(BaseQuanter):
+    """Per-channel absmax fake quanter for weights (ref
+    quanters capability / channel-wise abs-max): the scale is recomputed
+    from the live weight every forward, so QAT tracks weight updates."""
+
+    def __init__(self, quant_axis=0, quant_bits=8, layer=None):
+        super().__init__(quant_bits, layer)
+        self._axis = quant_axis
+        self._last_scale = None
+
+    def quant_axis(self):
+        return self._axis
+
+    def forward(self, x):
+        arr = as_tensor_data(x)
+        reduce_axes = tuple(i for i in range(arr.ndim) if i != self._axis)
+        amax = jnp.abs(arr).max(axis=reduce_axes, keepdims=True)
+        scale = jnp.maximum(amax, 1e-9) / self._qmax()
+        if not _is_tracer(arr):
+            self._last_scale = np.asarray(scale)
+        return self._ste(x, scale, self._qmax())
+
+    def scales(self):
+        return self._last_scale

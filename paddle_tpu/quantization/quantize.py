@@ -1,0 +1,158 @@
+"""QAT / PTQ passes: insert fake-quanters or observers, then convert to an
+int8 deploy model.
+
+Ref: python/paddle/quantization/quantize.py (Quantization base),
+qat.py (QAT), ptq.py (PTQ). The pass structure mirrors the reference —
+`_specify` annotates layers with their strategy, insert swaps layers via
+the QAT layer mapping (or wraps them for observation), `convert` strips
+the training scaffolding into int8-weight layers whose dequant multiply
+XLA fuses into the MXU matmul/conv epilogue.
+"""
+from __future__ import annotations
+
+import copy
+
+from .. import nn
+from ..nn.layer_base import Layer
+from .qconfig import QuantConfig
+from .qat_layers import (QuantedLinear, QuantedConv2D, ObserveWrapper,
+                         QuantizedConv2D)
+
+
+def _replace_sublayers(model: Layer, fn):
+    """Depth-first sublayer replacement: fn(layer) -> new layer or None."""
+    for name, layer in list(model._sub_layers.items()):
+        new = fn(layer)
+        if new is not None and new is not layer:
+            model._sub_layers[name] = new
+        else:
+            _replace_sublayers(layer, fn)
+
+
+class Quantization:
+    """Base pass (ref quantize.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model, inplace=False):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def convert(self, model, inplace=False):
+        """Swap QAT/observer scaffolding for int8 deploy layers."""
+        _model = model if inplace else copy.deepcopy(model)
+
+        def conv(layer):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                from . import QuantizedLinear
+                inner = (layer._linear if isinstance(layer, QuantedLinear)
+                         else layer._conv)
+                inner.weight = layer.weight
+                inner.bias = layer.bias
+                # quantize the LIVE weight along the axis training simulated
+                # (a scale recorded before the last opt.step() would clip
+                # channels that grew since)
+                wq = layer.weight_quanter
+                default_axis = 1 if isinstance(layer, QuantedLinear) else 0
+                axis = wq.quant_axis() if wq is not None else default_axis
+                cls = (QuantizedLinear if isinstance(layer, QuantedLinear)
+                       else QuantizedConv2D)
+                q = cls(inner, quant_axis=axis)
+                if layer.activation_quanter is not None:
+                    q.act_scale = layer.activation_quanter.scales()
+                return q
+            if isinstance(layer, ObserveWrapper):
+                inner = layer._observed
+                q = inner
+                wo = layer.weight_observer
+                w_scale = wo.scales() if wo is not None else None
+                w_axis = wo.quant_axis() if wo is not None else 1
+                if isinstance(inner, nn.Linear):
+                    from . import QuantizedLinear
+                    q = QuantizedLinear(inner, weight_scale=w_scale,
+                                        quant_axis=w_axis)
+                elif isinstance(inner, nn.Conv2D):
+                    q = QuantizedConv2D(inner, weight_scale=w_scale,
+                                        quant_axis=w_axis if wo is not None
+                                        else 0)
+                if layer.activation_observer is not None:
+                    q.act_scale = layer.activation_observer.scales()
+                return q
+            return None
+
+        _replace_sublayers(_model, conv)
+        return _model
+
+
+class QAT(Quantization):
+    """Prepare a model for quantization-aware training (ref qat.py)."""
+
+    def __init__(self, config: QuantConfig = None):
+        if config is None:
+            from .quanters import (QuanterFactory,
+                                   FakeQuanterWithAbsMaxObserver,
+                                   FakeQuanterChannelWiseAbsMax)
+            config = QuantConfig(
+                activation=QuanterFactory(FakeQuanterWithAbsMaxObserver,
+                                          moving_rate=0.9),
+                weight=QuanterFactory(FakeQuanterChannelWiseAbsMax,
+                                      quant_axis=1))
+            # conv weights are [out, in, kh, kw]: per-OUT-channel axis is 0
+            config.add_type_config(
+                nn.Conv2D,
+                activation=QuanterFactory(FakeQuanterWithAbsMaxObserver,
+                                          moving_rate=0.9),
+                weight=QuanterFactory(FakeQuanterChannelWiseAbsMax,
+                                      quant_axis=0))
+        super().__init__(config)
+
+    def quantize(self, model: Layer, inplace=False):
+        _model = model if inplace else copy.deepcopy(model)
+        self._config._specify(_model)
+        mapping = self._config._qat_layer_mapping
+
+        def ins(layer):
+            if not self._config._needs_quant(layer):
+                return None
+            for src, dst in mapping.items():
+                if type(layer) is src:
+                    return dst(layer, layer._quant_config)
+            return None
+
+        _replace_sublayers(_model, ins)
+        return _model
+
+
+class PTQ(Quantization):
+    """Post-training quantization: observe -> calibrate -> convert
+    (ref ptq.py)."""
+
+    def __init__(self, config: QuantConfig = None):
+        if config is None:
+            from .observers import (ObserverFactory, AbsmaxObserver,
+                                    PerChannelAbsmaxObserver)
+            config = QuantConfig(
+                activation=ObserverFactory(AbsmaxObserver),
+                weight=ObserverFactory(PerChannelAbsmaxObserver,
+                                       quant_axis=1))
+            # conv weights are [out, in, kh, kw]: per-OUT-channel axis is 0
+            config.add_type_config(
+                nn.Conv2D,
+                activation=ObserverFactory(AbsmaxObserver),
+                weight=ObserverFactory(PerChannelAbsmaxObserver,
+                                       quant_axis=0))
+        super().__init__(config)
+
+    def quantize(self, model: Layer, inplace=False):
+        _model = model if inplace else copy.deepcopy(model)
+        self._config._specify(_model)
+
+        def wrapit(layer):
+            if not self._config._needs_quant(layer):
+                return None
+            if isinstance(layer, (nn.Linear, nn.Conv2D)):
+                return ObserveWrapper(layer, layer._quant_config)
+            return None
+
+        _replace_sublayers(_model, wrapit)
+        return _model
