@@ -23,8 +23,9 @@ _global_mesh: Mesh | None = None
 _initialized = False
 
 # canonical hybrid-parallel axis order, outermost first. mp innermost so
-# tensor-parallel collectives ride neighboring ICI links
-HYBRID_AXES = ("pp", "dp", "sharding", "sp", "mp")
+# tensor-parallel collectives ride neighboring ICI links; ep next-innermost
+# so the MoE all_to_all stays on near links too
+HYBRID_AXES = ("pp", "dp", "sharding", "sp", "ep", "mp")
 
 
 def init_parallel_env():
@@ -68,20 +69,21 @@ def get_mesh() -> Mesh | None:
     return _global_mesh
 
 
-def create_hybrid_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, devices=None):
+def create_hybrid_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, ep=1,
+                       devices=None):
     """Build the hybrid-parallel mesh. Degrees must multiply to device count
     (a trailing dp fill-in is applied when dp == -1)."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
-    known = mp * pp * sharding * sp
+    known = mp * pp * sharding * sp * ep
     if dp == -1:
         assert n % known == 0, f"{n} devices not divisible by {known}"
         dp = n // known
     total = dp * known
     assert total <= n, (f"hybrid degrees dp{dp}×sharding{sharding}×pp{pp}×sp{sp}"
-                        f"×mp{mp}={total} > {n} devices")
+                        f"×mp{mp}×ep{ep}={total} > {n} devices")
     devices = list(devices)[:total]  # sub-mesh when degrees underfill the slice
-    shape = dict(zip(HYBRID_AXES, (pp, dp, sharding, sp, mp)))
+    shape = dict(zip(HYBRID_AXES, (pp, dp, sharding, sp, ep, mp)))
     arr = np.array(devices).reshape(tuple(shape[a] for a in HYBRID_AXES))
     mesh = Mesh(arr, HYBRID_AXES)
     set_mesh(mesh)

@@ -7,6 +7,7 @@
 - ``optimizer``: LookAhead, ModelAverage wrappers
 """
 from . import checkpoint  # noqa: F401
+from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401

@@ -1,0 +1,3 @@
+"""paddle.incubate.distributed parity surface (ref:
+python/paddle/incubate/distributed/)."""
+from . import models  # noqa: F401
