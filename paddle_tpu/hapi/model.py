@@ -38,21 +38,68 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._mesh = None
+        self._amp_level = None
+        self._amp_dtype = "bfloat16"
+        self._scaler = None
+        self._train_step = None
         self.stop_training = False
 
     # -- configuration ----------------------------------------------------
-    def prepare(self, optimizer=None, loss=None, metrics=None, jit=False):
+    def prepare(self, optimizer=None, loss=None, metrics=None, jit=False,
+                mesh=None, amp_level=None, amp_dtype="bfloat16"):
+        """Configure the loop. TPU-native extensions over the reference
+        (ref python/paddle/hapi/model.py Model.prepare, whose distributed
+        path wraps the net in Fleet's DataParallel):
+
+        - mesh: a jax.sharding.Mesh — fit() runs a single compiled
+          TrainStep with params replicated and the batch sharded over the
+          mesh's 'dp'/'sdp' axes; XLA inserts the gradient all-reduce the
+          reference gets from ProcessGroupNCCL.
+        - amp_level: 'O1' traces the step under amp.auto_cast (white ops
+          in bf16/fp16 on the MXU); 'O2' casts params via amp.decorate and
+          enables master weights. float16 + eager adds GradScaler loss
+          scaling; bfloat16 needs none.
+        """
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
         self._jit = jit
+        self._mesh = mesh
+        self._amp_level = amp_level
+        self._amp_dtype = amp_dtype
         self._train_step = None
+        self._scaler = None
         if optimizer is not None and getattr(optimizer, "_parameter_list", None) is None:
             optimizer._parameter_list = list(self.network.parameters())
-        if jit and optimizer is not None and loss is not None:
+        if amp_level == "O2":
+            from .. import amp as amp_mod
+            if optimizer is not None:
+                amp_mod.decorate(self.network, optimizer, level="O2",
+                                 dtype=amp_dtype)
+            else:
+                amp_mod.decorate(self.network, level="O2", dtype=amp_dtype)
+        if (jit or mesh is not None) and optimizer is not None and loss is not None:
+            if amp_level is not None and amp_dtype == "float16":
+                raise ValueError(
+                    "float16 AMP needs GradScaler loss scaling, which the "
+                    "compiled TrainStep path does not integrate; use "
+                    "amp_dtype='bfloat16' (the TPU-native choice, no "
+                    "scaling needed) or the eager path (jit=False, no mesh)")
             from ..jit.train_step import TrainStep
-            self._train_step = TrainStep(self.network, loss, optimizer)
+            self._train_step = TrainStep(self.network, loss, optimizer,
+                                         mesh=mesh)
+        elif amp_level is not None and amp_dtype == "float16":
+            from ..amp import GradScaler
+            self._scaler = GradScaler()
         return self
+
+    def _amp_ctx(self):
+        if self._amp_level is None:
+            import contextlib
+            return contextlib.nullcontext()
+        from ..amp import auto_cast
+        return auto_cast(level=self._amp_level, dtype=self._amp_dtype)
 
     def parameters(self):
         return list(self.network.parameters())
@@ -63,15 +110,25 @@ class Model:
         inputs = [_as_tensor(x) for x in _to_list(inputs)]
         labels = [_as_tensor(x) for x in _to_list(labels)]
         if self._train_step is not None:
-            loss = self._train_step(inputs[0] if len(inputs) == 1 else inputs,
-                                    labels[0] if len(labels) == 1 else labels)
+            # auto_cast matters at trace time only (first call compiles);
+            # harmless afterwards.
+            with self._amp_ctx():
+                loss = self._train_step(
+                    inputs[0] if len(inputs) == 1 else inputs,
+                    labels[0] if len(labels) == 1 else labels)
             self._train_step.sync_to_model()
             return [float(loss)], self._metric_logs()
         self._optimizer.clear_grad()
-        outputs = self.network(*inputs)
-        loss = self._loss(outputs, *labels) if labels else self._loss(outputs)
-        loss.backward()
-        self._optimizer.step()
+        with self._amp_ctx():
+            outputs = self.network(*inputs)
+            loss = self._loss(outputs, *labels) if labels else self._loss(outputs)
+        if self._scaler is not None:
+            self._scaler.scale(loss).backward()
+            # step() runs unscale_ + optimizer.step + update() internally
+            self._scaler.step(self._optimizer)
+        else:
+            loss.backward()
+            self._optimizer.step()
         self._update_metrics(outputs, labels)
         return [float(loss)], self._metric_logs()
 

@@ -285,6 +285,17 @@ def masked_matmul(x, y, mask, name=None):
     return SparseCooTensor(coo.indices, vals, coo.shape)
 
 
+def __getattr__(name):
+    # Deferred: sparse.nn imports paddle_tpu.nn, which may not be fully
+    # initialized while the top-level package is still importing. Use
+    # importlib (NOT `from . import nn` — the fromlist machinery calls this
+    # __getattr__ again and recurses).
+    if name == "nn":
+        import importlib
+        return importlib.import_module(".nn", __name__)
+    raise AttributeError(f"module 'paddle_tpu.sparse' has no attribute {name!r}")
+
+
 def slice(x, axes, starts, ends, name=None):
     """Slice a sparse COO tensor along `axes` (ref sparse/unary.py slice):
     filter stored entries inside the range, shift coordinates."""

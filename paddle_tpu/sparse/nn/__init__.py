@@ -1,0 +1,207 @@
+"""paddle.sparse.nn (ref: python/paddle/sparse/nn/layer/).
+
+Sparse layers over SparseCooTensor activations: submanifold / regular
+sparse conv (gather -> dense GEMM -> segment scatter, MXU-friendly),
+BatchNorm over values, activations, sparse max pooling, and sparse
+attention. See functional/__init__.py for the compute design.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer_base import Layer
+from ...nn import BatchNorm1D
+from ...nn import initializer as _I
+from . import functional as F  # noqa: N812
+
+
+class _SparseConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd, subm,
+                 stride=1, padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        assert padding_mode == "zeros"
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._kernel_size = ks
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._subm = subm
+        self._data_format = data_format
+        # reference weight layout: kernel_size + [in/groups, out]
+        shape = list(ks) + [in_channels // groups, out_channels]
+        fan_in = in_channels * int(np.prod(ks)) // groups
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(shape=shape, attr=weight_attr,
+                                            dtype=self._dtype)
+        self.bias = self.create_parameter(
+            shape=[out_channels], attr=bias_attr, dtype=self._dtype,
+            is_bias=True,
+            default_initializer=_I.Uniform(-bound, bound)
+            if bias_attr is None else None)
+
+    def extra_repr(self):
+        return (f"kernel_size={self._kernel_size}, stride={self._stride}, "
+                f"subm={self._subm}")
+
+
+class Conv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, False,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class SubmConv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        if stride not in (1, (1, 1, 1), [1, 1, 1]):
+            raise NotImplementedError(
+                "SubmConv3D: submanifold conv preserves coordinates; "
+                "stride must be 1")
+        super().__init__(in_channels, out_channels, kernel_size, 3, True,
+                         1, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+        self._key = key
+
+    def forward(self, x):
+        return F.subm_conv3d(x, self.weight, self.bias, 1, self._padding,
+                             self._dilation, self._groups, self._data_format,
+                             key=self._key)
+
+
+class Conv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, False,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class SubmConv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        if stride not in (1, (1, 1), [1, 1]):
+            raise NotImplementedError(
+                "SubmConv2D: submanifold conv preserves coordinates; "
+                "stride must be 1")
+        super().__init__(in_channels, out_channels, kernel_size, 2, True,
+                         1, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+        self._key = key
+
+    def forward(self, x):
+        return F.subm_conv2d(x, self.weight, self.bias, 1, self._padding,
+                             self._dilation, self._groups, self._data_format,
+                             key=self._key)
+
+
+class BatchNorm(BatchNorm1D):
+    """BatchNorm over sparse values [nnz, C] (ref sparse/nn/layer/norm.py
+    BatchNorm, which also runs dense BN on the values view)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum=momentum, epsilon=epsilon,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+        self._sparse_data_format = data_format
+
+    def forward(self, x):
+        from ...tensor_impl import Tensor
+        from .functional import _coo_with_tensor_values, _values_input
+        vals = x.values if isinstance(x.values, Tensor) \
+            else Tensor(_values_input(x))
+        out = super().forward(vals)
+        return _coo_with_tensor_values(x.indices, out, x.shape)
+
+
+class SyncBatchNorm(BatchNorm):
+    """On a mesh the dense BN stats reduce globally under GSPMD — sync is
+    the compiled default (ref sparse/nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(layer, cls):
+            new = cls(layer._num_features, momentum=layer._momentum,
+                      epsilon=layer._epsilon)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new._mean = layer._mean
+            new._variance = layer._variance
+            return new
+        for name, sub in list(getattr(layer, "_sub_layers", {}).items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError(
+                "sparse MaxPool3D: return_mask is not supported")
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._kernel_size, self._stride,
+                            self._padding, self._ceil_mode, self._data_format)
+
+
+__all__ = [
+    "Conv3D", "SubmConv3D", "Conv2D", "SubmConv2D", "BatchNorm",
+    "SyncBatchNorm", "ReLU", "ReLU6", "LeakyReLU", "Softmax", "MaxPool3D",
+    "functional",
+]

@@ -1,0 +1,96 @@
+"""Kernel-map construction for sparse N-D convolution.
+
+The reference computes rulebooks in CUDA (ref: paddle/phi/kernels/sparse/
+gpu/conv_kernel.cu, python/paddle/sparse/nn/layer/conv.py). TPU-native
+design: coordinates live on host (the sparse API is eager, like the
+reference's), the kernel map is built with vectorized numpy hashing, and
+the actual compute is a gather -> dense GEMM (MXU) -> segment scatter per
+kernel offset, executed by XLA on device.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def flatten_coords(coords, spatial):
+    """coords [n, 1+nd] (batch, *spatial) -> unique int64 key per coord."""
+    key = coords[:, 0].astype(np.int64)
+    for d, size in enumerate(spatial):
+        key = key * int(size) + coords[:, 1 + d]
+    return key
+
+
+def decode_keys(keys, spatial):
+    coords = []
+    rem = keys.astype(np.int64)
+    for size in reversed(spatial):
+        coords.append(rem % int(size))
+        rem = rem // int(size)
+    coords.append(rem)  # batch
+    return np.stack(list(reversed(coords)), axis=1)
+
+
+def kernel_offsets(kernel):
+    """All kernel offsets in row-major order matching weight.reshape(-1, ...)."""
+    grids = np.meshgrid(*[np.arange(k) for k in kernel], indexing="ij")
+    return np.stack([g.ravel() for g in grids], axis=1)
+
+
+def build_kernel_map(coords, spatial, kernel, stride, padding, dilation, subm,
+                     ceil_mode=False):
+    """coords: np.int64 [nnz, 1+nd]. Returns (out_coords [m, 1+nd],
+    out_spatial, pairs) where pairs[k] = (in_idx, out_idx) arrays giving, for
+    kernel offset k, which input point contributes to which output point."""
+    nd = len(spatial)
+    spatial = np.asarray(spatial, np.int64)
+    kernel = np.asarray(kernel, np.int64)
+    stride = np.asarray(stride, np.int64)
+    padding = np.asarray(padding, np.int64)
+    dilation = np.asarray(dilation, np.int64)
+    offsets = kernel_offsets(kernel)
+    n = coords.shape[0]
+
+    if subm:
+        # Submanifold: output coordinates == input coordinates (stride 1);
+        # pair (i -> j) exists when coords[i] == coords[j] + (k - c) * dil.
+        keys = flatten_coords(coords, spatial)
+        order = np.argsort(keys)
+        skeys = keys[order]
+        center = (kernel - 1) // 2 * dilation
+        pairs = []
+        for off in offsets:
+            delta = off * dilation - center
+            cand = coords.copy()
+            cand[:, 1:] = coords[:, 1:] + delta
+            valid = np.all((cand[:, 1:] >= 0) & (cand[:, 1:] < spatial), axis=1)
+            qk = flatten_coords(cand, spatial)
+            pos = np.clip(np.searchsorted(skeys, qk), 0, max(n - 1, 0))
+            found = valid if n == 0 else (skeys[pos] == qk) & valid
+            in_idx = order[pos[found]]
+            out_idx = np.nonzero(found)[0]
+            pairs.append((in_idx.astype(np.int32), out_idx.astype(np.int32)))
+        return coords, [int(s) for s in spatial], pairs
+
+    numer = spatial + 2 * padding - dilation * (kernel - 1) - 1
+    if ceil_mode:
+        numer = numer + stride - 1  # partial edge windows produce outputs
+    out_spatial = numer // stride + 1
+    cand = []
+    for off in offsets:
+        num = coords[:, 1:] + padding - off * dilation
+        ok = np.all(num % stride == 0, axis=1) & np.all(num >= 0, axis=1)
+        oc = num // stride
+        ok &= np.all(oc < out_spatial, axis=1)
+        cand.append((ok, oc))
+    keyed = [flatten_coords(
+        np.concatenate([coords[ok, :1], oc[ok]], axis=1), out_spatial)
+        for ok, oc in cand]
+    allk = np.concatenate(keyed) if keyed else np.zeros(0, np.int64)
+    uniq = np.unique(allk)
+    out_coords = decode_keys(uniq, out_spatial)
+    pairs = []
+    for (ok, oc), qk in zip(cand, keyed):
+        in_idx = np.nonzero(ok)[0]
+        out_idx = np.searchsorted(uniq, qk)
+        pairs.append((in_idx.astype(np.int32), out_idx.astype(np.int32)))
+    return out_coords, [int(s) for s in out_spatial], pairs

@@ -1,0 +1,312 @@
+"""paddle.sparse.nn.functional (ref: python/paddle/sparse/nn/functional/).
+
+Layout conventions follow the reference: a sparse activation tensor has
+shape [N, *spatial, C] (channels last), indices [1+nd, nnz], values
+[nnz, C]; conv weights are kernel_size + [C_in, C_out].
+
+All value computations route through dispatch.apply so the eager autograd
+tape records them — sparse conv/pool/attention are trainable end to end.
+The kernel map (which input point hits which output point under which
+kernel offset) is host-side numpy; the per-offset compute is a gather ->
+dense GEMM (MXU-friendly) -> segment scatter executed by XLA.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....tensor_impl import Tensor, as_tensor_data, wrap
+from ....dispatch import apply
+from ... import SparseCooTensor, SparseCsrTensor
+from .._kernel_map import build_kernel_map
+
+
+def _np_coords(sp):
+    return np.asarray(jax.device_get(as_tensor_data(sp.indices))).T.astype(np.int64)
+
+
+def _coo_with_tensor_values(indices, values, shape):
+    """Build a SparseCooTensor keeping `values` as a (possibly taped) Tensor
+    so gradients flow across chained sparse.nn layers."""
+    sp = SparseCooTensor.__new__(SparseCooTensor)
+    sp.indices = jnp.asarray(as_tensor_data(indices)).astype(jnp.int64)
+    sp.values = values
+    sp.shape = list(shape)
+    return sp
+
+
+def _csr_with_tensor_values(crows, cols, values, shape):
+    sp = SparseCsrTensor.__new__(SparseCsrTensor)
+    sp.crows = jnp.asarray(as_tensor_data(crows)).astype(jnp.int64)
+    sp.cols = jnp.asarray(as_tensor_data(cols)).astype(jnp.int64)
+    sp.values = values
+    sp.shape = list(shape)
+    return sp
+
+
+def _values_input(sp):
+    """The values leaf as fed to dispatch.apply (keeps a live tape if any)."""
+    return sp.values if isinstance(sp.values, Tensor) else jnp.asarray(sp.values)
+
+
+# Rulebook cache (reference `key=` semantics, ref sparse/nn/layer/conv.py):
+# building the kernel map costs a device->host indices sync plus numpy
+# hashing; the sparsity pattern is identical across submanifold chains and
+# across layers sharing a key, so cache per tensor (propagated through subm
+# outputs) and per user key. A keyed hit additionally requires the SAME
+# indices array object — a reused key with a different point cloud must
+# rebuild, never return a stale map.
+_RULEBOOK_CACHE = {}
+_RULEBOOK_CACHE_MAX = 256
+
+
+def _get_kernel_map(x, kernel, stride, padding, dilation, subm, key=None,
+                    ceil_mode=False):
+    geom = (kernel, stride, padding, dilation, subm, ceil_mode,
+            tuple(x.shape))
+    if key is not None:
+        cached = _RULEBOOK_CACHE.get((key, geom))
+        if cached is not None and cached[0] is x.indices:
+            return cached[1]
+    per_tensor = getattr(x, "_kmap_cache", None)
+    if per_tensor is None:
+        per_tensor = x._kmap_cache = {}
+    entry = per_tensor.get(geom)
+    if entry is None:
+        nd = len(kernel)
+        coords = _np_coords(x)
+        out_coords, out_spatial, pairs = build_kernel_map(
+            coords, x.shape[1:1 + nd], kernel, stride, padding, dilation,
+            subm, ceil_mode)
+        pairs_dev = tuple((jnp.asarray(i), jnp.asarray(j)) for i, j in pairs
+                          if len(i) > 0)
+        live = tuple(k for k, (i, j) in enumerate(pairs) if len(i) > 0)
+        entry = (out_coords, out_spatial, pairs, pairs_dev, live)
+        per_tensor[geom] = entry
+    if key is not None:
+        while len(_RULEBOOK_CACHE) >= _RULEBOOK_CACHE_MAX:
+            _RULEBOOK_CACHE.pop(next(iter(_RULEBOOK_CACHE)))
+        _RULEBOOK_CACHE[(key, geom)] = (x.indices, entry)
+    return entry
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, subm, nd, name,
+          key=None):
+    assert isinstance(x, SparseCooTensor), f"{name} expects a SparseCooTensor"
+    assert groups == 1, f"{name}: groups > 1 not supported"
+
+    def tup(v):
+        return (v,) * nd if isinstance(v, int) else tuple(v)
+
+    w_data = as_tensor_data(weight)
+    kernel = tuple(int(k) for k in w_data.shape[:nd])
+    cin, cout = int(w_data.shape[nd]), int(w_data.shape[nd + 1])
+    assert x.shape[1 + nd] == cin, (x.shape, w_data.shape)
+
+    out_coords, out_spatial, _pairs, pairs_dev, live = _get_kernel_map(
+        x, kernel, tup(stride), tup(padding), tup(dilation), subm, key=key)
+    n_out = out_coords.shape[0]
+
+    def compute(values, w, *maybe_bias):
+        wk = w.reshape((-1, cin, cout))
+        out = jnp.zeros((n_out, cout), values.dtype)
+        for k, (ii, jj) in zip(live, pairs_dev):
+            out = out.at[jj].add(values[ii] @ wk[k])
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+
+    args = (_values_input(x), weight) + ((bias,) if bias is not None else ())
+    out_vals = apply(compute, *args, op_name=name)
+    new_shape = [x.shape[0]] + list(out_spatial) + [cout]
+    out = _coo_with_tensor_values(
+        x.indices if subm else jnp.asarray(out_coords.T), out_vals, new_shape)
+    if subm:
+        # identical coords -> later subm layers reuse this rulebook cache
+        out._kmap_cache = x._kmap_cache
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3-D conv (ref sparse/nn/functional/conv.py conv3d)."""
+    assert data_format == "NDHWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=False, nd=3, name="sparse_conv3d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv: output coords == input coords
+    (ref sparse/nn/functional/conv.py subm_conv3d)."""
+    assert data_format == "NDHWC"
+    return _conv(x, weight, bias, 1, padding, dilation, groups,
+                 subm=True, nd=3, name="subm_conv3d", key=key)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    assert data_format == "NHWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=False, nd=2, name="sparse_conv2d")
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    assert data_format == "NHWC"
+    return _conv(x, weight, bias, 1, padding, dilation, groups,
+                 subm=True, nd=2, name="subm_conv2d", key=key)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling (ref sparse/nn/functional/pooling.py)."""
+    assert isinstance(x, SparseCooTensor) and data_format == "NDHWC"
+    nd = 3
+
+    def tup(v):
+        return (v,) * nd if isinstance(v, int) else tuple(v)
+
+    kernel = tup(kernel_size)
+    stride = tup(stride if stride is not None else kernel_size)
+    out_coords, out_spatial, pairs, _pd, _live = _get_kernel_map(
+        x, kernel, stride, tup(padding), tup(1), subm=False,
+        ceil_mode=ceil_mode)
+    n_out = out_coords.shape[0]
+    in_cat = jnp.asarray(np.concatenate([i for i, _ in pairs]))
+    out_cat = jnp.asarray(np.concatenate([j for _, j in pairs]))
+
+    def compute(values):
+        return jax.ops.segment_max(values[in_cat], out_cat,
+                                   num_segments=n_out)
+
+    out_vals = apply(compute, _values_input(x), op_name="sparse_max_pool3d")
+    new_shape = [x.shape[0]] + list(out_spatial) + [x.shape[-1]]
+    return _coo_with_tensor_values(jnp.asarray(out_coords.T), out_vals,
+                                   new_shape)
+
+
+def _values_unary(fn, op_name):
+    def op(x, *args, **kw):
+        if isinstance(x, SparseCsrTensor):
+            out = apply(fn, _values_input(x), op_name=op_name)
+            return _csr_with_tensor_values(x.crows, x.cols, out, x.shape)
+        if isinstance(x, SparseCooTensor):
+            out = apply(fn, _values_input(x), op_name=op_name)
+            return _coo_with_tensor_values(x.indices, out, x.shape)
+        return apply(fn, x, op_name=op_name)
+    return op
+
+
+relu = _values_unary(lambda v: jnp.maximum(v, 0), "sparse_relu")
+relu6 = _values_unary(lambda v: jnp.clip(v, 0, 6), "sparse_relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _values_unary(
+        lambda v: jnp.where(v >= 0, v, negative_slope * v), "sparse_leaky_relu")(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over stored values only (the reference treats
+    absent entries as -inf, ref sparse/nn/functional/activation.py)."""
+    assert axis in (-1, None) or axis == len(x.shape) - 1, \
+        "sparse softmax supports the last axis"
+    csr = isinstance(x, SparseCsrTensor)
+    coo = x.to_coo() if csr else x
+    rows_np = _np_coords(coo)[:, :-1]
+    # flatten every dim but the last into a row id
+    row_id = np.zeros(rows_np.shape[0], np.int64)
+    for d in range(rows_np.shape[1]):
+        row_id = row_id * int(x.shape[d]) + rows_np[:, d]
+    _, row_id = np.unique(row_id, return_inverse=True)
+    n_rows = int(row_id.max()) + 1 if row_id.size else 0
+    rid = jnp.asarray(row_id)
+
+    def compute(values):
+        m = jax.ops.segment_max(values, rid, num_segments=n_rows)
+        p = jnp.exp(values - m[rid])
+        z = jax.ops.segment_sum(p, rid, num_segments=n_rows)
+        return p / z[rid]
+
+    # to_coo strips any taped values, but keeps row-major value ORDER — feed
+    # the original tensor's values so the tape survives for CSR inputs too.
+    out = apply(compute, _values_input(x), op_name="sparse_softmax")
+    if csr:
+        return _csr_with_tensor_values(x.crows, x.cols, out, x.shape)
+    return _coo_with_tensor_values(coo.indices, out, x.shape)
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NDHWC",
+               use_global_stats=None, name=None):
+    """BatchNorm over sparse values [nnz, C] (ref sparse/nn/layer/norm.py —
+    the reference also normalizes the values view with dense BN)."""
+    from ....nn import functional as F
+    vals = x.values if isinstance(x.values, Tensor) else wrap(x.values)
+    out = F.batch_norm(vals, running_mean, running_var, weight, bias,
+                       training=training, momentum=momentum, epsilon=epsilon,
+                       data_format="NC", use_global_stats=use_global_stats)
+    return _coo_with_tensor_values(x.indices, out, x.shape)
+
+
+sync_batch_norm = batch_norm
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse attention: scores evaluated ONLY at sparse_mask's nnz
+    coordinates (SDDMM), sparse row softmax, then SpMM with value
+    (ref python/paddle/sparse/nn/functional/transformer.py attention).
+
+    query/key/value: dense [B, H, S, D]. sparse_mask: 2-D [S, S] COO/CSR
+    layout shared across batch and heads (the reference takes a batched CSR;
+    a shared layout is the common case and the TPU-friendly one — one
+    kernel map, batched GEMMs). key_padding_mask [B, S] and attn_mask
+    [S, S] are additive (-inf to exclude), as in the reference.
+    """
+    coo = sparse_mask.to_coo() if isinstance(sparse_mask, SparseCsrTensor) \
+        else sparse_mask
+    assert coo.indices.shape[0] == 2, "sparse_mask must be 2-D [S, S]"
+    idx = np.asarray(jax.device_get(coo.indices))
+    rows, cols = jnp.asarray(idx[0]), jnp.asarray(idx[1])
+    S = int(coo.shape[0])
+
+    q_in = query if isinstance(query, Tensor) else wrap(query)
+    extra = []
+    has_kpm = key_padding_mask is not None
+    has_am = attn_mask is not None
+    if has_kpm:
+        extra.append(key_padding_mask)
+    if has_am:
+        extra.append(attn_mask)
+
+    def compute(q, k, v, *masks):
+        D = q.shape[-1]
+        qr = jnp.take(q, rows, axis=2)          # [B, H, nnz, D]
+        kc = jnp.take(k, cols, axis=2)
+        s = jnp.einsum("bhnd,bhnd->bhn", qr, kc) / math.sqrt(D)
+        mi = 0
+        if has_kpm:
+            s = s + jnp.take(masks[mi], cols, axis=1)[:, None, :]
+            mi += 1
+        if has_am:
+            s = s + masks[mi][rows, cols][None, None, :]
+        B, H, nnz = s.shape
+        flat = s.reshape(B * H, nnz)
+        seg_max = jax.vmap(
+            lambda t: jax.ops.segment_max(t, rows, num_segments=S))(flat)
+        p = jnp.exp(flat - jnp.take(seg_max, rows, axis=1))
+        z = jax.vmap(
+            lambda t: jax.ops.segment_sum(t, rows, num_segments=S))(p)
+        p = p / jnp.take(z, rows, axis=1)
+        vc = jnp.take(v, cols, axis=2).reshape(B * H, nnz, D)
+        out = jax.vmap(
+            lambda pw, vv: jax.ops.segment_sum(pw[:, None] * vv, rows,
+                                               num_segments=S))(p, vc)
+        return out.reshape(B, H, S, D)
+
+    return apply(compute, q_in, key, value, *extra, op_name="sparse_attention")

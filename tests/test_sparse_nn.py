@@ -1,0 +1,210 @@
+"""sparse.nn: conv/pool/norm/activation/attention vs dense references
+(ref: python/paddle/sparse/nn/layer/conv.py, functional/transformer.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.sparse import sparse_coo_tensor, SparseCooTensor
+import paddle_tpu.sparse.nn as spnn
+import paddle_tpu.sparse.nn.functional as spF
+
+
+def _random_sparse_ndhwc(rng, n=2, d=6, h=6, w=6, c=4, density=0.2):
+    dense = rng.normal(size=(n, d, h, w, c)).astype("float32")
+    mask = rng.random((n, d, h, w)) < density
+    dense = dense * mask[..., None]
+    idx = np.stack(np.nonzero(mask))            # [4, nnz]
+    vals = dense[mask]                          # [nnz, c]
+    sp = sparse_coo_tensor(idx, vals, [n, d, h, w, c])
+    return sp, dense
+
+
+def _dense_conv3d_ndhwc(x, w, b, stride, padding, dilation):
+    # x [N,D,H,W,C], w [kd,kh,kw,ci,co]
+    out = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w),
+        window_strides=(stride,) * 3,
+        padding=[(padding, padding)] * 3,
+        rhs_dilation=(dilation,) * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    return out + (0 if b is None else jnp.asarray(b))
+
+
+def test_conv3d_matches_dense():
+    rng = np.random.default_rng(0)
+    sp, dense = _random_sparse_ndhwc(rng)
+    conv = spnn.Conv3D(4, 5, kernel_size=3, stride=2, padding=1)
+    out = conv(sp)
+    ref = _dense_conv3d_ndhwc(dense, conv.weight.numpy(), conv.bias.numpy(),
+                              stride=2, padding=1, dilation=1)
+    got = out.to_dense().numpy()
+    assert got.shape == ref.shape
+    # sparse conv omits outputs with NO active input in their window; compare
+    # only at the coordinates the sparse op produced (bias-only elsewhere)
+    coords = np.asarray(jax.device_get(out.indices))
+    at = tuple(coords[i] for i in range(4))
+    np.testing.assert_allclose(got[at], np.asarray(ref)[at],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_subm_conv3d_preserves_coords_and_matches_dense_at_sites():
+    rng = np.random.default_rng(1)
+    sp, dense = _random_sparse_ndhwc(rng, density=0.15)
+    conv = spnn.SubmConv3D(4, 6, kernel_size=3, padding=1)
+    out = conv(sp)
+    assert np.array_equal(np.asarray(jax.device_get(out.indices)),
+                          np.asarray(jax.device_get(sp.indices)))
+    # submanifold == dense conv evaluated at the input's active sites
+    ref = _dense_conv3d_ndhwc(dense, conv.weight.numpy(), conv.bias.numpy(),
+                              stride=1, padding=1, dilation=1)
+    coords = np.asarray(jax.device_get(out.indices))
+    at = tuple(coords[i] for i in range(4))
+    np.testing.assert_allclose(out.to_dense().numpy()[at], np.asarray(ref)[at],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_conv2d_and_subm_conv2d():
+    rng = np.random.default_rng(2)
+    dense = rng.normal(size=(2, 8, 8, 3)).astype("float32")
+    mask = rng.random((2, 8, 8)) < 0.3
+    dense *= mask[..., None]
+    idx = np.stack(np.nonzero(mask))
+    sp = sparse_coo_tensor(idx, dense[mask], [2, 8, 8, 3])
+    conv = spnn.SubmConv2D(3, 4, kernel_size=3, padding=1)
+    out = conv(sp)
+    assert list(out.shape) == [2, 8, 8, 4]
+    conv2 = spnn.Conv2D(3, 4, kernel_size=2, stride=2)
+    out2 = conv2(sp)
+    assert list(out2.shape) == [2, 4, 4, 4]
+
+
+def test_sparse_conv_is_trainable():
+    rng = np.random.default_rng(3)
+    sp, _ = _random_sparse_ndhwc(rng, c=4)
+    net = paddle.nn.Sequential()
+    conv = spnn.SubmConv3D(4, 8, 3, padding=1)
+    bn = spnn.BatchNorm(8)
+    act = spnn.ReLU()
+    out = act(bn(conv(sp)))
+    loss = out.values.sum() if hasattr(out.values, "sum") else None
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert float(np.abs(conv.weight.grad.numpy()).sum()) > 0
+    assert bn.weight.grad is not None
+
+
+def test_batch_norm_values_normalized():
+    rng = np.random.default_rng(4)
+    sp, _ = _random_sparse_ndhwc(rng, c=5)
+    bn = spnn.BatchNorm(5)
+    bn.train()
+    out = bn(sp)
+    v = np.asarray(jax.device_get(
+        out.values._data if hasattr(out.values, "_data") else out.values))
+    np.testing.assert_allclose(v.mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(v.std(axis=0), 1.0, atol=1e-2)
+
+
+def test_max_pool3d_matches_dense_on_active_windows():
+    rng = np.random.default_rng(5)
+    sp, dense = _random_sparse_ndhwc(rng, d=4, h=4, w=4, c=3, density=0.5)
+    out = spnn.MaxPool3D(kernel_size=2, stride=2)(sp)
+    assert list(out.shape) == [2, 2, 2, 2, 3]
+    got = out.to_dense().numpy()
+    # dense maxpool treating absent entries as -inf at active windows
+    dref = np.asarray(jax.device_get(jnp.where(
+        jnp.asarray(dense) == 0, -jnp.inf, jnp.asarray(dense))))
+    coords = np.asarray(jax.device_get(out.indices))
+    for t in range(coords.shape[1]):
+        n, z, y, x = coords[:, t]
+        win = dref[n, 2*z:2*z+2, 2*y:2*y+2, 2*x:2*x+2, :]
+        np.testing.assert_allclose(got[n, z, y, x], win.max(axis=(0, 1, 2)),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_max_pool3d_ceil_mode_shape():
+    rng = np.random.default_rng(8)
+    sp, _ = _random_sparse_ndhwc(rng, d=5, h=5, w=5, c=2, density=0.6)
+    floor_out = spnn.MaxPool3D(kernel_size=2, stride=2)(sp)
+    ceil_out = spnn.MaxPool3D(kernel_size=2, stride=2, ceil_mode=True)(sp)
+    assert list(floor_out.shape)[1:4] == [2, 2, 2]
+    assert list(ceil_out.shape)[1:4] == [3, 3, 3]
+
+
+def test_rulebook_cache_reused():
+    rng = np.random.default_rng(9)
+    sp, _ = _random_sparse_ndhwc(rng)
+    c1 = spnn.SubmConv3D(4, 4, 3, padding=1)
+    c2 = spnn.SubmConv3D(4, 4, 3, padding=1)
+    out1 = c1(sp)
+    cache = sp._kmap_cache
+    assert len(cache) == 1
+    out2 = c2(out1)          # same coords -> shared cache, no rebuild
+    assert out1._kmap_cache is cache
+    assert len(cache) == 1
+
+
+def test_activations_and_softmax():
+    vals = np.array([[-1.0, 2.0], [3.0, -4.0]], np.float32)
+    idx = np.array([[0, 1], [0, 1]])
+    sp = sparse_coo_tensor(idx, vals, [2, 2, 2])
+    r = spnn.ReLU()(sp)
+    got = np.asarray(jax.device_get(
+        r.values._data if hasattr(r.values, "_data") else r.values))
+    np.testing.assert_allclose(got, np.maximum(vals, 0))
+    r6 = spnn.ReLU6()(sp)
+    lr = spnn.LeakyReLU(0.1)(sp)
+
+    # 2-D row softmax over stored entries only
+    idx2 = np.array([[0, 0, 1], [0, 2, 1]])
+    v2 = np.array([1.0, 2.0, 5.0], np.float32)
+    sp2 = sparse_coo_tensor(idx2, v2, [2, 3])
+    s = spnn.Softmax()(sp2)
+    sv = np.asarray(jax.device_get(
+        s.values._data if hasattr(s.values, "_data") else s.values))
+    e = np.exp([1.0, 2.0])
+    np.testing.assert_allclose(sv[:2], e / e.sum(), rtol=1e-6)
+    np.testing.assert_allclose(sv[2], 1.0, rtol=1e-6)
+
+
+def test_sparse_attention_matches_masked_dense():
+    rng = np.random.default_rng(6)
+    B, H, S, D = 2, 3, 8, 4
+    q = rng.normal(size=(B, H, S, D)).astype("float32")
+    k = rng.normal(size=(B, H, S, D)).astype("float32")
+    v = rng.normal(size=(B, H, S, D)).astype("float32")
+    mask = np.tril(np.ones((S, S), bool))  # causal layout
+    idx = np.stack(np.nonzero(mask))
+    sp_mask = sparse_coo_tensor(idx, np.ones(idx.shape[1], np.float32),
+                                [S, S])
+    out = spF.attention(q, k, v, sp_mask)
+    got = np.asarray(jax.device_get(
+        out._data if hasattr(out, "_data") else out))
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    s = np.where(mask[None, None], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_sparse_attention_grads_flow():
+    rng = np.random.default_rng(7)
+    B, H, S, D = 1, 2, 6, 4
+    q = paddle.to_tensor(rng.normal(size=(B, H, S, D)).astype("float32"),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.normal(size=(B, H, S, D)).astype("float32"),
+                         stop_gradient=False)
+    v = paddle.to_tensor(rng.normal(size=(B, H, S, D)).astype("float32"),
+                         stop_gradient=False)
+    mask = np.tril(np.ones((S, S), bool))
+    idx = np.stack(np.nonzero(mask))
+    sp_mask = sparse_coo_tensor(idx, np.ones(idx.shape[1], np.float32),
+                                [S, S])
+    out = spF.attention(q, k, v, sp_mask)
+    out.sum().backward()
+    for t in (q, k, v):
+        assert t.grad is not None
+        assert np.isfinite(t.grad.numpy()).all()
