@@ -63,9 +63,15 @@ def run(model_name, batch, seq, steps=10, warmup=2, use_flash=True):
     cfg.compute_dtype = "bfloat16" if on_tpu else "float32"
     cfg.remat = True
 
-    opt = paddle.optimizer.AdamW(2e-4, grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
-    # bf16 params + fp32 moments: fits 1.3B on a 16G chip; master-weight
-    # training (multi_precision) is the default on >=v5p HBM sizes
+    # bf16 params; moments drop to bf16 storage when fp32 moments alone would
+    # crowd a 16G chip (>= ~1B params: 2 + 8 bytes/param > half of HBM). The
+    # measured alternative is a guaranteed compile-time HBM OOM ("Used 20.4G
+    # of 15.75G") — bf16 moments are the single-chip analog of the
+    # reference's ZeRO moment sharding across a GPU pod.
+    _, n_params = model_flops_per_token(cfg, seq)
+    moment_dtype = "bfloat16" if (on_tpu and n_params > 1.0e9) else "float32"
+    opt = paddle.optimizer.AdamW(2e-4, grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0),
+                                 moment_dtype=moment_dtype)
     param_dtype = jnp.bfloat16 if on_tpu else jnp.float32
     _log(f"{model_name} bs={batch} seq={seq}: init params...")
     step = HybridTrainStep(cfg, opt, param_dtype=param_dtype)

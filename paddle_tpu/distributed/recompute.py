@@ -23,6 +23,9 @@ POLICIES = {
     "dots": jax.checkpoint_policies.checkpoint_dots,
     "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
     "nothing": jax.checkpoint_policies.everything_saveable,
+    # keep only the attention context (checkpoint_name'd in gpt_block_fn):
+    # +B*S*H bf16 per layer, and backward skips the flash-forward rerun
+    "save_attn": jax.checkpoint_policies.save_only_these_names("attn_ctx"),
 }
 
 

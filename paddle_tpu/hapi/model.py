@@ -72,6 +72,17 @@ class Model:
         self._scaler = None
         if optimizer is not None and getattr(optimizer, "_parameter_list", None) is None:
             optimizer._parameter_list = list(self.network.parameters())
+        compiled = (jit or mesh is not None) and optimizer is not None \
+            and loss is not None
+        if compiled and amp_level is not None and amp_dtype == "float16":
+            # validate BEFORE decorate: O2 decorate casts params in place,
+            # and a caller catching this error must be able to re-prepare
+            # from unmodified weights
+            raise ValueError(
+                "float16 AMP needs GradScaler loss scaling, which the "
+                "compiled TrainStep path does not integrate; use "
+                "amp_dtype='bfloat16' (the TPU-native choice, no "
+                "scaling needed) or the eager path (jit=False, no mesh)")
         if amp_level == "O2":
             from .. import amp as amp_mod
             if optimizer is not None:
@@ -79,13 +90,7 @@ class Model:
                                  dtype=amp_dtype)
             else:
                 amp_mod.decorate(self.network, level="O2", dtype=amp_dtype)
-        if (jit or mesh is not None) and optimizer is not None and loss is not None:
-            if amp_level is not None and amp_dtype == "float16":
-                raise ValueError(
-                    "float16 AMP needs GradScaler loss scaling, which the "
-                    "compiled TrainStep path does not integrate; use "
-                    "amp_dtype='bfloat16' (the TPU-native choice, no "
-                    "scaling needed) or the eager path (jit=False, no mesh)")
+        if compiled:
             from ..jit.train_step import TrainStep
             self._train_step = TrainStep(self.network, loss, optimizer,
                                          mesh=mesh)

@@ -45,6 +45,11 @@ class GPTConfig:
     use_flash: bool = True
     compute_dtype: str = "bfloat16"
     remat: bool = True
+    # remat policy preset (distributed/recompute.py POLICIES): "full"
+    # recomputes the whole block in backward; "dots"/"dots_no_batch" keep
+    # MXU outputs resident and recompute only elementwise ops — faster when
+    # HBM has headroom
+    remat_policy: str = "full"
     tie_embeddings: bool = False
     # pipeline-parallel schedule: "1f1b" (O(stages) activation residency,
     # ref fleet/meta_parallel/pipeline_parallel.py:230) or "gpipe"
@@ -250,6 +255,11 @@ def gpt_block_fn(config: GPTConfig):
         qkv = h1 @ p["qkv_w"].astype(x.dtype) + p["qkv_b"].astype(x.dtype)
         q, k, v = jnp.split(qkv.reshape(B, S, 3, nh, d), 3, axis=2)
         ctx = _attention(q[:, :, 0], k[:, :, 0], v[:, :, 0], config.use_flash)
+        # named residual: remat_policy="save_attn" keeps ctx so the backward
+        # pass skips the flash-forward rerun (flash bwd recomputes its own
+        # tiles from q/k/v; rerunning fwd for ctx would be pure waste)
+        from jax.ad_checkpoint import checkpoint_name
+        ctx = checkpoint_name(ctx, "attn_ctx")
         attn_out = ctx.reshape(B, S, H) @ p["out_w"].astype(x.dtype) + \
             p["out_b"].astype(x.dtype)
         x = x + attn_out

@@ -134,8 +134,15 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
                          vpp_stage_major=getattr(config, "vpp_stage_major",
                                                  False))
     else:
+        from ..distributed.recompute import POLICIES
+        pol_name = getattr(config, "remat_policy", "full") or "full"
+        if pol_name not in POLICIES:
+            raise ValueError(f"unknown remat_policy {pol_name!r}; "
+                             f"choose from {sorted(POLICIES)}")
+        ck_block = jax.checkpoint(block, policy=POLICIES[pol_name])
+
         def scan_body(h, layer_params):
-            return jax.checkpoint(block)(layer_params, h), None
+            return ck_block(layer_params, h), None
         x, _ = jax.lax.scan(scan_body, x, params["blocks"])
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, -1, keepdims=True)

@@ -122,28 +122,43 @@ class RMSProp(Optimizer):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, use_multi_tensor=False, name=None):
+                 multi_precision=False, use_multi_tensor=False, name=None,
+                 moment_dtype="float32"):
+        """moment_dtype: storage dtype for moment1/moment2 (update math stays
+        fp32). 'bfloat16' halves optimizer-state HBM — the single-chip analog
+        of the reference's ZeRO moment sharding; bf16 keeps fp32's exponent
+        range so moment2 does not underflow, it only loses mantissa."""
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._moment_dtype = jnp.dtype(moment_dtype)
 
     def _create_slots(self, arr):
-        return {"moment1": jnp.zeros_like(arr, dtype=jnp.float32),
-                "moment2": jnp.zeros_like(arr, dtype=jnp.float32)}
+        return {"moment1": jnp.zeros_like(arr, dtype=self._moment_dtype),
+                "moment2": jnp.zeros_like(arr, dtype=self._moment_dtype)}
+
+    def _moments_fp32(self, slots):
+        return (slots["moment1"].astype(jnp.float32),
+                slots["moment2"].astype(jnp.float32))
+
+    def _store_moments(self, m, v):
+        d = self._moment_dtype
+        return {"moment1": m.astype(d), "moment2": v.astype(d)}
 
     def _update(self, p, g, slots, lr, step, decay_on=True):
         b1, b2 = self._beta1, self._beta2
         g32 = g.astype(jnp.float32)
-        m = b1 * slots["moment1"] + (1 - b1) * g32
-        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g32)
+        m0, v0 = self._moments_fp32(slots)
+        m = b1 * m0 + (1 - b1) * g32
+        v = b2 * v0 + (1 - b2) * jnp.square(g32)
         stepf = jnp.asarray(step, jnp.float32)
         mhat = m / (1 - b1 ** stepf)
         vhat = v / (1 - b2 ** stepf)
         upd = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
         return (p.astype(jnp.float32) - upd).astype(p.dtype), \
-            {"moment1": m, "moment2": v}
+            self._store_moments(m, v)
 
 
 class AdamW(Adam):
@@ -152,9 +167,10 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, name=None):
+                 multi_precision=False, name=None, moment_dtype="float32"):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, lazy_mode, multi_precision, name=name)
+                         None, grad_clip, lazy_mode, multi_precision, name=name,
+                         moment_dtype=moment_dtype)
         self._wd = float(weight_decay) if isinstance(weight_decay, (int, float)) \
             else float(getattr(weight_decay, "_coeff", 0.0))
         self._apply_decay_param_fun = apply_decay_param_fun
@@ -174,8 +190,9 @@ class AdamW(Adam):
     def _update(self, p, g, slots, lr, step, decay_on=True):
         b1, b2 = self._beta1, self._beta2
         g32 = g.astype(jnp.float32)
-        m = b1 * slots["moment1"] + (1 - b1) * g32
-        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g32)
+        m0, v0 = self._moments_fp32(slots)
+        m = b1 * m0 + (1 - b1) * g32
+        v = b2 * v0 + (1 - b2) * jnp.square(g32)
         stepf = jnp.asarray(step, jnp.float32)
         mhat = m / (1 - b1 ** stepf)
         vhat = v / (1 - b2 ** stepf)
@@ -183,7 +200,7 @@ class AdamW(Adam):
         if decay_on and self._wd:
             p32 = p32 * (1 - lr * self._wd)
         upd = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
-        return (p32 - upd).astype(p.dtype), {"moment1": m, "moment2": v}
+        return (p32 - upd).astype(p.dtype), self._store_moments(m, v)
 
     def apply_gradients(self, params, grads, state, lr=None, wd_mask=None):
         if wd_mask is None and self._apply_decay_param_fun is not None:

@@ -75,6 +75,11 @@ def build_kernel_map(coords, spatial, kernel, stride, padding, dilation, subm,
     if ceil_mode:
         numer = numer + stride - 1  # partial edge windows produce outputs
     out_spatial = numer // stride + 1
+    if ceil_mode:
+        # reference clamp: the last window must start inside the input or
+        # its LEFT padding — drop outputs starting in the right-pad region
+        out_spatial = np.where((out_spatial - 1) * stride >= spatial + padding,
+                               out_spatial - 1, out_spatial)
     cand = []
     for off in offsets:
         num = coords[:, 1:] + padding - off * dilation

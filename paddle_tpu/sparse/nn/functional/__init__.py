@@ -65,8 +65,11 @@ _RULEBOOK_CACHE_MAX = 256
 
 def _get_kernel_map(x, kernel, stride, padding, dilation, subm, key=None,
                     ceil_mode=False):
+    nd = len(kernel)
+    # the kernel map is channel-independent: key on batch+spatial dims only,
+    # so subm chains that change channel width still hit the propagated cache
     geom = (kernel, stride, padding, dilation, subm, ceil_mode,
-            tuple(x.shape))
+            tuple(x.shape[:1 + nd]))
     if key is not None:
         cached = _RULEBOOK_CACHE.get((key, geom))
         if cached is not None and cached[0] is x.indices:
@@ -76,15 +79,19 @@ def _get_kernel_map(x, kernel, stride, padding, dilation, subm, key=None,
         per_tensor = x._kmap_cache = {}
     entry = per_tensor.get(geom)
     if entry is None:
-        nd = len(kernel)
         coords = _np_coords(x)
         out_coords, out_spatial, pairs = build_kernel_map(
             coords, x.shape[1:1 + nd], kernel, stride, padding, dilation,
             subm, ceil_mode)
-        pairs_dev = tuple((jnp.asarray(i), jnp.asarray(j)) for i, j in pairs
-                          if len(i) > 0)
-        live = tuple(k for k, (i, j) in enumerate(pairs) if len(i) > 0)
-        entry = (out_coords, out_spatial, pairs, pairs_dev, live)
+        entry = {
+            "out_coords": out_coords,
+            "out_spatial": out_spatial,
+            "pairs": pairs,
+            "pairs_dev": tuple((jnp.asarray(i), jnp.asarray(j))
+                               for i, j in pairs if len(i) > 0),
+            "live": tuple(k for k, (i, j) in enumerate(pairs)
+                          if len(i) > 0),
+        }
         per_tensor[geom] = entry
     if key is not None:
         while len(_RULEBOOK_CACHE) >= _RULEBOOK_CACHE_MAX:
@@ -106,8 +113,10 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, subm, nd, name,
     cin, cout = int(w_data.shape[nd]), int(w_data.shape[nd + 1])
     assert x.shape[1 + nd] == cin, (x.shape, w_data.shape)
 
-    out_coords, out_spatial, _pairs, pairs_dev, live = _get_kernel_map(
-        x, kernel, tup(stride), tup(padding), tup(dilation), subm, key=key)
+    entry = _get_kernel_map(x, kernel, tup(stride), tup(padding),
+                            tup(dilation), subm, key=key)
+    out_coords, out_spatial = entry["out_coords"], entry["out_spatial"]
+    pairs_dev, live = entry["pairs_dev"], entry["live"]
     n_out = out_coords.shape[0]
 
     def compute(values, w, *maybe_bias):
@@ -172,12 +181,16 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
     kernel = tup(kernel_size)
     stride = tup(stride if stride is not None else kernel_size)
-    out_coords, out_spatial, pairs, _pd, _live = _get_kernel_map(
-        x, kernel, stride, tup(padding), tup(1), subm=False,
-        ceil_mode=ceil_mode)
+    entry = _get_kernel_map(x, kernel, stride, tup(padding), tup(1),
+                            subm=False, ceil_mode=ceil_mode)
+    out_coords, out_spatial = entry["out_coords"], entry["out_spatial"]
     n_out = out_coords.shape[0]
-    in_cat = jnp.asarray(np.concatenate([i for i, _ in pairs]))
-    out_cat = jnp.asarray(np.concatenate([j for _, j in pairs]))
+    if "pool_cat" not in entry:
+        pairs = entry["pairs"]
+        entry["pool_cat"] = (
+            jnp.asarray(np.concatenate([i for i, _ in pairs])),
+            jnp.asarray(np.concatenate([j for _, j in pairs])))
+    in_cat, out_cat = entry["pool_cat"]
 
     def compute(values):
         return jax.ops.segment_max(values[in_cat], out_cat,

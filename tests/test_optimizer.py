@@ -180,3 +180,37 @@ class TestMetric:
         correct = m.compute(pred, label)
         m.update(correct)
         assert abs(m.accumulate() - 0.5) < 1e-6
+
+
+class TestMomentDtype:
+    """moment_dtype='bfloat16' halves Adam state HBM (the single-chip analog
+    of ZeRO moment sharding); update math stays fp32."""
+
+    def test_slots_stored_reduced_functional(self):
+        import jax.numpy as jnp
+        opt = paddle.optimizer.AdamW(1e-3, moment_dtype="bfloat16")
+        params = {"w": jnp.ones((4, 4), jnp.bfloat16)}
+        state = opt.init_state(params)
+        assert state["slots"]["w"]["moment1"].dtype == jnp.bfloat16
+        grads = {"w": jnp.full((4, 4), 0.1, jnp.bfloat16)}
+        new_p, new_state = opt.apply_gradients(params, grads, state, 1e-3)
+        assert new_state["slots"]["w"]["moment2"].dtype == jnp.bfloat16
+        assert new_p["w"].dtype == jnp.bfloat16
+
+    def test_converges_close_to_fp32_moments(self):
+        import jax, jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.normal(size=(64, 8)).astype("float32"))
+        yt = X @ jnp.asarray(rng.normal(size=(8, 1)).astype("float32"))
+        finals = {}
+        for md in ("float32", "bfloat16"):
+            opt = paddle.optimizer.Adam(5e-2, moment_dtype=md)
+            params = {"w": jnp.zeros((8, 1), jnp.float32)}
+            state = opt.init_state(params)
+            for _ in range(400):
+                loss, g = jax.value_and_grad(
+                    lambda p: ((X @ p["w"] - yt) ** 2).mean())(params)
+                params, state = opt.apply_gradients(params, g, state, 5e-2)
+            finals[md] = float(loss)
+        assert finals["bfloat16"] < 1e-2
+        assert abs(finals["bfloat16"] - finals["float32"]) < 5e-3
