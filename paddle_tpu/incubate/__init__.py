@@ -5,10 +5,14 @@
   (XLA compiler fusion; pallas flash attention on TPU)
 - ``autograd``: functional jvp/vjp/Jacobian/Hessian (jax transforms)
 - ``optimizer``: LookAhead, ModelAverage wrappers
+- ``asp``: n:m automatic structured pruning + mask maintenance
+- ``autotune``: kernel/layout/dataloader auto-tuning config
 """
 from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,211 @@
+"""Automatic Structured Pruning — n:m sparsity (ref: python/paddle/incubate/
+asp/asp.py, asp/utils.py).
+
+n:m pattern = at least n ZEROS in every 1xm (or mxm) block, pruned by
+magnitude. The reference maintains masks so cuSPARSELt can use the A100
+2:4 sparse tensor cores; the TPU MXU has no structured-sparse datapath, so
+here the value is model compression + training-under-mask parity: masks
+are computed with vectorized jnp (grouped top-k, no python-per-row loops),
+weights stay dense-with-zeros, and `decorate(optimizer)` re-applies masks
+after every update so sparsity survives training.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor
+
+# param name -> mask (jnp array); and excluded param-name set
+_MASKS = {}
+_EXCLUDED = set()
+
+
+def _rank_in_group(mat_abs):
+    """rank (0 = smallest) of each element within its last-axis group."""
+    order = jnp.argsort(mat_abs, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    return ranks
+
+
+def get_mask_1d(mat, n, m):
+    """n:m zeros per 1xm block along rows, smallest-|.| pruned
+    (ref asp/utils.py get_mask_1d — numpy row loop there; grouped
+    argsort-of-argsort here, one fused XLA program)."""
+    arr = jnp.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    rows, cols = arr.shape[-2], arr.shape[-1]
+    pad = (-cols) % m
+    padded = jnp.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(0, pad)])
+    grouped = padded.reshape(padded.shape[:-1] + ((cols + pad) // m, m))
+    ranks = _rank_in_group(jnp.abs(grouped))
+    mask = (ranks >= n).astype(arr.dtype)
+    mask = mask.reshape(padded.shape)[..., :cols]
+    return mask
+
+
+def check_mask_1d(mat, n, m):
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    cols = arr.shape[-1]
+    pad = (-cols) % m
+    padded = np.pad(arr.reshape(-1, cols), [(0, 0), (0, pad)],
+                    constant_values=0)
+    grouped = padded.reshape(padded.shape[0], -1, m)
+    zeros = (grouped == 0).sum(axis=-1)
+    # padding counts as zeros, matching the reference's padded check
+    return bool((zeros >= min(n, m)).all())
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """mxm blocks with >= n zeros per row AND column, greedy by magnitude
+    (ref asp/utils.py get_mask_2d_greedy)."""
+    arr = np.asarray(
+        (mat._data if isinstance(mat, Tensor) else mat), dtype=np.float32)
+    rows, cols = arr.shape
+    pr, pc = (-rows) % m, (-cols) % m
+    padded = np.pad(np.abs(arr), [(0, pr), (0, pc)])
+    mask = np.zeros_like(padded)
+    keep = m - n  # values kept per row/col of each block
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = padded[bi:bi + m, bj:bj + m]
+            order = np.argsort(block, axis=None)[::-1]
+            row_budget = np.full(m, keep)
+            col_budget = np.full(m, keep)
+            bm = mask[bi:bi + m, bj:bj + m]
+            for flat in order:
+                r, c = divmod(int(flat), m)
+                if row_budget[r] > 0 and col_budget[c] > 0:
+                    bm[r, c] = 1
+                    row_budget[r] -= 1
+                    col_budget[c] -= 1
+    return jnp.asarray(mask[:rows, :cols], dtype=jnp.asarray(arr).dtype)
+
+
+def get_mask_2d_best(mat, n, m):
+    """Exhaustive per-block search over valid per-row keep-patterns
+    (the reference's precomputed valid-pattern bank, ref asp/utils.py
+    get_mask_2d_best): maximizes kept magnitude under the 2D n:m
+    constraint. Falls back to greedy for m > 4 (pattern count explodes)."""
+    arr = np.asarray(
+        (mat._data if isinstance(mat, Tensor) else mat), dtype=np.float32)
+    if m > 4:
+        return get_mask_2d_greedy(arr, n, m)
+    import itertools
+    keep = m - n
+    row_patterns = []
+    for kept_cols in itertools.combinations(range(m), keep):
+        pat = np.zeros(m, np.float32)
+        pat[list(kept_cols)] = 1
+        row_patterns.append(pat)
+    row_patterns = np.stack(row_patterns)           # [P, m]
+    combos = list(itertools.product(range(len(row_patterns)), repeat=m))
+    combo_masks = np.stack([row_patterns[list(c)] for c in combos])  # [C,m,m]
+    valid = (combo_masks.sum(axis=1) <= keep).all(axis=1)  # col budget
+    combo_masks = combo_masks[valid]
+    rows, cols = arr.shape
+    pr, pc = (-rows) % m, (-cols) % m
+    padded = np.pad(np.abs(arr), [(0, pr), (0, pc)])
+    mask = np.zeros_like(padded)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = padded[bi:bi + m, bj:bj + m]
+            scores = (combo_masks * block[None]).sum(axis=(1, 2))
+            mask[bi:bi + m, bj:bj + m] = combo_masks[int(np.argmax(scores))]
+    return jnp.asarray(mask[:rows, :cols], dtype=jnp.asarray(arr).dtype)
+
+
+def check_mask_2d(mat, n, m):
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    rows, cols = arr.shape
+    pr, pc = (-rows) % m, (-cols) % m
+    padded = np.pad(arr, [(0, pr), (0, pc)])
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = padded[bi:bi + m, bj:bj + m]
+            if ((block != 0).sum(axis=0) > m - n).any() or \
+                    ((block != 0).sum(axis=1) > m - n).any():
+                return False
+    return True
+
+
+MaskAlgo = {"mask_1d": get_mask_1d, "mask_2d_greedy": get_mask_2d_greedy,
+            "mask_2d_best": get_mask_2d_best}
+CheckMethod = {"mask_1d": check_mask_1d, "mask_2d_greedy": check_mask_2d,
+               "mask_2d_best": check_mask_2d}
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Exclude params (by name prefix) from pruning (ref asp.py:40)."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(name, p):
+    # prefix (dotted-path component) or exact match — substring matching
+    # would over-exclude ('fc1' must not exclude 'fc10.weight')
+    if any(name == e or name.startswith(e + ".") or p.name == e
+           for e in _EXCLUDED):
+        return False
+    if p.ndim < 2:
+        return False
+    return "weight" in name or name.endswith("_w")
+
+
+def _as_2d(arr):
+    return arr.reshape(arr.shape[0], -1)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune supported weights in place to the n:m pattern; register masks
+    for maintenance under training (ref asp.py prune_model)."""
+    algo = MaskAlgo[mask_algo]
+    pruned = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        w2 = _as_2d(p._data)
+        mask = jnp.asarray(algo(w2, n, m), dtype=p._data.dtype)
+        p._data = (w2 * mask).reshape(p._data.shape)
+        if with_mask:
+            # keyed by both the dotted path and the Parameter's own name
+            # (Tensor has __slots__, so the mask cannot live on the object)
+            _MASKS[name] = mask.reshape(p._data.shape)
+            if p.name:
+                _MASKS[p.name] = _MASKS[name]
+        pruned[name] = float((np.asarray(mask) == 0).mean())
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so masks are re-applied after every step
+    (ref asp.py decorate -> OptimizerWithSparsityGuarantee)."""
+
+    class OptimizerWithSparsityGuarantee:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+        def step(self):
+            self._inner.step()
+            for p in (self._inner._parameter_list or []):
+                mask = _MASKS.get(p.name)
+                if mask is not None and mask.shape == p._data.shape:
+                    p._data = p._data * mask
+
+        def apply_gradients(self, params, grads, state, lr=None, **kw):
+            new_params, new_state = self._inner.apply_gradients(
+                params, grads, state, lr, **kw)
+            for name, mask in _MASKS.items():
+                # shape guard: _MASKS is process-global, and a same-named
+                # param of a DIFFERENT (un-pruned) model must not be masked
+                if name in new_params and \
+                        new_params[name].shape == mask.shape:
+                    new_params[name] = new_params[name] * mask
+            return new_params, new_state
+
+    return OptimizerWithSparsityGuarantee(optimizer)

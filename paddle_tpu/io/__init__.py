@@ -52,7 +52,8 @@ class DataLoader:
                  worker_init_fn=None, persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
-        self.num_workers = num_workers
+        from ..incubate.autotune import dataloader_num_workers
+        self.num_workers = dataloader_num_workers(num_workers)
         self.prefetch_factor = max(prefetch_factor, 2)
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:

@@ -120,7 +120,18 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
             x, NamedSharding(mesh, P("dp", None, None)))
     block = gpt_block_fn(config)
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    from ..distributed.recompute import POLICIES
+    pol_name = getattr(config, "remat_policy", "full") or "full"
+    if pol_name not in POLICIES:
+        raise ValueError(f"unknown remat_policy {pol_name!r}; "
+                         f"choose from {sorted(POLICIES)}")
     if pp > 1:
+        if pol_name != "full":
+            import warnings
+            warnings.warn(
+                f"remat_policy={pol_name!r} is not applied under pipeline "
+                "parallelism: the pp schedules recompute per-tick (1f1b "
+                "checkpoints stage inputs); only 'full' semantics apply")
         # NOTE: no per-block remat inside the pipelined region — the GPipe scan
         # already recomputes per-tick; remat's constant residuals break the
         # shard_map vma typing of the reverse scan. The 1f1b schedule has its
@@ -134,11 +145,6 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
                          vpp_stage_major=getattr(config, "vpp_stage_major",
                                                  False))
     else:
-        from ..distributed.recompute import POLICIES
-        pol_name = getattr(config, "remat_policy", "full") or "full"
-        if pol_name not in POLICIES:
-            raise ValueError(f"unknown remat_policy {pol_name!r}; "
-                             f"choose from {sorted(POLICIES)}")
         ck_block = jax.checkpoint(block, policy=POLICIES[pol_name])
 
         def scan_body(h, layer_params):

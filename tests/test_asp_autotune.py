@@ -1,0 +1,106 @@
+"""incubate.asp (n:m structured sparsity) + incubate.autotune
+(ref: python/paddle/incubate/asp/asp.py, incubate/autotune.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import asp, autotune
+
+
+class TestMasks:
+    def test_mask_1d_reference_example(self):
+        # the reference docstring example (asp/utils.py get_mask_1d)
+        mat = np.array([[0, 1, 5, 4], [2, 7, 3, 6]], np.float32)
+        mask = np.asarray(asp.get_mask_1d(mat, 2, 4))
+        np.testing.assert_array_equal(mask, [[0, 0, 1, 1], [0, 1, 0, 1]])
+        assert asp.check_mask_1d(mat * mask, 2, 4)
+
+    def test_mask_1d_non_multiple_cols(self):
+        rng = np.random.default_rng(0)
+        mat = rng.normal(size=(3, 6)).astype(np.float32)
+        mask = np.asarray(asp.get_mask_1d(mat, 2, 4))
+        assert mask.shape == mat.shape
+        assert asp.check_mask_1d(mat * mask, 2, 4)
+
+    def test_mask_2d_greedy_constraints(self):
+        rng = np.random.default_rng(1)
+        mat = rng.normal(size=(8, 8)).astype(np.float32)
+        mask = np.asarray(asp.get_mask_2d_greedy(mat, 2, 4))
+        assert asp.check_mask_2d(mat * mask, 2, 4)
+        # 2:4 over rows and cols -> at most half survive; greedy may
+        # under-fill a block when remaining budgets conflict
+        assert mat.size // 4 <= mask.sum() <= mat.size // 2
+
+    def test_mask_2d_best_not_worse_than_greedy(self):
+        rng = np.random.default_rng(2)
+        mat = rng.normal(size=(8, 8)).astype(np.float32)
+        g = np.abs(mat * np.asarray(asp.get_mask_2d_greedy(mat, 2, 4))).sum()
+        b = np.abs(mat * np.asarray(asp.get_mask_2d_best(mat, 2, 4))).sum()
+        assert b >= g - 1e-5
+        assert asp.check_mask_2d(
+            mat * np.asarray(asp.get_mask_2d_best(mat, 2, 4)), 2, 4)
+
+
+class TestPruneModel:
+    def _model(self):
+        paddle.seed(0)
+        return paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+            paddle.nn.Linear(32, 8))
+
+    def test_prune_applies_and_registers(self):
+        asp.reset_excluded_layers()
+        net = self._model()
+        pruned = asp.prune_model(net, n=2, m=4)
+        assert pruned, "no layers pruned"
+        for _name, p in net.named_parameters():
+            if p.ndim == 2:
+                assert asp.check_mask_1d(np.asarray(p.numpy()), 2, 4)
+
+    def test_excluded_layers_skipped(self):
+        asp.reset_excluded_layers()
+        net = self._model()
+        names = [n for n, p in net.named_parameters() if p.ndim == 2]
+        asp.set_excluded_layers([names[0]])
+        pruned = asp.prune_model(net, n=2, m=4)
+        assert names[0] not in pruned
+        asp.reset_excluded_layers()
+
+    def test_decorate_maintains_sparsity_under_training(self):
+        asp.reset_excluded_layers()
+        net = self._model()
+        asp.prune_model(net, n=2, m=4)
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()))
+        rng = np.random.default_rng(3)
+        X = paddle.to_tensor(rng.normal(size=(8, 16)).astype("float32"))
+        y = paddle.to_tensor(rng.normal(size=(8, 8)).astype("float32"))
+        loss_fn = paddle.nn.MSELoss()
+        for _ in range(3):
+            opt.clear_grad()
+            loss = loss_fn(net(X), y)
+            loss.backward()
+            opt.step()
+        for _name, p in net.named_parameters():
+            if asp._MASKS.get(p.name) is not None:
+                assert asp.check_mask_1d(np.asarray(p.numpy()), 2, 4)
+
+
+class TestAutotune:
+    def test_set_config_dict_and_get(self):
+        autotune.set_config({"dataloader": {"enable": True},
+                             "kernel": {"enable": False}})
+        cfg = autotune.get_config()
+        assert cfg["dataloader"]["enable"] is True
+        assert cfg["kernel"]["enable"] is False
+
+    def test_unknown_section_warns(self):
+        with pytest.warns(UserWarning):
+            autotune.set_config({"bogus": {"enable": True}})
+
+    def test_dataloader_num_workers(self):
+        autotune.set_config({"dataloader": {"enable": False}})
+        assert autotune.dataloader_num_workers(0) == 0
+        autotune.set_config({"dataloader": {"enable": True}})
+        assert autotune.dataloader_num_workers(0) >= 1
+        autotune.set_config({"dataloader": {"enable": False}})
