@@ -98,6 +98,23 @@ def run(model_name, batch, seq, steps=10, warmup=2, use_flash=True):
     peak = peak_flops_bf16(getattr(dev, "device_kind", "unknown"))
     mfu = tokens_per_sec * fpt / peak
     attn = "pallas" if cfg.use_flash else "blockwise"
+    # step-time breakdown: time the forward alone (shares param buffers),
+    # the remainder is backward(+remat recompute)+optimizer
+    breakdown = None
+    if on_tpu and os.environ.get("BENCH_BREAKDOWN", "1") != "0":
+        try:
+            _log("breakdown: forward-only timing...")
+            l = step.loss_only(ids)
+            jax.device_get(l)
+            t0 = time.perf_counter()
+            for _ in range(max(steps // 2, 3)):
+                l = step.loss_only(ids)
+            jax.device_get(l)
+            fwd_s = (time.perf_counter() - t0) / max(steps // 2, 3)
+            breakdown = {"fwd_s": round(fwd_s, 4),
+                         "bwd_opt_s": round(dt - fwd_s, 4)}
+        except Exception as e:  # noqa: BLE001 — breakdown is best-effort
+            _log(f"breakdown probe failed: {str(e)[:120]}")
     return {
         "metric": f"GPT pretrain tokens/sec/chip ({model_name}, seq={seq}, "
                   f"bs={batch}, bf16+remat+attn={attn}, 1 chip)",
@@ -111,6 +128,7 @@ def run(model_name, batch, seq, steps=10, warmup=2, use_flash=True):
         "attention": attn,
         "device": getattr(dev, "device_kind", str(dev)),
         "peak_flops_assumed": peak,
+        **({"breakdown": breakdown} if breakdown else {}),
     }
 
 

@@ -293,6 +293,27 @@ class HybridTrainStep:
         self._step_count += 1
         return loss
 
+    def loss_only(self, ids):
+        """Forward-only loss on the CURRENT params (no grads, no update) —
+        the bench's step-time-breakdown probe. Shares the live param
+        buffers; only activation workspace is added."""
+        if not hasattr(self, "_fwd_jitted"):
+            config, mesh, M = self.config, self.mesh, self.num_microbatches
+            unflat = self._unflat
+            mp = mesh.shape.get("mp", 1) if mesh is not None else 1
+
+            def fwd(fp, ids):
+                p = unflat(fp)
+                if mp == 1:
+                    from ..ops.fused_ce import fused_lm_loss
+                    hidden = gpt_hidden(p, ids, config, mesh, M)
+                    return fused_lm_loss(
+                        hidden, p["head_w"].astype(hidden.dtype), ids)
+                return _lm_loss(gpt_forward(p, ids, config, mesh, M), ids)
+
+            self._fwd_jitted = jax.jit(fwd)
+        return self._fwd_jitted(self._flat(self.params), jnp.asarray(ids))
+
     def num_params(self):
         return int(sum(np.prod(l.shape) for l in
                        jax.tree_util.tree_leaves(self.params)))
