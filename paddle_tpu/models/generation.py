@@ -1,0 +1,186 @@
+"""Autoregressive generation for the GPT family (capability parity with the
+reference ecosystem's `model.generate`, ref PaddleNLP-class usage of
+python/paddle — greedy/top-k/top-p sampling over a KV cache).
+
+TPU-native design: ONE jitted XLA program runs prefill + the whole decode
+loop (`lax.scan` over positions, static shapes, preallocated KV cache with
+`dynamic_update_slice`). The eager alternative — one dispatch per token —
+would pay a host->device round trip per step; here the host sees a single
+call per generation.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .gpt import ln_fp32
+
+
+def _layer_cached(p, h, kc, vc, start, nh, eps):
+    """One transformer block over h [B,T,H] with KV cache [B,Smax,nh,d].
+    Positions [start, start+T) are written; attention keys are the cache
+    prefix up to start+T (mask below). Mirrors gpt_block_fn math
+    (models/gpt.py) plus cache read/write."""
+    B, T, H = h.shape
+    d = H // nh
+
+    def ln(x, g, b):
+        return ln_fp32(x, g, b, eps)
+
+    h1 = ln(h, p["ln1_g"], p["ln1_b"])
+    qkv = h1 @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+    q, k, v = jnp.split(qkv.reshape(B, T, 3, nh, d), 3, axis=2)
+    q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, start, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, start, 0, 0))
+    Smax = kc.shape[1]
+    # causal mask in absolute positions: query t attends keys <= start+t
+    key_pos = jnp.arange(Smax)[None, :]
+    q_pos = start + jnp.arange(T)[:, None]
+    mask = key_pos <= q_pos                                   # [T, Smax]
+    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        kc.astype(jnp.float32)) / (d ** 0.5)
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhts,bshd->bthd", probs,
+                     vc.astype(jnp.float32)).astype(h.dtype)
+    attn = ctx.reshape(B, T, H) @ p["out_w"].astype(h.dtype) + \
+        p["out_b"].astype(h.dtype)
+    h = h + attn
+    h2 = ln(h, p["ln2_g"], p["ln2_b"])
+    up = h2 @ p["up_w"].astype(h.dtype) + p["up_b"].astype(h.dtype)
+    up = jax.nn.gelu(up, approximate=True)
+    return h + up @ p["down_w"].astype(h.dtype) + p["down_b"].astype(h.dtype), \
+        kc, vc
+
+
+def _forward_cached(params, config, ids, kc, vc, start):
+    """ids [B,T] at absolute positions [start, start+T); returns logits of
+    the LAST position [B,V] and the updated cache."""
+    compute = jnp.dtype(config.compute_dtype or "float32")
+    B, T = ids.shape
+    pos = start + jnp.arange(T)
+    x = params["wte"].astype(compute)[ids] + \
+        jnp.take(params["wpe"].astype(compute), pos, axis=0)[None]
+    nh = config.num_heads
+
+    def layer_fn(h, xs):
+        p_l, kc_l, vc_l = xs
+        h, kc_l, vc_l = _layer_cached(p_l, h, kc_l, vc_l, start, nh,
+                                      config.layer_norm_epsilon)
+        return h, (kc_l, vc_l)
+
+    x, (kc, vc) = jax.lax.scan(layer_fn, x, (params["blocks"], kc, vc))
+    xf = x[:, -1].astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    xn = (xf - mu) * jax.lax.rsqrt(var + config.layer_norm_epsilon)
+    xn = xn * params["lnf_g"].astype(jnp.float32) + \
+        params["lnf_b"].astype(jnp.float32)
+    logits = xn @ params["head_w"].astype(jnp.float32)
+    return logits, kc, vc
+
+
+def _select_token(logits, key, do_sample, temperature, top_k, top_p):
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_p      # always keeps the top token
+        inv = jnp.argsort(sort_idx, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+# cfg is a hashable static tuple (nh, L, H, eps, compute_dtype_str) —
+# GPTConfig itself is a mutable dataclass and cannot key the jit cache
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "do_sample",
+                                   "top_k", "top_p", "eos_token_id"))
+def _generate_jit(params, ids, key, *, cfg, max_new_tokens, do_sample,
+                  temperature, top_k, top_p, eos_token_id):
+    class config:  # minimal view the helpers read
+        num_heads, num_layers, hidden_size, layer_norm_epsilon = cfg[:4]
+        compute_dtype = cfg[4]
+    B, P = ids.shape
+    total = P + max_new_tokens
+    compute = jnp.dtype(config.compute_dtype or "float32")
+    nh = config.num_heads
+    d = config.hidden_size // nh
+    L = config.num_layers
+    kc = jnp.zeros((L, B, total, nh, d), compute)
+    vc = jnp.zeros((L, B, total, nh, d), compute)
+
+    logits, kc, vc = _forward_cached(params, config, ids, kc, vc, 0)
+    key, sub = jax.random.split(key)
+    tok = _select_token(logits, sub, do_sample, temperature, top_k, top_p)
+    finished = jnp.zeros((B,), bool) if eos_token_id is None else \
+        (tok == eos_token_id)
+
+    def step(carry, i):
+        kc, vc, tok, finished, key = carry
+        key, sub = jax.random.split(key)
+        # tok was produced for absolute position P+i; feed it there
+        logits, kc, vc = _forward_cached(params, config, tok[:, None],
+                                         kc, vc, P + i)
+        nxt = _select_token(logits, sub, do_sample, temperature, top_k, top_p)
+        if eos_token_id is not None:
+            nxt = jnp.where(finished, eos_token_id, nxt)
+            finished = finished | (nxt == eos_token_id)
+        return (kc, vc, nxt, finished, key), tok
+
+    (kc, vc, last, finished, key), toks = jax.lax.scan(
+        step, (kc, vc, tok, finished, key),
+        jnp.arange(max_new_tokens - 1), length=max_new_tokens - 1)
+    out = jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, new]
+    return jnp.concatenate([ids, out], axis=1)
+
+
+def generate(model, input_ids, max_new_tokens=32, do_sample=False,
+             temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
+             seed=0):
+    """Generate from a GPTForCausalLM Layer. Collects its weights into the
+    functional layout (models/gpt_hybrid.py init_gpt_params) and runs the
+    single-program decode above."""
+    from ..tensor_impl import Tensor
+    from .gpt import stack_block_params
+    config = model.config
+    ids = jnp.asarray(input_ids._data if isinstance(input_ids, Tensor)
+                      else input_ids, jnp.int32)
+    if max_new_tokens < 1:
+        if max_new_tokens == 0:
+            return Tensor(ids)
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    assert ids.shape[1] + max_new_tokens <= config.max_seq_len, \
+        "prompt + max_new_tokens exceeds config.max_seq_len (wpe table)"
+    gpt = model.gpt
+    head_w = (gpt.wte.weight._data.T if model.lm_head is None
+              else model.lm_head.weight._data)
+    params = {
+        "wte": gpt.wte.weight._data,
+        "wpe": gpt.wpe.weight._data,
+        "lnf_g": gpt.ln_f.weight._data,
+        "lnf_b": gpt.ln_f.bias._data,
+        "head_w": head_w,
+        "blocks": stack_block_params(model),
+    }
+    cfg_key = (config.num_heads, config.num_layers, config.hidden_size,
+               config.layer_norm_epsilon, config.compute_dtype)
+    out = _generate_jit(params, ids, jax.random.key(seed), cfg=cfg_key,
+                        max_new_tokens=int(max_new_tokens),
+                        do_sample=bool(do_sample),
+                        temperature=float(temperature),
+                        top_k=None if top_k in (None, 0)
+                        else min(int(top_k), config.vocab_size),
+                        top_p=None if top_p in (None, 1.0) else float(top_p),
+                        eos_token_id=eos_token_id)
+    return Tensor(out)

@@ -226,6 +226,15 @@ class GPTForCausalLM(Layer):
     def num_params(self):
         return sum(p.size for p in self.parameters())
 
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
+                 seed=0):
+        """Single-XLA-program autoregressive decode with a static KV cache
+        (see models/generation.py)."""
+        from .generation import generate as _generate
+        return _generate(self, input_ids, max_new_tokens, do_sample,
+                         temperature, top_k, top_p, eos_token_id, seed)
+
 
 def gpt_loss_fn(logits, labels):
     V = logits.shape[-1]
@@ -237,16 +246,22 @@ def gpt_loss_fn(logits, labels):
 # ---------------------------------------------------------------------------
 # Pure-pytree block function for the pipeline/scan hybrid path: the same math
 # as GPTBlock.forward over a {name: array} dict with full logical shapes.
+def ln_fp32(x, g, b, eps):
+    """fp32 LayerNorm cast back to x.dtype — shared by the block fn and the
+    KV-cache decode path (models/generation.py)."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g.astype(
+        x.dtype) + b.astype(x.dtype)
+
+
 def gpt_block_fn(config: GPTConfig):
     nh = config.num_heads
     eps = config.layer_norm_epsilon
 
     def ln(x, g, b):
-        xf = x.astype(jnp.float32)
-        mu = jnp.mean(xf, -1, keepdims=True)
-        var = jnp.var(xf, -1, keepdims=True)
-        return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g.astype(
-            x.dtype) + b.astype(x.dtype)
+        return ln_fp32(x, g, b, eps)
 
     def block(p, x):
         B, S, H = x.shape
