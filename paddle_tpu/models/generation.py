@@ -102,23 +102,31 @@ def _select_token(logits, key, do_sample, temperature, top_k, top_p):
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
-# cfg is a hashable static tuple (nh, L, H, eps, compute_dtype_str) —
-# GPTConfig itself is a mutable dataclass and cannot key the jit cache
+def _cfg_view(cfg):
+    """cfg is a hashable static tuple (nh, L, H, eps, compute_dtype_str) —
+    GPTConfig itself is a mutable dataclass and cannot key the jit cache."""
+    class config:  # minimal view the helpers read
+        num_heads, num_layers, hidden_size, layer_norm_epsilon = cfg[:4]
+        compute_dtype = cfg[4]
+    return config
+
+
+def _alloc_cache(config, rows, total):
+    nh = config.num_heads
+    d = config.hidden_size // nh
+    compute = jnp.dtype(config.compute_dtype or "float32")
+    shape = (config.num_layers, rows, total, nh, d)
+    return jnp.zeros(shape, compute), jnp.zeros(shape, compute)
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "do_sample",
                                    "top_k", "top_p", "eos_token_id"))
 def _generate_jit(params, ids, key, *, cfg, max_new_tokens, do_sample,
                   temperature, top_k, top_p, eos_token_id):
-    class config:  # minimal view the helpers read
-        num_heads, num_layers, hidden_size, layer_norm_epsilon = cfg[:4]
-        compute_dtype = cfg[4]
+    config = _cfg_view(cfg)
     B, P = ids.shape
     total = P + max_new_tokens
-    compute = jnp.dtype(config.compute_dtype or "float32")
-    nh = config.num_heads
-    d = config.hidden_size // nh
-    L = config.num_layers
-    kc = jnp.zeros((L, B, total, nh, d), compute)
-    vc = jnp.zeros((L, B, total, nh, d), compute)
+    kc, vc = _alloc_cache(config, B, total)
 
     logits, kc, vc = _forward_cached(params, config, ids, kc, vc, 0)
     key, sub = jax.random.split(key)
@@ -145,9 +153,80 @@ def _generate_jit(params, ids, key, *, cfg, max_new_tokens, do_sample,
     return jnp.concatenate([ids, out], axis=1)
 
 
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "num_beams",
+                                   "eos_token_id"))
+def _beam_search_jit(params, ids, *, cfg, max_new_tokens, num_beams,
+                     length_penalty, eos_token_id):
+    """Beam search in one XLA program (capability: the reference generate's
+    beam_search mode). Beams live in the batch dim ([B*W, ...]); the KV
+    cache is re-gathered along that dim on every beam reorder."""
+    config = _cfg_view(cfg)
+    B, P = ids.shape
+    W = num_beams
+    total = P + max_new_tokens
+    NEG = jnp.float32(-1e9)
+
+    # prefill ONCE per example ([B, P]), then fan the cache out to W beams
+    # (the W beams are identical until the first expansion)
+    kc1, vc1 = _alloc_cache(config, B, total)
+    logits, kc1, vc1 = _forward_cached(params, config, ids, kc1, vc1, 0)
+    kc = jnp.repeat(kc1, W, axis=1)
+    vc = jnp.repeat(vc1, W, axis=1)
+    first = jax.nn.log_softmax(logits, axis=-1)             # [B, V]
+    V = first.shape[-1]
+    scores, tok = jax.lax.top_k(first, W)                   # [B, W]
+    tok = tok.astype(jnp.int32)
+    finished = (tok == eos_token_id) if eos_token_id is not None else \
+        jnp.zeros((B, W), bool)
+    seqs = jnp.zeros((B, W, max_new_tokens), jnp.int32)
+    seqs = seqs.at[:, :, 0].set(tok)
+
+    def step(carry, i):
+        kc, vc, tok, scores, finished, seqs = carry
+        logits, kc, vc = _forward_cached(params, config,
+                                         tok.reshape(B * W)[:, None],
+                                         kc, vc, P + i)
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, W, V)
+        # finished beams extend only with eos at unchanged score
+        if eos_token_id is not None:
+            frozen = jnp.full((V,), NEG).at[eos_token_id].set(0.0)
+            logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
+        cand = scores[:, :, None] + logp                    # [B, W, V]
+        scores, idx = jax.lax.top_k(cand.reshape(B, W * V), W)
+        beam = (idx // V).astype(jnp.int32)                 # [B, W]
+        tok = (idx % V).astype(jnp.int32)
+        # reorder beam state (incl. KV cache) along the B*W dim
+        gidx = (jnp.arange(B)[:, None] * W + beam).reshape(B * W)
+        kc = jnp.take(kc, gidx, axis=1)
+        vc = jnp.take(vc, gidx, axis=1)
+        seqs = jnp.take_along_axis(seqs, beam[:, :, None], axis=1)
+        finished = jnp.take_along_axis(finished, beam, axis=1)
+        if eos_token_id is not None:
+            finished = finished | (tok == eos_token_id)
+        seqs = seqs.at[:, :, i + 1].set(tok)
+        return (kc, vc, tok, scores, finished, seqs), None
+
+    (kc, vc, tok, scores, finished, seqs), _ = jax.lax.scan(
+        step, (kc, vc, tok, scores, finished, seqs),
+        jnp.arange(max_new_tokens - 1), length=max_new_tokens - 1)
+    # pick the best beam under the GNMT length penalty
+    if eos_token_id is not None:
+        lengths = jnp.where(
+            finished,
+            1 + jnp.argmax((seqs == eos_token_id).astype(jnp.int32), axis=-1),
+            max_new_tokens).astype(jnp.float32)
+    else:
+        lengths = jnp.full((B, W), float(max_new_tokens))
+    norm = ((5.0 + lengths) / 6.0) ** length_penalty
+    best = jnp.argmax(scores / norm, axis=-1)               # [B]
+    best_seq = jnp.take_along_axis(
+        seqs, best[:, None, None], axis=1)[:, 0]            # [B, new]
+    return jnp.concatenate([ids, best_seq], axis=1)
+
+
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
-             seed=0):
+             seed=0, num_beams=1, length_penalty=1.0):
     """Generate from a GPTForCausalLM Layer. Collects its weights into the
     functional layout (models/gpt_hybrid.py init_gpt_params) and runs the
     single-program decode above."""
@@ -175,6 +254,16 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     }
     cfg_key = (config.num_heads, config.num_layers, config.hidden_size,
                config.layer_norm_epsilon, config.compute_dtype)
+    if num_beams > 1:
+        if do_sample:
+            raise ValueError("beam search is deterministic; do_sample=True "
+                             "with num_beams > 1 is not supported")
+        out = _beam_search_jit(params, ids, cfg=cfg_key,
+                               max_new_tokens=int(max_new_tokens),
+                               num_beams=int(num_beams),
+                               length_penalty=float(length_penalty),
+                               eos_token_id=eos_token_id)
+        return Tensor(out)
     out = _generate_jit(params, ids, jax.random.key(seed), cfg=cfg_key,
                         max_new_tokens=int(max_new_tokens),
                         do_sample=bool(do_sample),

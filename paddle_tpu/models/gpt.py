@@ -228,12 +228,13 @@ class GPTForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
-                 seed=0):
-        """Single-XLA-program autoregressive decode with a static KV cache
-        (see models/generation.py)."""
+                 seed=0, num_beams=1, length_penalty=1.0):
+        """Single-XLA-program autoregressive decode with a static KV cache;
+        num_beams > 1 switches to beam search (see models/generation.py)."""
         from .generation import generate as _generate
         return _generate(self, input_ids, max_new_tokens, do_sample,
-                         temperature, top_k, top_p, eos_token_id, seed)
+                         temperature, top_k, top_p, eos_token_id, seed,
+                         num_beams, length_penalty)
 
 
 def gpt_loss_fn(logits, labels):

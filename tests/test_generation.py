@@ -75,3 +75,48 @@ def test_top_p_masks_tail():
     for s in range(5):
         tok = _select_token(logits, jax.random.key(s), True, 1.0, None, 0.5)
         assert int(tok[0]) == 0
+
+
+def test_beam1_matches_greedy():
+    model, cfg = _tiny_model()
+    model.eval()
+    prompt = np.array([[3, 14, 15, 92]], np.int64)
+    greedy = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                       max_new_tokens=6).numpy())
+    beam1 = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=6,
+                                      num_beams=1).numpy())
+    np.testing.assert_array_equal(greedy, beam1)
+
+
+def test_beam_score_not_worse_than_greedy():
+    model, cfg = _tiny_model()
+    model.eval()
+    prompt = np.array([[5, 6], [40, 2]], np.int64)
+
+    def seq_logprob(full):
+        """Sum of next-token logprobs for the generated suffix."""
+        logits = model(paddle.to_tensor(full.astype(np.int64))).numpy()
+        lp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
+        s = 0.0
+        for b in range(full.shape[0]):
+            for t in range(prompt.shape[1], full.shape[1]):
+                s += float(lp[b, t - 1, full[b, t]])
+        return s
+
+    g = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                  max_new_tokens=5).numpy())
+    bm = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                   max_new_tokens=5, num_beams=4,
+                                   length_penalty=0.0).numpy())
+    assert bm.shape == g.shape
+    assert seq_logprob(bm) >= seq_logprob(g) - 1e-4
+
+
+def test_beam_rejects_sampling():
+    model, cfg = _tiny_model()
+    prompt = np.array([[1]], np.int64)
+    import pytest
+    with pytest.raises(ValueError):
+        model.generate(paddle.to_tensor(prompt), max_new_tokens=2,
+                       num_beams=2, do_sample=True)
