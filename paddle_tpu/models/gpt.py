@@ -50,6 +50,9 @@ class GPTConfig:
     # MXU outputs resident and recompute only elementwise ops — faster when
     # HBM has headroom
     remat_policy: str = "full"
+    # pallas flash attention tile sizes (the MFU autotune surface)
+    flash_block_q: int = 256
+    flash_block_k: int = 256
     tie_embeddings: bool = False
     # pipeline-parallel schedule: "1f1b" (O(stages) activation residency,
     # ref fleet/meta_parallel/pipeline_parallel.py:230) or "gpipe"
@@ -75,13 +78,14 @@ GPT_CONFIGS = {
 }
 
 
-def _attention(q, k, v, use_flash, causal=True):
+def _attention(q, k, v, use_flash, causal=True, block_q=256, block_k=256):
     """q,k,v arrays [B,S,H,D] -> [B,S,H,D]. Routed by the same logged
     predicate as nn.functional (flash_supported) so gating can't drift."""
     from ..ops.pallas_kernels.flash_attention import flash_supported
     if use_flash and flash_supported(q.shape, kv_seq=k.shape[1], why="gpt"):
         from ..ops.pallas_kernels.flash_attention import flash_attention_bshd
-        return flash_attention_bshd(q, k, v, causal)
+        return flash_attention_bshd(q, k, v, causal,
+                                    block_q=block_q, block_k=block_k)
     return blockwise_attention(q, k, v, causal=causal)
 
 
@@ -270,7 +274,9 @@ def gpt_block_fn(config: GPTConfig):
         h1 = ln(x, p["ln1_g"], p["ln1_b"])
         qkv = h1 @ p["qkv_w"].astype(x.dtype) + p["qkv_b"].astype(x.dtype)
         q, k, v = jnp.split(qkv.reshape(B, S, 3, nh, d), 3, axis=2)
-        ctx = _attention(q[:, :, 0], k[:, :, 0], v[:, :, 0], config.use_flash)
+        ctx = _attention(q[:, :, 0], k[:, :, 0], v[:, :, 0], config.use_flash,
+                         block_q=getattr(config, "flash_block_q", 256),
+                         block_k=getattr(config, "flash_block_k", 256))
         # named residual: remat_policy="save_attn" keeps ctx so the backward
         # pass skips the flash-forward rerun (flash bwd recomputes its own
         # tiles from q/k/v; rerunning fwd for ctx would be pure waste)

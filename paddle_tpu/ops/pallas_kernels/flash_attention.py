@@ -291,23 +291,28 @@ def _pallas_forward(q, k, v, causal, block_q=256, block_k=256,
     return (out, res) if with_residuals else out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 6, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 6, 8, 9, 10))
 def flash_attention_bshd(q, k, v, causal=True, bias=None, segment_ids=None,
-                         dropout_p=0.0, dropout_seed=None, scale=None):
+                         dropout_p=0.0, dropout_seed=None, scale=None,
+                         block_q=256, block_k=256):
     """Differentiable flash attention, [B, S, H, D] layout.
 
     bias and segment_ids participate in the forward and in the recomputed
     backward scores but receive no gradients (masks are constants; the
     reference's flash_attn likewise returns no mask/bias grad).
+    block_q/block_k tile the pallas grid (both clamped to S; must divide
+    it) — the autotuning surface for MFU sweeps.
     """
-    return _pallas_forward(q, k, v, causal, bias=bias,
-                           segment_ids=segment_ids, dropout_p=dropout_p,
-                           dropout_seed=dropout_seed, scale=scale)
+    return _pallas_forward(q, k, v, causal, block_q=block_q, block_k=block_k,
+                           bias=bias, segment_ids=segment_ids,
+                           dropout_p=dropout_p, dropout_seed=dropout_seed,
+                           scale=scale)
 
 
 def _vjp_fwd(q, k, v, causal, bias, segment_ids, dropout_p, dropout_seed,
-             scale):
-    out, res = _pallas_forward(q, k, v, causal, with_residuals=True,
+             scale, block_q, block_k):
+    out, res = _pallas_forward(q, k, v, causal, block_q=block_q,
+                               block_k=block_k, with_residuals=True,
                                bias=bias, segment_ids=segment_ids,
                                dropout_p=dropout_p, dropout_seed=dropout_seed,
                                scale=scale)
@@ -316,7 +321,7 @@ def _vjp_fwd(q, k, v, causal, bias, segment_ids, dropout_p, dropout_seed,
                  jnp.zeros((0,), q.dtype))
 
 
-def _vjp_bwd(causal, dropout_p, _scale_arg, residuals, g):
+def _vjp_bwd(causal, dropout_p, _scale_arg, block_q, block_k, residuals, g):
     ((qb, kb, vb, ob, lse, scale), bias, segment_ids, dropout_seed,
      (B, Sq, H, D0), dt_proto) = residuals
     in_dtype = dt_proto.dtype
@@ -328,7 +333,8 @@ def _vjp_bwd(causal, dropout_p, _scale_arg, residuals, g):
     gb = gb.transpose(0, 2, 1, 3).reshape(B * H, Sq, D).astype(qb.dtype)
     interpret = jax.default_backend() != "tpu"
     dqb, dkb, dvb = flash_attention_backward(
-        qb, kb, vb, ob, lse, gb, scale, causal, interpret=interpret,
+        qb, kb, vb, ob, lse, gb, scale, causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
         bias=bias, segment_ids=segment_ids, num_heads=H,
         dropout_p=dropout_p, dropout_seed=dropout_seed)
 
