@@ -69,6 +69,8 @@ from . import audio  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
 from . import sysconfig  # noqa: E402,F401
+from . import reader  # noqa: E402,F401
+from . import cost_model  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401
 from .hapi import callbacks  # noqa: E402,F401

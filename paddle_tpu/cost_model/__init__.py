@@ -1,0 +1,68 @@
+"""Cost model (ref: python/paddle/cost_model/cost_model.py).
+
+The reference profiles static Programs per-op and ships a benchmark table
+(static_op_benchmark.json). TPU-native: the "program" is a jitted function
+and XLA's compiled cost analysis IS the cost model — `static_cost_data`
+returns the compiler's FLOP/byte estimates, `profile_measure` runs the
+executable and reports measured wall time alongside them.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+class CostModel:
+    def __init__(self):
+        self._analysis = None
+
+    def build_program(self, fn=None, example_args=()):
+        """Register the jittable fn to analyze (the reference builds a demo
+        fc Program when called with no args; we require the real fn)."""
+        if fn is None:
+            raise ValueError("pass the jittable fn to analyze: "
+                             "build_program(fn, example_args)")
+        self._fn = fn
+        self._args = example_args
+        self._lowered = jax.jit(fn).lower(*example_args)
+        return self._lowered
+
+    def static_cost_data(self):
+        """XLA's compile-time cost analysis: flops, bytes accessed,
+        transcendentals (ref static_cost_data, which loads the shipped
+        benchmark json)."""
+        compiled = self._lowered.compile()
+        self._compiled = compiled
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        self._analysis = dict(ca) if ca else {}
+        return self._analysis
+
+    def get_static_op_time(self, op_name=None, forward=True, dtype="float32"):
+        """Per-metric lookup from the cost analysis (the reference keys a
+        benchmark table by op name; XLA reports whole-program metrics)."""
+        if self._analysis is None:
+            self.static_cost_data()
+        if op_name is None:
+            return self._analysis
+        return {k: v for k, v in self._analysis.items() if op_name in k}
+
+    def profile_measure(self, steps=10, warmup=2):
+        """Execute and measure (ref profile_measure runs the Program under
+        the profiler). Returns seconds/step plus the static analysis."""
+        compiled = getattr(self, "_compiled", None) or self._lowered.compile()
+        self._compiled = compiled
+        out = None
+        for _ in range(warmup):
+            out = compiled(*self._args)
+        if out is not None:
+            jax.device_get(jax.tree_util.tree_leaves(out)[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = compiled(*self._args)
+        # device_get, not block_until_ready: remote platforms may not block
+        jax.device_get(jax.tree_util.tree_leaves(out)[0])
+        dt = (time.perf_counter() - t0) / steps
+        return {"time_per_step_s": dt, **(self._analysis or {})}

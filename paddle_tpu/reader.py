@@ -1,0 +1,129 @@
+"""Legacy reader decorators (ref: python/paddle/reader/decorator.py).
+
+Plain generator combinators with no device component; kept for API parity
+with older Paddle training scripts (`paddle.batch` lives in
+framework/extras.py). paddle.io.DataLoader is the modern path.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+
+def cache(reader):
+    """Cache all samples in memory on first epoch (ref decorator.py:45).
+    A partial first epoch (source raised) is discarded, not kept."""
+    all_data = []
+    filled = []
+
+    def cached():
+        if not filled:
+            fresh = list(reader())  # completes or raises — never partial
+            all_data.extend(fresh)
+            filled.append(True)
+        yield from all_data
+    return cached
+
+
+def map_readers(func, *readers):
+    """Yield func(*samples) across readers zipped (ref decorator.py:84)."""
+    def reader():
+        yield from map(func, *[r() for r in readers])
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (ref decorator.py:125)."""
+    def shuffled():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers (ref decorator.py:174)."""
+    def chained():
+        for r in readers:
+            yield from r()
+    return chained
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuple samples (ref decorator.py:238)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed():
+        its = [r() for r in readers]
+        for samples in itertools.zip_longest(*its):
+            if check_alignment and any(s is None for s in samples):
+                raise ValueError("readers have different lengths")
+            yield sum((make_tuple(s) for s in samples), ())
+    return composed
+
+
+def buffered(reader, size):
+    """Background-thread prefetch buffer (ref decorator.py:296)."""
+    end = object()
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+        err = []
+
+        def worker():
+            try:
+                for s in reader():
+                    q.put(s)
+            except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+                err.append(e)
+            finally:
+                q.put(end)  # ALWAYS unblock the consumer
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                if err:
+                    raise err[0]
+                return
+            yield s
+    return buffered_reader
+
+
+def firstn(reader, n):
+    """First n samples (ref decorator.py:358)."""
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Thread-pool map over a reader (ref decorator.py:403 — processes in
+    the reference; threads suffice here because mappers are numpy/jax-bound,
+    not GIL-bound python loops)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def mapped():
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            it = reader()
+            pending = []
+            for s in it:
+                pending.append(pool.submit(mapper, s))
+                if len(pending) >= buffer_size:
+                    yield pending.pop(0).result()
+            for f in pending:
+                yield f.result()
+    return mapped

@@ -38,3 +38,4 @@ class InputSpec:
 
 from .extras import *  # noqa: E402,F401,F403
 from .extras import __all__ as _extras_all  # noqa: E402
+from . import nn  # noqa: E402,F401

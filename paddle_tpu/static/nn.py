@@ -1,0 +1,334 @@
+"""paddle.static.nn — static-graph layer builders (ref: python/paddle/
+static/nn/common.py, control_flow.py, loss.py).
+
+The reference's builders append ops + parameters to the current Program.
+Here a Program is a handle over traced callables (static/extras.py), so
+each builder creates the corresponding dygraph Layer — registered on the
+default Program's state under `name` so a named builder called twice
+reuses its parameters, like re-running a reference block — and applies it.
+Control flow lowers to lax.cond/while_loop under tracing and plain Python
+eagerly. Legacy sequence-LoD ops are out of scope (LoD has no TPU analog;
+use dense padded batches).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, as_tensor_data, wrap
+from .extras import default_main_program
+
+
+def _get_layer(name, factory):
+    # anonymous builders create fresh params each call (reference Program
+    # semantics) and are NOT cached — registering them would leak one layer
+    # per call into Program.state
+    if name is None:
+        return factory()
+    prog = default_main_program()
+    cache = prog.state.setdefault("_static_nn_layers", {})
+    if name not in cache:
+        cache[name] = factory()
+    return cache[name]
+
+
+def _act(x, activation):
+    if activation is None:
+        return x
+    from ..nn import functional as F
+    return getattr(F, activation)(x)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully-connected over flattened trailing dims (ref common.py fc)."""
+    from .. import nn
+    xs = list(as_tensor_data(x).shape)
+    in_dim = int(np.prod(xs[num_flatten_dims:]))
+    layer = _get_layer(name, lambda: nn.Linear(
+        in_dim, size, weight_attr=weight_attr, bias_attr=bias_attr))
+    flat = as_tensor_data(x).reshape(tuple(xs[:num_flatten_dims]) + (in_dim,))
+    return _act(layer(wrap(flat, stop_gradient=False)), activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    from .. import nn
+    layer = _get_layer(name, lambda: nn.Embedding(
+        size[0], size[1], padding_idx=padding_idx, weight_attr=param_attr))
+    return layer(input)
+
+
+sparse_embedding = embedding
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from .. import nn
+    C = as_tensor_data(input).shape[1 if data_layout == "NCHW" else -1]
+    layer = _get_layer(name, lambda: nn.BatchNorm(
+        C, act=None, momentum=momentum, epsilon=epsilon,
+        param_attr=param_attr, bias_attr=bias_attr, data_layout=data_layout))
+    layer.training = not is_test
+    return _act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn
+    C = as_tensor_data(input).shape[1]
+    layer = _get_layer(name, lambda: nn.InstanceNorm2D(
+        C, epsilon=epsilon, weight_attr=param_attr, bias_attr=bias_attr))
+    return layer(input)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from .. import nn
+    C = as_tensor_data(input).shape[1]
+    layer = _get_layer(name, lambda: nn.GroupNorm(
+        groups, C, epsilon=epsilon, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    return _act(layer(input), act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from .. import nn
+    shape = as_tensor_data(input).shape[begin_norm_axis:]
+    layer = _get_layer(name, lambda: nn.LayerNorm(
+        list(shape), epsilon=epsilon,
+        weight_attr=param_attr if scale else False,
+        bias_attr=bias_attr if shift else False))
+    return _act(layer(input), act)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Global-stats normalization without learned affine by default
+    (ref common.py data_norm)."""
+    xd = as_tensor_data(input)
+    mu = jnp.mean(xd, axis=0, keepdims=True)
+    var = jnp.var(xd, axis=0, keepdims=True)
+    return _act(wrap((xd - mu) * jax.lax.rsqrt(var + epsilon)), act)
+
+
+def _conv(layer_cls, input, num_filters, filter_size, stride, padding,
+          dilation, groups, param_attr, bias_attr, act, name, **extra):
+    from .. import nn  # noqa: F401 — layer_cls resolved by caller
+    C = as_tensor_data(input).shape[1]
+    layer = _get_layer(name, lambda: layer_cls(
+        C, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, **extra))
+    return _act(layer(input), act)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    from .. import nn
+    return _conv(nn.Conv2D, input, num_filters, filter_size, stride, padding,
+                 dilation, groups, param_attr, bias_attr, act, name)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+    return _conv(nn.Conv3D, input, num_filters, filter_size, stride, padding,
+                 dilation, groups, param_attr, bias_attr, act, name)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from .. import nn
+    return _conv(nn.Conv2DTranspose, input, num_filters, filter_size, stride,
+                 padding, dilation, groups, param_attr, bias_attr, act, name)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+    return _conv(nn.Conv3DTranspose, input, num_filters, filter_size, stride,
+                 padding, dilation, groups, param_attr, bias_attr, act, name)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import deform_conv2d as _dc
+    from .. import nn
+    C = as_tensor_data(x).shape[1]
+    layer = _get_layer(name, lambda: nn.Conv2D(
+        C, num_filters, filter_size, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    return _dc(x, offset, layer.weight, layer.bias, stride, padding,
+               dilation, deformable_groups, groups, mask)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+    C = as_tensor_data(x).shape[1]
+    num = 1 if mode == "all" else C
+    layer = _get_layer(name, lambda: nn.PReLU(
+        num_parameters=num, weight_attr=param_attr))
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Power-iteration spectral normalization of a weight tensor
+    (ref common.py spectral_norm)."""
+    w = as_tensor_data(weight)
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    u = jnp.ones((mat.shape[0],), jnp.float32)
+    for _ in range(max(power_iters, 1)):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    return wrap(w / sigma)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out_k = x W_k y^T + b (ref common.py bilinear_tensor_product)."""
+    from .. import nn
+    dx = as_tensor_data(x).shape[-1]
+    dy = as_tensor_data(y).shape[-1]
+    layer = _get_layer(name, lambda: nn.Bilinear(
+        dx, dy, size, weight_attr=param_attr, bias_attr=bias_attr))
+    return _act(layer(x, y), act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (ref common.py row_conv): y[t] = sum_{i=0..k}
+    w[i] * x[t+i], per feature channel."""
+    xd = as_tensor_data(input)  # [B, T, D]
+    k = future_context_size + 1
+    D = xd.shape[-1]
+    from ..nn.layer_base import Layer
+
+    class _RowConv(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter([k, D], attr=param_attr)
+
+    # fresh parameters per call, like the reference's per-Program append
+    w = _RowConv().weight._data
+    pad = jnp.pad(xd, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(pad[:, i:i + xd.shape[1]] * w[i] for i in range(k))
+    return _act(wrap(out, stop_gradient=False), act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (ref loss.py nce). The CUDA
+    reference samples negatives to avoid a full-vocab matmul; the TPU MXU
+    eats the full matmul, so negatives are drawn but the math is the
+    standard NCE logistic objective."""
+    from .. import nn
+    D = as_tensor_data(input).shape[-1]
+    k = num_neg_samples or 10
+    layer = _get_layer(name, lambda: nn.Linear(
+        D, num_total_classes, weight_attr=param_attr, bias_attr=bias_attr))
+    logits = as_tensor_data(layer(input))  # [B, V]
+    lab = as_tensor_data(label).reshape(-1).astype(jnp.int32)
+    B = logits.shape[0]
+    if seed:
+        key = jax.random.key(seed)
+    else:  # fresh negatives every call via the framework RNG stream
+        from ..framework.random import next_key
+        key = next_key()
+    neg = jax.random.randint(key, (B, k), 0, num_total_classes)
+    pos_logit = jnp.take_along_axis(logits, lab[:, None], axis=1)
+    neg_logit = jnp.take_along_axis(logits, neg, axis=1)
+    loss = -jax.nn.log_sigmoid(pos_logit) - \
+        jax.nn.log_sigmoid(-neg_logit).sum(axis=1, keepdims=True)
+    return wrap(loss, stop_gradient=False)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op inside a traced program via jax.pure_callback
+    (ref common.py py_func — the honest XLA mapping of a host callback)."""
+    xs = [as_tensor_data(v) for v in (x if isinstance(x, (list, tuple)) else [x])]
+    shape_dtype = jax.ShapeDtypeStruct(
+        tuple(as_tensor_data(out).shape), as_tensor_data(out).dtype)
+    res = jax.pure_callback(lambda *a: np.asarray(func(*a)), shape_dtype, *xs)
+    return wrap(res)
+
+
+# ---- control flow (ref static/nn/control_flow.py): lax under tracing ----
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    p = as_tensor_data(pred)
+    if _is_tracer(p):
+        return jax.lax.cond(jnp.reshape(p, ()), lambda _: true_fn(),
+                            lambda _: false_fn(), None)
+    return true_fn() if bool(np.asarray(jax.device_get(p))) else false_fn()
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        p = as_tensor_data(pred)
+        if _is_tracer(p):
+            raise NotImplementedError(
+                "traced static.nn.case: express as nested cond()")
+        if bool(np.asarray(jax.device_get(p))):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = as_tensor_data(branch_index)
+    fns = dict(branch_fns) if isinstance(branch_fns, (list, tuple)) and \
+        isinstance(branch_fns[0], (list, tuple)) else branch_fns
+    if not isinstance(fns, dict):
+        raise TypeError("branch_fns must be a dict or list of (index, fn)")
+    keys = sorted(fns)
+    fallback = default if default is not None else fns[keys[-1]]
+    if _is_tracer(idx):
+        # map user keys -> positional branches; unmatched keys hit the
+        # trailing fallback branch (reference `default` semantics)
+        flat = jnp.reshape(idx, ())
+        pos = jnp.full((), len(keys), jnp.int32)
+        for j, k in enumerate(keys):
+            pos = jnp.where(flat == k, j, pos)
+        return jax.lax.switch(pos, [fns[k] for k in keys] + [fallback])
+    i = int(np.asarray(jax.device_get(idx)))
+    return fns[i]() if i in fns else fallback()
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    vals = loop_vars
+    first = as_tensor_data(cond_fn(*vals))
+    if _is_tracer(first) or any(_is_tracer(as_tensor_data(v)) for v in vals):
+        return jax.lax.while_loop(
+            lambda vs: jnp.reshape(as_tensor_data(cond_fn(*vs)), ()),
+            lambda vs: tuple(body(*vs)), tuple(vals))
+    while bool(np.asarray(jax.device_get(as_tensor_data(cond_fn(*vals))))):
+        vals = body(*vals)
+        if not isinstance(vals, (list, tuple)):
+            vals = (vals,)
+    return vals

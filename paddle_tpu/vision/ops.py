@@ -681,3 +681,67 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+class RoIAlign:
+    """Layer wrapper over roi_align (ref vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    """Layer wrapper over roi_pool (ref vision/ops.py RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool:
+    """Layer wrapper over psroi_pool (ref vision/ops.py PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+_DEFAULT = object()
+
+
+def ConvNormActivation(in_channels, out_channels, kernel_size=3, stride=1,
+                       padding=None, groups=1, norm_layer=_DEFAULT,
+                       activation_layer=_DEFAULT, dilation=1, bias=None):
+    """Conv2D + norm + activation block (ref vision/ops.py
+    ConvNormActivation). An EXPLICIT norm_layer=None/activation_layer=None
+    omits that stage (the defaults are BatchNorm2D / ReLU)."""
+    from .. import nn
+    if padding is None:
+        padding = (kernel_size - 1) // 2 * dilation
+    if norm_layer is _DEFAULT:
+        norm_layer = nn.BatchNorm2D
+    if activation_layer is _DEFAULT:
+        activation_layer = nn.ReLU
+    if bias is None:
+        bias = norm_layer is None
+    layers = [nn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                        padding, dilation=dilation, groups=groups,
+                        bias_attr=None if bias else False)]
+    if norm_layer is not None:
+        layers.append(norm_layer(out_channels))
+    if activation_layer is not None:
+        layers.append(activation_layer())
+    return nn.Sequential(*layers)

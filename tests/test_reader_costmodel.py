@@ -1,0 +1,80 @@
+"""paddle.reader decorators + cost_model (ref: python/paddle/reader/
+decorator.py, cost_model/cost_model.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader as R
+
+
+def _r10():
+    def r():
+        yield from range(10)
+    return r
+
+
+class TestReader:
+    def test_batch(self):
+        out = list(paddle.batch(_r10(), 3)())
+        assert out == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+        out = list(paddle.batch(_r10(), 3, drop_last=True)())
+        assert out[-1] == [6, 7, 8]
+
+    def test_cache_and_firstn(self):
+        calls = []
+
+        def r():
+            calls.append(1)
+            yield from range(5)
+        c = R.cache(r)
+        assert list(c()) == list(range(5))
+        assert list(c()) == list(range(5))
+        assert len(calls) == 1
+        assert list(R.firstn(_r10(), 3)()) == [0, 1, 2]
+
+    def test_shuffle_preserves_multiset(self):
+        out = list(R.shuffle(_r10(), 4)())
+        assert sorted(out) == list(range(10))
+
+    def test_chain_compose_map(self):
+        assert list(R.chain(_r10(), _r10())()) == list(range(10)) * 2
+        comp = list(R.compose(_r10(), _r10())())
+        assert comp[0] == (0, 0) and len(comp) == 10
+        assert list(R.map_readers(lambda a: a * 2, _r10())()) == \
+            [2 * i for i in range(10)]
+
+    def test_compose_misaligned_raises(self):
+        def r3():
+            yield from range(3)
+        with pytest.raises(ValueError):
+            list(R.compose(_r10(), r3)())
+
+    def test_buffered_and_xmap(self):
+        assert sorted(R.buffered(_r10(), 2)()) == list(range(10))
+        out = list(R.xmap_readers(lambda x: x + 1, _r10(), 2, 4)())
+        assert out == [i + 1 for i in range(10)]
+
+
+class TestCostModel:
+    def test_static_cost_and_measure(self):
+        import jax.numpy as jnp
+        cm = paddle.cost_model.CostModel()
+
+        def f(x, w):
+            return jnp.tanh(x @ w).sum()
+
+        x = jnp.ones((64, 128), jnp.float32)
+        w = jnp.ones((128, 32), jnp.float32)
+        cm.build_program(f, (x, w))
+        data = cm.static_cost_data()
+        assert isinstance(data, dict)
+        if "flops" in data:
+            # 2*64*128*32 matmul flops, compiler may fold some
+            assert data["flops"] > 0
+        res = cm.profile_measure(steps=3, warmup=1)
+        assert res["time_per_step_s"] > 0
+
+    def test_requires_fn(self):
+        cm = paddle.cost_model.CostModel()
+        with pytest.raises(ValueError):
+            cm.build_program()

@@ -1,0 +1,130 @@
+"""paddle.static.nn builders (ref: python/paddle/static/nn/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+def _x(shape, seed=0):
+    return paddle.to_tensor(
+        np.random.default_rng(seed).normal(size=shape).astype("float32"),
+        stop_gradient=False)
+
+
+class TestBuilders:
+    def test_fc_named_reuses_params(self):
+        x = _x((4, 6))
+        a = snn.fc(x, 8, name="shared_fc")
+        b = snn.fc(x, 8, name="shared_fc")
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        c = snn.fc(x, 8)  # anonymous: fresh params
+        assert not np.allclose(a.numpy(), c.numpy())
+
+    def test_fc_flatten_and_activation(self):
+        x = _x((2, 3, 4))
+        out = snn.fc(x, 5, num_flatten_dims=1, activation="relu")
+        assert list(out.shape) == [2, 5]
+        assert (out.numpy() >= 0).all()
+
+    def test_norms(self):
+        x4 = _x((2, 6, 5, 5))
+        assert list(snn.batch_norm(x4).shape) == [2, 6, 5, 5]
+        assert list(snn.instance_norm(x4).shape) == [2, 6, 5, 5]
+        assert list(snn.group_norm(x4, groups=3).shape) == [2, 6, 5, 5]
+        x2 = _x((4, 7))
+        out = snn.layer_norm(x2)
+        np.testing.assert_allclose(out.numpy().mean(-1), 0, atol=1e-5)
+        dn = snn.data_norm(x2)
+        np.testing.assert_allclose(dn.numpy().mean(0), 0, atol=1e-5)
+
+    def test_convs(self):
+        x = _x((2, 3, 8, 8))
+        assert list(snn.conv2d(x, 4, 3, padding=1).shape) == [2, 4, 8, 8]
+        assert list(snn.conv2d_transpose(x, 4, filter_size=2,
+                                         stride=2).shape) == [2, 4, 16, 16]
+        x3 = _x((1, 2, 4, 4, 4))
+        assert list(snn.conv3d(x3, 3, 3, padding=1).shape) == [1, 3, 4, 4, 4]
+
+    def test_embedding_prelu_bilinear(self):
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
+        emb = snn.embedding(ids, size=(10, 6))
+        assert list(emb.shape) == [2, 2, 6]
+        x = _x((3, 5))
+        assert list(snn.prelu(x).shape) == [3, 5]
+        y = _x((3, 4))
+        assert list(snn.bilinear_tensor_product(x, y, 7).shape) == [3, 7]
+
+    def test_spectral_norm_unit_sigma(self):
+        w = _x((6, 4), seed=3)
+        wn = snn.spectral_norm(w, power_iters=20)
+        s = np.linalg.svd(np.asarray(wn.numpy()), compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+    def test_row_conv(self):
+        x = _x((2, 6, 3))
+        out = snn.row_conv(x, future_context_size=2)
+        assert list(out.shape) == [2, 6, 3]
+
+    def test_nce_positive_loss(self):
+        x = _x((4, 8))
+        lab = paddle.to_tensor(np.array([[1], [2], [3], [0]], np.int64))
+        loss = snn.nce(x, lab, num_total_classes=20, num_neg_samples=5)
+        assert (loss.numpy() > 0).all()
+
+
+class TestControlFlow:
+    def test_cond_eager_and_traced(self):
+        import jax
+        import jax.numpy as jnp
+        assert snn.cond(paddle.to_tensor(np.array(True)),
+                        lambda: 1, lambda: 2) == 1
+
+        def f(flag, a):
+            return snn.cond(flag, lambda: a * 2, lambda: a - 1)
+        out = jax.jit(f)(jnp.asarray(True), jnp.asarray(3.0))
+        assert float(out) == 6.0
+
+    def test_while_loop_both_modes(self):
+        import jax
+        import jax.numpy as jnp
+        res = snn.while_loop(lambda i: i < 5, lambda i: (i + 1,),
+                             (np.int32(0),))
+        assert int(res[0]) == 5
+
+        def g(i):
+            return snn.while_loop(lambda i: i < 5, lambda i: (i + 1,), (i,))[0]
+        assert int(jax.jit(g)(jnp.asarray(0))) == 5
+
+    def test_case_switch(self):
+        t = paddle.to_tensor(np.array(True))
+        f = paddle.to_tensor(np.array(False))
+        assert snn.case([(f, lambda: 1), (t, lambda: 2)]) == 2
+        assert snn.switch_case(paddle.to_tensor(np.array(1)),
+                               {0: lambda: "a", 1: lambda: "b"}) == "b"
+
+    def test_py_func(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        out = snn.py_func(lambda a: a * 3, x, out=x)
+        np.testing.assert_allclose(out.numpy(), np.arange(4) * 3)
+
+    def test_switch_case_traced_noncontiguous_keys(self):
+        import jax
+        import jax.numpy as jnp
+
+        def g(i):
+            return snn.switch_case(i, {1: lambda: jnp.asarray(10.0),
+                                       5: lambda: jnp.asarray(50.0)},
+                                   default=lambda: jnp.asarray(-1.0))
+        assert float(jax.jit(g)(jnp.asarray(1))) == 10.0
+        assert float(jax.jit(g)(jnp.asarray(5))) == 50.0
+        assert float(jax.jit(g)(jnp.asarray(3))) == -1.0
+
+    def test_buffered_propagates_errors(self):
+        from paddle_tpu import reader as R
+
+        def bad():
+            yield 1
+            raise IOError("boom")
+        with pytest.raises(IOError):
+            list(R.buffered(bad, 2)())
