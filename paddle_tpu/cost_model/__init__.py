@@ -52,8 +52,9 @@ class CostModel:
     def profile_measure(self, steps=10, warmup=2):
         """Execute and measure (ref profile_measure runs the Program under
         the profiler). Returns seconds/step plus the static analysis."""
-        compiled = getattr(self, "_compiled", None) or self._lowered.compile()
-        self._compiled = compiled
+        if self._analysis is None:
+            self.static_cost_data()
+        compiled = self._compiled
         out = None
         for _ in range(warmup):
             out = compiled(*self._args)

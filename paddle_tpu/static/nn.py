@@ -27,7 +27,11 @@ def _get_layer(name, factory):
     if name is None:
         return factory()
     prog = default_main_program()
-    cache = prog.state.setdefault("_static_nn_layers", {})
+    # cache on a plain attribute, NOT prog.state: state holds persistable
+    # tensors (serialize_persistables/state_dict iterate it)
+    cache = getattr(prog, "_static_nn_layers", None)
+    if cache is None:
+        cache = prog._static_nn_layers = {}
     if name not in cache:
         cache[name] = factory()
     return cache[name]
@@ -74,6 +78,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
         C, act=None, momentum=momentum, epsilon=epsilon,
         param_attr=param_attr, bias_attr=bias_attr, data_layout=data_layout))
     layer.training = not is_test
+    layer._use_global_stats = use_global_stats or None
     return _act(layer(input), act)
 
 
@@ -149,11 +154,30 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                  dilation, groups, param_attr, bias_attr, act, name)
 
 
+def _transpose_filter_size(input, output_size, filter_size, stride, padding,
+                           dilation, nd):
+    """Reference semantics: filter_size may be omitted when output_size is
+    given — derive k from out = (in-1)*stride - 2*pad + dilation*(k-1)+1."""
+    if filter_size is not None:
+        return filter_size
+    if output_size is None:
+        raise ValueError("conv transpose: give filter_size or output_size")
+    tup = lambda v: (v,) * nd if isinstance(v, int) else tuple(v)  # noqa: E731
+    outs, strides = tup(output_size), tup(stride)
+    pads, dils = tup(padding), tup(dilation)
+    spatial = as_tensor_data(input).shape[2:2 + nd]
+    return tuple(
+        (outs[i] - (spatial[i] - 1) * strides[i] + 2 * pads[i] - 1)
+        // dils[i] + 1 for i in range(nd))
+
+
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      padding=0, stride=1, dilation=1, groups=1,
                      param_attr=None, bias_attr=None, use_cudnn=True,
                      act=None, name=None, data_format="NCHW"):
     from .. import nn
+    filter_size = _transpose_filter_size(input, output_size, filter_size,
+                                         stride, padding, dilation, 2)
     return _conv(nn.Conv2DTranspose, input, num_filters, filter_size, stride,
                  padding, dilation, groups, param_attr, bias_attr, act, name)
 
@@ -163,6 +187,8 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                      param_attr=None, bias_attr=None, use_cudnn=True,
                      act=None, name=None, data_format="NCDHW"):
     from .. import nn
+    filter_size = _transpose_filter_size(input, output_size, filter_size,
+                                         stride, padding, dilation, 3)
     return _conv(nn.Conv3DTranspose, input, num_filters, filter_size, stride,
                  padding, dilation, groups, param_attr, bias_attr, act, name)
 
@@ -174,7 +200,7 @@ def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
     from .. import nn
     C = as_tensor_data(x).shape[1]
     layer = _get_layer(name, lambda: nn.Conv2D(
-        C, num_filters, filter_size, weight_attr=param_attr,
+        C, num_filters, filter_size, groups=groups, weight_attr=param_attr,
         bias_attr=bias_attr))
     return _dc(x, offset, layer.weight, layer.bias, stride, padding,
                dilation, deformable_groups, groups, mask)

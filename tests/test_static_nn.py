@@ -128,3 +128,23 @@ class TestControlFlow:
             raise IOError("boom")
         with pytest.raises(IOError):
             list(R.buffered(bad, 2)())
+
+    def test_conv2d_transpose_output_size(self):
+        x = _x((2, 3, 8, 8))
+        out = snn.conv2d_transpose(x, 4, output_size=(16, 16), stride=2)
+        assert list(out.shape) == [2, 4, 16, 16]
+
+    def test_state_dict_unpolluted_by_named_builders(self):
+        from paddle_tpu.static.extras import default_main_program
+        x = _x((2, 6))
+        snn.fc(x, 4, name="sd_probe")
+        for v in default_main_program().state_dict().values():
+            assert not hasattr(v, "forward"), "Layer leaked into state"
+
+    def test_cost_model_profile_direct(self):
+        import jax.numpy as jnp
+        cm = paddle.cost_model.CostModel()
+        cm.build_program(lambda a: (a @ a).sum(), (jnp.ones((32, 32)),))
+        res = cm.profile_measure(steps=2, warmup=0)
+        assert res["time_per_step_s"] > 0
+        assert len(res) > 1  # static analysis merged in
