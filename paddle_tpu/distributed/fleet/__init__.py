@@ -18,6 +18,7 @@ from jax.sharding import PartitionSpec as P
 from .. import env
 from ..env import create_hybrid_mesh, get_mesh
 from . import mp_layers  # noqa: F401
+from . import utils  # noqa: F401
 from .mp_layers import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy,

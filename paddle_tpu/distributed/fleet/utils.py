@@ -1,0 +1,272 @@
+"""fleet.utils (ref: python/paddle/distributed/fleet/utils/__init__.py —
+exports LocalFS, recompute, HDFSClient, DistributedInfer; fs.py for the FS
+classes).
+
+The NCCL-era gradient helpers (hybrid_parallel_util._apply_collective_grads
+etc.) have no analog: GSPMD emits those collectives from sharding
+annotations. The filesystem abstraction and recompute re-export are the
+user-facing surface and live here.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+from ..recompute import recompute  # noqa: F401  (ref utils/__init__.py:31)
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FSShellCmdAborted(ExecuteError):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=False):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem client (ref fs.py LocalFS)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for entry in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, entry)):
+                dirs.append(entry)
+            else:
+                files.append(entry)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        assert not os.path.isfile(fs_path), f"{fs_path} is already a file"
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            os.remove(fs_path)
+        else:
+            shutil.rmtree(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError(fs_src_path)
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        if self.is_exist(fs_dst_path):
+            raise FSFileExistsError(fs_dst_path)
+        os.rename(fs_src_path, fs_dst_path)
+
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return [e for e in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, e))]
+
+    def cat(self, fs_path=None):
+        with open(fs_path, "r") as f:
+            return f.read()
+
+
+class HDFSClient(FS):
+    """Shells out to the hadoop CLI like the reference (ref fs.py
+    HDFSClient). Raises at construction when no hadoop binary exists —
+    TPU hosts typically read from GCS/local instead."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else shutil.which("hadoop")
+        if self._hadoop is None or not os.path.exists(self._hadoop):
+            raise RuntimeError(
+                "HDFSClient needs a hadoop installation (hadoop_home or "
+                "`hadoop` on PATH); none found on this host")
+        self._configs = configs or {}
+        self._time_out = time_out
+        self._sleep_inter = sleep_inter
+
+    def _run(self, *args, retries=2):
+        import time as _time
+        conf = [f"-D{k}={v}" for k, v in self._configs.items()]
+        cmd = [self._hadoop, "fs"] + conf + list(args)
+        last = None
+        for attempt in range(retries + 1):
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=self._time_out / 1000.0)
+            except subprocess.TimeoutExpired as e:
+                raise FSTimeOut(f"{' '.join(cmd)} timed out") from e
+            if proc.returncode == 0:
+                return proc.stdout
+            last = ExecuteError(f"{' '.join(cmd)}: {proc.stderr[:400]}")
+            if attempt < retries:
+                _time.sleep(self._sleep_inter / 1000.0)
+        raise last
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        try:
+            self._run("-test", "-f", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", fs_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=False):
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def need_upload_download(self):
+        return True
+
+    def cat(self, fs_path=None):
+        return self._run("-cat", fs_path)
+
+
+class DistributedInfer:
+    """Parameter-server-era sparse-table inference helper — superseded by
+    sharded SPMD inference on TPU (ref utils/ps_util.py DistributedInfer)."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "DistributedInfer targets parameter-server sparse tables; use "
+            "paddle_tpu.inference (StableHLO artifacts) with a sharded mesh")

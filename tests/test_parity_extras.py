@@ -290,3 +290,34 @@ class TestLBFGS:
         for _ in range(8):
             opt.step(closure)
         np.testing.assert_allclose(_np(x), [1.0, 1.0], atol=1e-3)
+
+
+class TestFleetUtils:
+    def test_localfs_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        fs = LocalFS()
+        d = str(tmp_path / "a")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = str(tmp_path / "a" / "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(str(tmp_path / "a"))
+        assert files == ["x.txt"]
+        fs.mv(f, str(tmp_path / "a" / "y.txt"))
+        assert fs.cat(str(tmp_path / "a" / "y.txt")) == ""
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_recompute_reexport(self):
+        from paddle_tpu.distributed.fleet import utils as fu
+        from paddle_tpu.distributed.recompute import recompute
+        assert fu.recompute is recompute
+
+    def test_hdfs_requires_hadoop(self):
+        import pytest
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+        import shutil as _sh
+        if _sh.which("hadoop") is None:
+            with pytest.raises(RuntimeError):
+                HDFSClient()
