@@ -204,23 +204,24 @@ class HDFSClient(FS):
             (dirs if parts[0].startswith("d") else files).append(name)
         return dirs, files
 
+    # -test's nonzero exit IS the answer — no retries, no sleeps
     def is_exist(self, fs_path):
         try:
-            self._run("-test", "-e", fs_path)
+            self._run("-test", "-e", fs_path, retries=0)
             return True
         except ExecuteError:
             return False
 
     def is_file(self, fs_path):
         try:
-            self._run("-test", "-f", fs_path)
+            self._run("-test", "-f", fs_path, retries=0)
             return True
         except ExecuteError:
             return False
 
     def is_dir(self, fs_path):
         try:
-            self._run("-test", "-d", fs_path)
+            self._run("-test", "-d", fs_path, retries=0)
             return True
         except ExecuteError:
             return False

@@ -134,6 +134,26 @@ CheckMethod = {"mask_1d": check_mask_1d, "mask_2d_greedy": check_mask_2d,
                "mask_2d_best": check_mask_2d}
 
 
+_EXTRA_SUPPORTED = {}  # layer type/name -> optional custom pruning_func
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register an extra layer type/name as prunable, optionally with a
+    custom mask function (mat, n, m) -> mask
+    (ref asp/supported_layer_list.py add_supported_layer). prune_model
+    consults this registry for params whose dotted path contains the
+    registered name."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _EXTRA_SUPPORTED[name] = pruning_func
+
+
+def calculate_density(x):
+    """Fraction of non-zeros (ref asp/utils.py calculate_density)."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float((arr != 0).mean()) if arr.size else 1.0
+
+
 def set_excluded_layers(param_names, main_program=None):
     """Exclude params (by name prefix) from pruning (ref asp.py:40)."""
     _EXCLUDED.update(param_names)
@@ -141,6 +161,16 @@ def set_excluded_layers(param_names, main_program=None):
 
 def reset_excluded_layers(main_program=None):
     _EXCLUDED.clear()
+
+
+def _extra_match(name):
+    """The registered extra-layer name whose component appears in the
+    dotted path, if any."""
+    parts = name.split(".")
+    for extra in _EXTRA_SUPPORTED:
+        if extra in parts or extra.lower() in (s.lower() for s in parts):
+            return extra
+    return None
 
 
 def _prunable(name, p):
@@ -151,7 +181,8 @@ def _prunable(name, p):
         return False
     if p.ndim < 2:
         return False
-    return "weight" in name or name.endswith("_w")
+    return "weight" in name or name.endswith("_w") or \
+        _extra_match(name) is not None
 
 
 def _as_2d(arr):
@@ -166,8 +197,10 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     for name, p in model.named_parameters():
         if not _prunable(name, p):
             continue
+        extra = _extra_match(name)
+        fn = _EXTRA_SUPPORTED.get(extra) if extra else None
         w2 = _as_2d(p._data)
-        mask = jnp.asarray(algo(w2, n, m), dtype=p._data.dtype)
+        mask = jnp.asarray((fn or algo)(w2, n, m), dtype=p._data.dtype)
         p._data = (w2 * mask).reshape(p._data.shape)
         if with_mask:
             # keyed by both the dotted path and the Parameter's own name

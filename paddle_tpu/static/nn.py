@@ -7,8 +7,8 @@ each builder creates the corresponding dygraph Layer — registered on the
 default Program's state under `name` so a named builder called twice
 reuses its parameters, like re-running a reference block — and applies it.
 Control flow lowers to lax.cond/while_loop under tracing and plain Python
-eagerly. Legacy sequence-LoD ops are out of scope (LoD has no TPU analog;
-use dense padded batches).
+eagerly. The sequence ops live in sequence.py as dense-padded analogs of
+the LoD originals (ragged LoD layouts have no TPU tiling).
 """
 from __future__ import annotations
 
@@ -358,3 +358,12 @@ def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
         if not isinstance(vals, (list, tuple)):
             vals = (vals,)
     return vals
+
+
+# sequence ops (dense-padded analogs of the LoD originals — see sequence.py)
+from .sequence import (  # noqa: E402,F401
+    sequence_softmax, sequence_pool, sequence_first_step, sequence_last_step,
+    sequence_reverse, sequence_concat, sequence_slice, sequence_expand,
+    sequence_expand_as, sequence_pad, sequence_unpad, sequence_reshape,
+    sequence_scatter, sequence_enumerate, sequence_conv, StaticRNN,
+)

@@ -1,0 +1,240 @@
+"""Sequence ops (ref: python/paddle/static/nn/sequence_lod.py).
+
+The reference operates on LoD tensors — ragged sequences packed flat with
+level-of-detail offsets, a CPU-era layout XLA cannot tile. The TPU-native
+layout is dense padding: every op here takes `x` as a padded batch
+[B, T, ...] plus an optional `seq_len` [B] of valid lengths (None = all T
+valid). That is also what `sequence_pad`/`sequence_unpad` convert between:
+unpad returns the ragged python list the LoD form represents.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, as_tensor_data, wrap
+from ..dispatch import apply
+
+
+def _data_len(x, seq_len):
+    xd = as_tensor_data(x)
+    B, T = xd.shape[0], xd.shape[1]
+    if seq_len is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    else:
+        lens = jnp.asarray(as_tensor_data(seq_len), jnp.int32).reshape(B)
+    return xd, lens, B, T
+
+
+def _valid_mask(lens, T):
+    return jnp.arange(T)[None, :] < lens[:, None]  # [B, T]
+
+
+def sequence_softmax(x, seq_len=None, name=None):
+    """Softmax over each sequence's valid steps (padding gets 0 weight)."""
+    xd, lens, B, T = _data_len(x, seq_len)
+
+    def f(xv):
+        mask = _valid_mask(lens, T)
+        shaped = mask if xv.ndim == 2 else mask[..., None]
+        logits = jnp.where(shaped, xv, -jnp.inf)
+        out = jax.nn.softmax(logits, axis=1)
+        return jnp.where(shaped, out, 0.0)
+
+    return apply(f, x, op_name="sequence_softmax")
+
+
+def sequence_pool(x, pool_type="average", seq_len=None, pad_value=0.0):
+    """Pool each sequence to one vector: average/sum/max/min/sqrt/first/last
+    (ref sequence_lod.py sequence_pool)."""
+    xd, lens, B, T = _data_len(x, seq_len)
+    pt = pool_type.lower()
+
+    def f(xv):
+        mask = _valid_mask(lens, T)
+        m = mask if xv.ndim == 2 else mask[..., None]
+        cnt = jnp.maximum(lens, 1).astype(xv.dtype)
+        cshape = (B,) + (1,) * (xv.ndim - 2)
+        if pt == "sum":
+            return jnp.where(m, xv, 0).sum(axis=1)
+        if pt in ("average", "mean"):
+            return jnp.where(m, xv, 0).sum(axis=1) / cnt.reshape(cshape)
+        if pt == "sqrt":
+            return jnp.where(m, xv, 0).sum(axis=1) / \
+                jnp.sqrt(cnt).reshape(cshape).astype(xv.dtype)
+        if pt == "max":
+            out = jnp.where(m, xv, -jnp.inf).max(axis=1)
+            return jnp.where(jnp.isneginf(out), pad_value, out)
+        if pt == "min":
+            out = jnp.where(m, xv, jnp.inf).min(axis=1)
+            return jnp.where(jnp.isposinf(out), pad_value, out)
+        if pt == "first":
+            return xv[:, 0]
+        if pt == "last":
+            idx = jnp.maximum(lens - 1, 0)
+            return jnp.take_along_axis(
+                xv, idx.reshape((B,) + (1,) * (xv.ndim - 1)), axis=1)[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    return apply(f, x, op_name=f"sequence_pool_{pt}")
+
+
+def sequence_first_step(x, seq_len=None):
+    return sequence_pool(x, "first", seq_len)
+
+
+def sequence_last_step(x, seq_len=None):
+    return sequence_pool(x, "last", seq_len)
+
+
+def sequence_reverse(x, seq_len=None, name=None):
+    """Reverse each sequence's valid prefix in place; padding stays put."""
+    xd, lens, B, T = _data_len(x, seq_len)
+
+    def f(xv):
+        pos = jnp.arange(T)[None, :]
+        rev = jnp.where(pos < lens[:, None], lens[:, None] - 1 - pos, pos)
+        return jnp.take_along_axis(
+            xv, rev.reshape((B, T) + (1,) * (xv.ndim - 2)), axis=1)
+
+    return apply(f, x, op_name="sequence_reverse")
+
+
+def sequence_concat(input, name=None):
+    """Concatenate sequences element-wise along time (ref sequence_concat):
+    padded analog concatenates along T. Routed through apply so the tape
+    records it."""
+    return apply(lambda *vs: jnp.concatenate(vs, axis=1), *input,
+                 op_name="sequence_concat")
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence slice [offset, offset+length) along time."""
+    xd = as_tensor_data(input)
+    off = jnp.asarray(as_tensor_data(offset), jnp.int32).reshape(-1)
+    ln = np.asarray(jax.device_get(as_tensor_data(length))).reshape(-1)
+    L = int(ln.max())
+    B, T = xd.shape[0], xd.shape[1]
+
+    def f(xv):
+        idx = off[:, None] + jnp.arange(L)[None, :]
+        idx = jnp.clip(idx, 0, T - 1)
+        out = jnp.take_along_axis(
+            xv, idx.reshape((B, L) + (1,) * (xv.ndim - 2)), axis=1)
+        mask = jnp.arange(L)[None, :] < jnp.asarray(ln)[:, None]
+        return jnp.where(mask if xv.ndim == 2 else mask[..., None], out, 0)
+
+    return apply(f, input, op_name="sequence_slice")
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat each row of x to match y's per-sequence lengths
+    (padded analog: tile x rows along a new time axis of y's T)."""
+    xd = as_tensor_data(x)
+    yd = as_tensor_data(y)
+    T = yd.shape[1]
+
+    def f(xv):
+        return jnp.repeat(xv[:, None], T, axis=1) if xv.ndim == 2 else \
+            jnp.broadcast_to(xv[:, None], (xv.shape[0], T) + xv.shape[1:])
+
+    return apply(f, x, op_name="sequence_expand")
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Ragged python list -> (padded [B, maxlen, ...], lengths [B])
+    (ref sequence_pad returns (Out, Length))."""
+    seqs = [np.asarray(jax.device_get(as_tensor_data(s))) for s in x] \
+        if isinstance(x, (list, tuple)) else \
+        [np.asarray(jax.device_get(as_tensor_data(x)))]
+    lens = np.asarray([s.shape[0] for s in seqs], np.int64)
+    T = int(maxlen) if maxlen is not None else int(lens.max())
+    pv = float(np.asarray(jax.device_get(as_tensor_data(pad_value))).reshape(-1)[0])
+    tail = seqs[0].shape[1:]
+    out = np.full((len(seqs), T) + tail, pv, seqs[0].dtype)
+    for i, s in enumerate(seqs):
+        out[i, :min(s.shape[0], T)] = s[:T]
+    return wrap(jnp.asarray(out)), wrap(jnp.asarray(lens))
+
+
+def sequence_unpad(x, length, name=None):
+    """(padded, lengths) -> list of ragged arrays (the LoD content)."""
+    xd = np.asarray(jax.device_get(as_tensor_data(x)))
+    lens = np.asarray(jax.device_get(as_tensor_data(length))).reshape(-1)
+    return [wrap(jnp.asarray(xd[i, :int(l)])) for i, l in enumerate(lens)]
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """Reshape the trailing feature dim, redistributing time steps."""
+    xd = as_tensor_data(input)
+    B = xd.shape[0]
+
+    def f(xv):
+        return xv.reshape(B, -1, new_dim)
+
+    return apply(f, input, op_name="sequence_reshape")
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """Scatter updates into per-sequence time positions."""
+    xd = as_tensor_data(input)
+    B = xd.shape[0]
+
+    def f(xv, upd):
+        idx = jnp.asarray(as_tensor_data(index), jnp.int32).reshape(B, -1)
+        return xv.at[jnp.arange(B)[:, None], idx].add(upd)
+
+    return apply(f, input, updates, op_name="sequence_scatter")
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Sliding windows of ids along time (ref sequence_enumerate)."""
+    xd = as_tensor_data(input)
+    B, T = xd.shape[0], xd.shape[1]
+
+    def f(xv):
+        pad = jnp.full((B, win_size - 1), pad_value, xv.dtype)
+        ext = jnp.concatenate([xv, pad], axis=1)
+        return jnp.stack([ext[:, i:i + T] for i in range(win_size)], axis=-1)
+
+    return apply(f, input, op_name="sequence_enumerate")
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Temporal convolution over padded sequences: window of `filter_size`
+    steps -> Linear (ref sequence_conv's im2col + fc formulation)."""
+    from .. import nn
+    from .nn import _get_layer, _act
+    xd = as_tensor_data(input)
+    B, T, D = xd.shape
+    layer = _get_layer(name, lambda: nn.Linear(
+        D * filter_size, num_filters, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    start = -(filter_size // 2) if padding_start is None else padding_start
+
+    def windows(xv):
+        padded = jnp.pad(xv, ((0, 0), (filter_size, filter_size), (0, 0)))
+        cols = [padded[:, filter_size + start + i:
+                       filter_size + start + i + T] for i in range(filter_size)]
+        return jnp.concatenate(cols, axis=-1)  # [B, T, D*filter_size]
+
+    win = apply(windows, input, op_name="sequence_conv_im2col")
+    return _act(layer(win), act)
+
+
+class StaticRNN:
+    """Legacy static-graph RNN builder (ref fluid/layers StaticRNN) — the
+    lax.scan era replacement is paddle_tpu.nn.RNN; this shim raises with
+    guidance rather than half-working."""
+
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "StaticRNN is the legacy static-graph unroller; use "
+            "paddle_tpu.nn.SimpleRNN/LSTM/GRU (lax.scan) instead")

@@ -148,3 +148,46 @@ class TestControlFlow:
         res = cm.profile_measure(steps=2, warmup=0)
         assert res["time_per_step_s"] > 0
         assert len(res) > 1  # static analysis merged in
+
+
+class TestSequenceOps:
+    def test_softmax_and_pool_respect_lengths(self):
+        x = _x((2, 4, 3))
+        lens = paddle.to_tensor(np.array([2, 4], np.int64))
+        sm = snn.sequence_softmax(x, seq_len=lens).numpy()
+        np.testing.assert_allclose(sm[0, :2].sum(0), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(sm[0, 2:], 0.0)
+        avg = snn.sequence_pool(x, "average", seq_len=lens).numpy()
+        np.testing.assert_allclose(avg[0], x.numpy()[0, :2].mean(0), rtol=1e-5)
+        last = snn.sequence_last_step(x, seq_len=lens).numpy()
+        np.testing.assert_allclose(last[0], x.numpy()[0, 1], rtol=1e-6)
+        np.testing.assert_allclose(last[1], x.numpy()[1, 3], rtol=1e-6)
+
+    def test_reverse_pad_unpad_roundtrip(self):
+        x = _x((2, 5, 2))
+        lens = paddle.to_tensor(np.array([3, 5], np.int64))
+        rev = snn.sequence_reverse(x, seq_len=lens).numpy()
+        np.testing.assert_allclose(rev[0, :3], x.numpy()[0, :3][::-1],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(rev[0, 3:], x.numpy()[0, 3:], rtol=1e-6)
+        ragged = snn.sequence_unpad(x, lens)
+        assert [r.shape[0] for r in ragged] == [3, 5]
+        padded, L = snn.sequence_pad(ragged, paddle.to_tensor(
+            np.float32(0.0)))
+        assert list(padded.shape) == [2, 5, 2]
+        np.testing.assert_allclose(np.asarray(L.numpy()), [3, 5])
+
+    def test_enumerate_conv_concat(self):
+        ids = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(2, 4))
+        win = snn.sequence_enumerate(ids, 2).numpy()
+        assert win.shape == (2, 4, 2)
+        np.testing.assert_allclose(win[0, 0], [0, 1])
+        x = _x((2, 6, 3))
+        out = snn.sequence_conv(x, 5, filter_size=3)
+        assert list(out.shape) == [2, 6, 5]
+        cat = snn.sequence_concat([x, x])
+        assert list(cat.shape) == [2, 12, 3]
+
+    def test_staticrnn_raises_with_guidance(self):
+        with pytest.raises(NotImplementedError):
+            snn.StaticRNN()
