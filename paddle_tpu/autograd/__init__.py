@@ -153,3 +153,36 @@ def jvp(func, xs, v=None):
         tangents = [as_tensor_data(t) for t in v_list]
     out, tangent_out = jax.jvp(_to_pure(func), tuple(arrays), tuple(tangents))
     return (jax.tree_util.tree_map(Tensor, out), jax.tree_util.tree_map(Tensor, tangent_out))
+
+
+class saved_tensors_hooks:
+    """Pack/unpack hooks for tape-saved tensors (ref: python/paddle/autograd/
+    saved_tensors_hooks.py — used for CPU offload / compression of saved
+    activations).
+
+    TPU-native scope: the jax.vjp residual closure is opaque, but every
+    GradNode also retains its primal inputs (`primals`, used for
+    double-backward). Inside this context those retained primals run
+    through pack_hook at record time and unpack_hook at backward time —
+    the mechanism reference users rely on to offload/quantize retained
+    activations. The preferred TPU memory lever remains jax.checkpoint
+    (recompute), which trades the residual memory away entirely.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..framework import state as _st
+        self._prev = getattr(_st._state, "saved_tensor_hooks", None)
+        _st._state.saved_tensor_hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ..framework import state as _st
+        _st._state.saved_tensor_hooks = self._prev
+        return False
+
+
+__all__.append("saved_tensors_hooks")

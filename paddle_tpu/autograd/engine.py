@@ -115,7 +115,7 @@ def _call_vjp(node, cots, create_graph):
     tensor_parent_ix = [i for i, p in enumerate(node.parents) if p is not None]
     real_cot_ix = [i for i, c in enumerate(full) if isinstance(c, Tensor)]
     raw_leaves = [c._data if isinstance(c, Tensor) else c for c in full]
-    primals0 = node.primals
+    primals0 = node.get_primals()
     treedef = node.out_treedef
     fwd = node.fwd_fn
 
@@ -140,7 +140,7 @@ def _call_vjp(node, cots, create_graph):
     # re-align to parents: float0 slots (non-float primals) were dropped
     aligned, it = [], iter(outs)
     for i, p in enumerate(node.parents):
-        a = node.primals[i]
+        a = primals0[i]
         diff = hasattr(a, "dtype") and (
             jnp.issubdtype(a.dtype, jnp.floating) or jnp.issubdtype(a.dtype, jnp.complexfloating))
         if diff:

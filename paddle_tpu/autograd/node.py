@@ -9,7 +9,7 @@ from __future__ import annotations
 
 class GradNode:
     __slots__ = ("vjp_fn", "parents", "out_treedef", "out_avals", "op_name", "hooks",
-                 "fwd_fn", "primals")
+                 "fwd_fn", "primals", "saved_unpack")
 
     def __init__(self, vjp_fn, parents, out_treedef, out_avals, op_name=None,
                  fwd_fn=None, primals=None):
@@ -24,6 +24,15 @@ class GradNode:
         # closure; primals the original input arrays.
         self.fwd_fn = fwd_fn
         self.primals = primals
+        self.saved_unpack = None      # saved_tensors_hooks unpack fn
+
+    def get_primals(self):
+        """Retained primal inputs, routed through the saved_tensors_hooks
+        unpack fn when one was active at record time."""
+        if self.saved_unpack is None or self.primals is None:
+            return self.primals
+        import jax.numpy as jnp
+        return [jnp.asarray(self.saved_unpack(p)) for p in self.primals]
 
     def add_hook(self, out_idx, hook):
         if self.hooks is None:

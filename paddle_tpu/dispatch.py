@@ -89,8 +89,17 @@ def apply(fn, *inputs, op_name=None, **static_kw):
     parents = [x if isinstance(x, Tensor) else None for x in inputs]
     leaves, treedef = jax.tree_util.tree_flatten(out)
     avals = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+    # saved_tensors_hooks: pack the retained primals at record time; the
+    # node unpacks them lazily in backward (autograd.saved_tensors_hooks)
+    hooks = getattr(_st._state, "saved_tensor_hooks", None)
+    primals_store = arrays
+    if hooks is not None:
+        pack, unpack = hooks
+        primals_store = [pack(a) for a in arrays]
     node = GradNode(vjp_fn, parents, treedef, avals, op_name=op_name,
-                    fwd_fn=call, primals=arrays)
+                    fwd_fn=call, primals=primals_store)
+    if hooks is not None:
+        node.saved_unpack = hooks[1]
     return _wrap_outputs(out, node=node)
 
 

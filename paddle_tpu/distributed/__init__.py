@@ -42,3 +42,52 @@ def get_backend():
 def is_initialized():
     from . import env as _env
     return _env.is_initialized()
+
+
+class ParallelMode:
+    """Parallelism mode ids (ref distributed/parallel.py ParallelMode)."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def gloo_init_parallel_env(*a, **k):
+    """Gloo is the reference's CPU rendezvous; single-controller SPMD needs
+    none (ref distributed/parallel.py gloo_init_parallel_env)."""
+    return None
+
+
+def gloo_barrier():
+    from .collective import barrier
+    barrier()
+
+
+def gloo_release():
+    return None
+
+
+def _ps_era(name, hint):
+    class _Stub:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f"{name} configures parameter-server sparse tables "
+                f"(ref incubate/distributed/fleet); on TPU use {hint}")
+    _Stub.__name__ = name
+    return _Stub
+
+
+# parameter-server sparse-table config & dataset feeders: PS async training
+# is superseded by sharded SPMD (SURVEY.md out-of-scope list); the names
+# raise with guidance instead of silently half-working
+CountFilterEntry = _ps_era("CountFilterEntry", "dense embeddings + ZeRO")
+ProbabilityEntry = _ps_era("ProbabilityEntry", "dense embeddings + ZeRO")
+ShowClickEntry = _ps_era("ShowClickEntry", "dense embeddings + ZeRO")
+InMemoryDataset = _ps_era("InMemoryDataset", "paddle_tpu.io.DataLoader")
+QueueDataset = _ps_era("QueueDataset", "paddle_tpu.io.DataLoader")
+
+from .collective import (  # noqa: E402,F401
+    gather, isend, irecv, broadcast_object_list, scatter_object_list,
+    destroy_process_group, is_available,
+)
+from . import io  # noqa: E402,F401

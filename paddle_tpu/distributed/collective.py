@@ -251,3 +251,81 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def stream_allreduce(*a, **k):
     return all_reduce(*a, **k)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather to dst (ref communication/gather.py). SPMD model: all_gather
+    everywhere (a single-destination gather saves nothing on ICI); eager
+    single-controller: every rank holds the same replicated value, so the
+    gather list is world_size copies of it."""
+    ax = _axis(group)
+    if _in_spmd(ax):
+        out = _apply(lambda x: lax.all_gather(x, ax), tensor,
+                     op_name="gather")
+        chunks = [out[i] for i in range(out.shape[0])]
+    else:
+        # independent copies: aliasing one Tensor world_size times would
+        # make any in-place edit of one entry mutate all of them
+        chunks = [Tensor(tensor._data) if isinstance(tensor, Tensor)
+                  else tensor for _ in range(env.world_size())]
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(chunks)
+    return chunks
+
+
+def isend(tensor, dst=0, group=None):
+    """Async send returns a waitable task (ref communication/isend); under
+    the compiled SPMD model dispatch is already async, so the task's wait
+    is a device sync."""
+    res = send(tensor, dst, group, sync_op=False)
+
+    class _Task:
+        def wait(self, *a, **k):
+            return wait(res)
+    return _Task()
+
+
+def irecv(tensor, src=0, group=None):
+    res = recv(tensor, src, group, sync_op=False)
+
+    class _Task:
+        def wait(self, *a, **k):
+            return wait(res)
+    return _Task()
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Python-object broadcast (ref communication/broadcast.py). The
+    single-controller owns every rank's python state, so the list is
+    already consistent; kept for API parity."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    out_object_list.clear()
+    if in_object_list:
+        out_object_list.append(in_object_list[get_rank_in(group)])
+    return out_object_list
+
+
+def get_rank_in(group=None):
+    """Rank within `group` (falls back to global rank for the world)."""
+    from .env import get_rank
+    rank = get_rank()
+    ranks = getattr(group, "ranks", None) if group is not None else None
+    if ranks:
+        return list(ranks).index(rank) if rank in ranks else 0
+    return rank
+
+
+def destroy_process_group(group=None):
+    """Reset mesh/env state (ref communication/group.py destroy)."""
+    from . import env as _env
+    if group is None:
+        _env.set_mesh(None)
+
+
+def is_available():
+    return True

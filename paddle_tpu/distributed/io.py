@@ -1,0 +1,30 @@
+"""paddle.distributed.io (ref: python/paddle/distributed/io.py —
+save/load for distributed persistables). Single-controller SPMD: sharded
+arrays are globally addressable, so these reduce to framework.io with a
+device_get that assembles global values."""
+from __future__ import annotations
+
+from ..framework.io import save, load  # noqa: F401
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """ref distributed/io.py save_persistables."""
+    from ..static.extras import default_main_program, serialize_persistables
+    import os
+    prog = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    data = serialize_persistables(program=prog)
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static.extras import default_main_program, deserialize_persistables
+    import os
+    prog = main_program or default_main_program()
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    with open(path, "rb") as f:
+        deserialize_persistables(prog, f.read())
+    return prog

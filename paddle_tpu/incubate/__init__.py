@@ -16,3 +16,124 @@ from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+# ---- functional surface (ref python/paddle/incubate/__init__.py __all__) ----
+import jax as _jax
+import jax.numpy as _jnp
+
+from ..tensor_impl import Tensor as _T, as_tensor_data as _d
+from ..dispatch import apply as _apply
+
+
+def _segment(reduce_fn, fill=0.0):
+    def op(data, segment_ids, name=None):
+        ids = _jnp.asarray(_d(segment_ids), _jnp.int32)
+        n = int(_jax.device_get(ids.max())) + 1 if ids.size else 0
+
+        def f(x):
+            return reduce_fn(x, ids, n)
+        return _apply(f, data, op_name="segment_op")
+    return op
+
+
+segment_sum = _segment(
+    lambda x, ids, n: _jax.ops.segment_sum(x, ids, num_segments=n))
+segment_max = _segment(
+    lambda x, ids, n: _jax.ops.segment_max(x, ids, num_segments=n))
+segment_min = _segment(
+    lambda x, ids, n: _jax.ops.segment_min(x, ids, num_segments=n))
+
+
+def segment_mean(data, segment_ids, name=None):
+    ids = _jnp.asarray(_d(segment_ids), _jnp.int32)
+    n = int(_jax.device_get(ids.max())) + 1 if ids.size else 0
+
+    def f(x):
+        s = _jax.ops.segment_sum(x, ids, num_segments=n)
+        c = _jax.ops.segment_sum(_jnp.ones_like(ids, x.dtype), ids,
+                                 num_segments=n)
+        return s / _jnp.maximum(c, 1).reshape((n,) + (1,) * (x.ndim - 1))
+    return _apply(f, data, op_name="segment_mean")
+
+
+def identity_loss(x, reduction="none"):
+    """ref incubate/nn/loss.py identity_loss (IPU-era reduction wrapper)."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+
+    def f(v):
+        if red == "sum":
+            return v.sum()
+        if red == "mean":
+            return v.mean()
+        return v
+    return _apply(f, x, op_name="identity_loss")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused program (ref incubate/operators/
+    softmax_mask_fuse.py — a CUDA fusion; XLA fuses this composition)."""
+    def f(xv, mv):
+        return _jax.nn.softmax(xv.astype(_jnp.float32) +
+                               mv.astype(_jnp.float32),
+                               axis=-1).astype(xv.dtype)
+    return _apply(f, x, mask, op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (ref softmax_mask_fuse_upper_triangle: mask is
+    the upper triangle, queries attend to <= their position)."""
+    def f(xv):
+        S1, S2 = xv.shape[-2], xv.shape[-1]
+        mask = _jnp.tril(_jnp.ones((S1, S2), bool))
+        logits = _jnp.where(mask, xv.astype(_jnp.float32), -_jnp.inf)
+        return _jax.nn.softmax(logits, axis=-1).astype(xv.dtype)
+    return _apply(f, x, op_name="softmax_mask_fuse_upper_triangle")
+
+
+# graph ops: the geometric namespace owns the TPU-native implementations
+# (ref incubate graph_* were promoted to paddle.geometric upstream)
+from ..geometric import (  # noqa: E402
+    send_u_recv as graph_send_recv,
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling: chained sample_neighbors over hops
+    (ref incubate/operators/graph_khop_sampler.py)."""
+    import numpy as _np
+    from ..geometric import sample_neighbors as _sn
+    nodes = _np.asarray(_jax.device_get(_d(input_nodes))).reshape(-1)
+    all_rows, all_cols = [], []
+    seen = set(int(v) for v in nodes)
+    frontier = nodes  # hop k samples ONLY newly discovered nodes
+    for k in sample_sizes:
+        if frontier.size == 0:
+            break
+        out_neighbors, out_count = _sn(row, colptr, frontier, sample_size=k)
+        nb = _np.asarray(_jax.device_get(_d(out_neighbors)))
+        cnt = _np.asarray(_jax.device_get(_d(out_count)))
+        dst = _np.repeat(frontier[:len(cnt)], cnt)
+        all_rows.append(nb)
+        all_cols.append(dst)
+        fresh = [int(v) for v in _np.unique(nb) if int(v) not in seen]
+        seen.update(fresh)
+        frontier = _np.asarray(fresh, nodes.dtype)
+    edge_src = _np.concatenate(all_rows) if all_rows else _np.zeros(0, _np.int64)
+    edge_dst = _np.concatenate(all_cols) if all_cols else _np.zeros(0, _np.int64)
+    # seeds first, then neighbors in first-seen order (the reindex_graph
+    # contract: input nodes map to [0, len(input_nodes)))
+    remap = {}
+    for v in nodes:
+        remap.setdefault(int(v), len(remap))
+    for v in edge_src:
+        remap.setdefault(int(v), len(remap))
+    sample_index = _np.asarray(list(remap), _np.int64)
+    reindex_src = _np.asarray([remap[int(v)] for v in edge_src], _np.int64)
+    reindex_dst = _np.asarray([remap[int(v)] for v in edge_dst], _np.int64)
+    out = (_T(_jnp.asarray(edge_src)), _T(_jnp.asarray(edge_dst)),
+           _T(_jnp.asarray(sample_index)),
+           (_T(_jnp.asarray(reindex_src)), _T(_jnp.asarray(reindex_dst))))
+    return out

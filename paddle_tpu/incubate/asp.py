@@ -143,8 +143,12 @@ def add_supported_layer(layer, pruning_func=None):
     (ref asp/supported_layer_list.py add_supported_layer). prune_model
     consults this registry for params whose dotted path contains the
     registered name."""
-    name = layer if isinstance(layer, str) else getattr(
-        layer, "__name__", str(layer))
+    if isinstance(layer, str):
+        name = layer
+    elif isinstance(layer, type):
+        name = layer.__name__
+    else:  # instance: register its class
+        name = type(layer).__name__
     _EXTRA_SUPPORTED[name] = pruning_func
 
 
@@ -163,17 +167,20 @@ def reset_excluded_layers(main_program=None):
     _EXCLUDED.clear()
 
 
-def _extra_match(name):
-    """The registered extra-layer name whose component appears in the
-    dotted path, if any."""
+def _extra_match(name, type_names=None):
+    """The registered extra-layer entry matching either a dotted-path
+    component (string registrations) or the owning layer's class name
+    (class registrations; prune_model passes the param's layer type)."""
     parts = name.split(".")
     for extra in _EXTRA_SUPPORTED:
         if extra in parts or extra.lower() in (s.lower() for s in parts):
             return extra
+        if type_names and extra in type_names:
+            return extra
     return None
 
 
-def _prunable(name, p):
+def _prunable(name, p, type_names=None):
     # prefix (dotted-path component) or exact match — substring matching
     # would over-exclude ('fc1' must not exclude 'fc10.weight')
     if any(name == e or name.startswith(e + ".") or p.name == e
@@ -182,7 +189,7 @@ def _prunable(name, p):
     if p.ndim < 2:
         return False
     return "weight" in name or name.endswith("_w") or \
-        _extra_match(name) is not None
+        _extra_match(name, type_names) is not None
 
 
 def _as_2d(arr):
@@ -194,10 +201,14 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     for maintenance under training (ref asp.py prune_model)."""
     algo = MaskAlgo[mask_algo]
     pruned = {}
+    sub_types = {prefix: type(layer).__name__
+                 for prefix, layer in model.named_sublayers()}
     for name, p in model.named_parameters():
-        if not _prunable(name, p):
+        owner = name.rsplit(".", 1)[0] if "." in name else ""
+        tnames = {sub_types[owner]} if owner in sub_types else set()
+        if not _prunable(name, p, tnames):
             continue
-        extra = _extra_match(name)
+        extra = _extra_match(name, tnames)
         fn = _EXTRA_SUPPORTED.get(extra) if extra else None
         w2 = _as_2d(p._data)
         mask = jnp.asarray((fn or algo)(w2, n, m), dtype=p._data.dtype)

@@ -184,3 +184,23 @@ def set_global_initializer(weight_init=None, bias_init=None):
 
 _global_weight_init = None
 _global_bias_init = None
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel for transposed conv upsampling
+    (ref: python/paddle/nn/initializer/Bilinear — every (out, in) channel
+    pair of the [C_out, C_in, k, k] weight gets the classic bilinear
+    upsample filter)."""
+
+    def _generate(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D conv weight")
+        k = shape[-1]
+        if shape[-2] != k:
+            raise ValueError("Bilinear initializer needs square kernels")
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:k, :k]
+        filt = (1 - np.abs(og[0] / f - c)) * (1 - np.abs(og[1] / f - c))
+        w = np.broadcast_to(filt.astype(np.float32), shape)
+        return jnp.asarray(w, dtype)
