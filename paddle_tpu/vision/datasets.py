@@ -163,3 +163,54 @@ class DatasetFolder(Dataset):
 
 
 ImageFolder = DatasetFolder
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers (ref vision/datasets/flowers.py). Offline:
+    deterministic synthetic blobs with the right shape/label space."""
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 1020 if mode == "train" else 256
+        rng = np.random.RandomState(hash(mode) % (2 ** 31))
+        self.labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+        self.images = rng.rand(n, 64, 64, 3).astype(np.float32)
+        for i, lab in enumerate(self.labels):
+            self.images[i, :, :, lab % 3] += (lab % 17) / 17.0
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (ref vision/datasets/voc2012.py).
+    Offline: synthetic image/mask pairs with the 21-class label space."""
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 512 if mode == "train" else 128
+        rng = np.random.RandomState(1 + (hash(mode) % (2 ** 31)))
+        self.images = rng.rand(n, 64, 64, 3).astype(np.float32)
+        self.masks = rng.randint(0, self.NUM_CLASSES,
+                                 (n, 64, 64)).astype(np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
