@@ -43,6 +43,7 @@ class Model:
         self._amp_dtype = "bfloat16"
         self._scaler = None
         self._train_step = None
+        self._eval_jitted = None
         self.stop_training = False
 
     # -- configuration ----------------------------------------------------
@@ -70,6 +71,7 @@ class Model:
         self._amp_dtype = amp_dtype
         self._train_step = None
         self._scaler = None
+        self._eval_jitted = None  # re-prepare must re-trace with the new loss
         if optimizer is not None and getattr(optimizer, "_parameter_list", None) is None:
             optimizer._parameter_list = list(self.network.parameters())
         compiled = (jit or mesh is not None) and optimizer is not None \
@@ -141,10 +143,27 @@ class Model:
         self.network.eval()
         inputs = [_as_tensor(x) for x in _to_list(inputs)]
         labels = [_as_tensor(x) for x in _to_list(labels)]
+        if self._train_step is not None and self._loss is not None and labels:
+            # compiled eval: one XLA program over the step's live (possibly
+            # mesh-sharded) params instead of eager per-op dispatch
+            loss, outputs = self._compiled_eval(inputs, labels)
+            self._update_metrics(outputs, labels)
+            return [float(loss)], self._metric_logs()
         outputs = self.network(*inputs)
         loss = self._loss(outputs, *labels) if self._loss and labels else None
         self._update_metrics(outputs, labels)
         return ([float(loss)] if loss is not None else []), self._metric_logs()
+
+    def _compiled_eval(self, inputs, labels):
+        import jax
+        step = self._train_step
+        if self._eval_jitted is None:
+            self._eval_jitted = step.build_eval()
+        in_arrays = tuple(x._data for x in inputs)
+        lab_arrays = tuple(x._data for x in labels)
+        loss, out = self._eval_jitted(step._params, step._buffers,
+                                      in_arrays, lab_arrays)
+        return loss, jax.tree_util.tree_map(Tensor, out)
 
     def predict_batch(self, inputs):
         self.network.eval()

@@ -205,6 +205,37 @@ class TrainStep:
                            in_shardings=in_shardings, out_shardings=out_shardings)
         return jax.jit(step_fn, donate_argnums=donate)
 
+    def build_eval(self):
+        """Jitted (params, buffers, inputs, labels) -> (loss, outputs) over
+        the SAME forward+loss tracing and data shardings as the train step
+        (hapi Model.eval_batch's compiled path)."""
+        model, loss_fn = self.model, self.loss_fn
+        mesh = self.mesh
+
+        def eval_fn(params, buffers, inputs, labels):
+            out, _ = functional_call(model, params, buffers, inputs)
+            from ..framework import state as _st
+            with _st.functional_trace():
+                wrapped = jax.tree_util.tree_map(Tensor, out)
+                wrapped_labels = jax.tree_util.tree_map(
+                    lambda x: Tensor(x) if hasattr(x, "dtype") else x, labels)
+                loss_t = loss_fn(wrapped, *wrapped_labels)
+            loss = loss_t._data if isinstance(loss_t, Tensor) else loss_t
+            return loss.astype(jnp.float32), out
+
+        if mesh is not None and getattr(self, "_sample_inputs", None) is not None:
+            p_sh = self._param_shardings()
+            rep = NamedSharding(mesh, P())
+            b_sh = {n: rep for n in self._buffers}
+            dp_axes = tuple(a for a in ("dp", "sdp") if a in mesh.axis_names)
+            data_sh = NamedSharding(mesh, P(dp_axes if dp_axes else None))
+            data_tree = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda _: data_sh, t)
+            return jax.jit(eval_fn, in_shardings=(
+                p_sh, b_sh, data_tree(self._sample_inputs),
+                data_tree(self._sample_labels)))
+        return jax.jit(eval_fn)
+
     def __call__(self, inputs, labels):
         """inputs: Tensor or tuple of Tensors fed to model; labels likewise."""
         if not isinstance(inputs, (list, tuple)):

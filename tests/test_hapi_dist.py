@@ -90,3 +90,19 @@ def test_eager_amp_float16_scaler_path():
     for _ in range(5):
         last, _ = model.train_batch([X], [y])
     assert last[0] < first[0]
+
+
+def test_compiled_eval_matches_eager(devices8):
+    X, y = _regression_data(n=64)
+    net = _mlp()
+    model = paddle.Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    model.prepare(opt, paddle.nn.MSELoss(), jit=True)
+    model.train_batch([X], [y])
+    losses_c, _ = model.eval_batch([X], [y])
+    # eager reference path (no train step): fresh Model sharing the net
+    eager = paddle.Model(net)
+    eager.prepare(None, paddle.nn.MSELoss())
+    losses_e, _ = eager.eval_batch([X], [y])
+    np.testing.assert_allclose(losses_c[0], losses_e[0], rtol=1e-5)
