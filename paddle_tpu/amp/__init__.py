@@ -169,3 +169,12 @@ def is_float16_supported(device=None):
 def is_bfloat16_supported(device=None):
     import jax
     return jax.default_backend() in ("tpu", "cpu")
+
+
+from . import debugging  # noqa: E402,F401
+from .debugging import (  # noqa: E402,F401
+    DebugMode, TensorCheckerConfig, check_numerics, collect_operator_stats,
+    compare_accuracy, disable_operator_stats_collection,
+    disable_tensor_checker, enable_operator_stats_collection,
+    enable_tensor_checker,
+)

@@ -1,0 +1,148 @@
+"""AMP debugging tools (ref: python/paddle/amp/debugging.py).
+
+The reference instruments C++ kernels; here the eager dispatch layer
+(dispatch.apply) is the single chokepoint, so the tensor checker and
+operator-stats collector hook there: every dispatched op can have its
+outputs nan/inf-checked on host and its (op, dtype) call count recorded.
+Compiled (jit) paths are outside the eager tape — for those, NanGuard
+(distributed/elastic.py) checks the step outputs instead.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import Counter
+
+import numpy as np
+import jax
+
+from ..framework import state as _st
+
+
+class DebugMode:
+    """ref amp/debugging.py DebugMode."""
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+    DUMP_ALL = 4
+
+
+class TensorCheckerConfig:
+    """ref amp/debugging.py TensorCheckerConfig."""
+
+    def __init__(self, enable=False, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or ())
+        self.skipped_op_list = set(skipped_op_list or ())
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+def enable_tensor_checker(checker_config):
+    _st._state.amp_tensor_checker = checker_config if \
+        getattr(checker_config, "enable", True) else None
+
+
+def disable_tensor_checker():
+    _st._state.amp_tensor_checker = None
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Host-side nan/inf check of one tensor (ref check_numerics op).
+    Returns (num_nan, num_inf, num_zero) like the reference kernel."""
+    from ..tensor_impl import as_tensor_data
+    arr = np.asarray(jax.device_get(as_tensor_data(tensor)))
+    if not np.issubdtype(arr.dtype, np.floating):
+        return 0, 0, int((arr == 0).sum())
+    n_nan = int(np.isnan(arr).sum())
+    n_inf = int(np.isinf(arr).sum())
+    n_zero = int((arr == 0).sum())
+    mode = debug_mode if debug_mode is not None else \
+        DebugMode.CHECK_NAN_INF_AND_ABORT
+    if (n_nan or n_inf) and mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise RuntimeError(
+            f"check_numerics: op={op_type!r} var={var_name!r} has "
+            f"{n_nan} nan / {n_inf} inf values")
+    return n_nan, n_inf, n_zero
+
+
+def _checker_hook(op_name, leaves):
+    """Called by dispatch.apply on eager op outputs when a checker is on."""
+    cfg = getattr(_st._state, "amp_tensor_checker", None)
+    if cfg is not None:
+        if cfg.checked_op_list and op_name not in cfg.checked_op_list:
+            pass
+        elif op_name in cfg.skipped_op_list:
+            pass
+        else:
+            for leaf in leaves:
+                if hasattr(leaf, "dtype") and np.issubdtype(
+                        np.dtype(leaf.dtype), np.floating):
+                    check_numerics(leaf, op_type=op_name or "",
+                                   debug_mode=cfg.debug_mode)
+    stats = getattr(_st._state, "amp_op_stats", None)
+    if stats is not None:
+        for leaf in leaves:
+            dt = str(getattr(leaf, "dtype", "?"))
+            stats[f"{op_name or 'unknown'}-{dt}"] += 1
+
+
+def enable_operator_stats_collection():
+    _st._state.amp_op_stats = Counter()
+
+
+def disable_operator_stats_collection():
+    stats = getattr(_st._state, "amp_op_stats", None)
+    _st._state.amp_op_stats = None
+    if stats:
+        _print_stats(stats)
+    return stats
+
+
+def _print_stats(stats):
+    print("<------------------------------ op list ------------------------->")
+    for key in sorted(stats):
+        print(f"  {key}: {stats[key]}")
+    print("<----------------------------------- done ----------------------->")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """ref amp/debugging.py collect_operator_stats context manager."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Compare two op-stats/tensor dumps (ref compare_accuracy — the
+    reference diffs fp32-vs-fp16 run workerlogs). Accepts paths to files
+    written as repr(dict) / one 'key: count' per line; writes a csv of
+    keys whose counts differ."""
+    def read(path):
+        out = {}
+        with open(path) as f:
+            for line in f:
+                if ":" in line:
+                    k, _, v = line.rpartition(":")
+                    try:
+                        out[k.strip()] = int(v)
+                    except ValueError:
+                        pass
+        return out
+
+    a, b = read(dump_path), read(another_dump_path)
+    rows = ["key,run_a,run_b"]
+    for k in sorted(set(a) | set(b)):
+        if a.get(k) != b.get(k):
+            rows.append(f"{k},{a.get(k, 0)},{b.get(k, 0)}")
+    with open(output_filename, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    return output_filename

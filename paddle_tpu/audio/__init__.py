@@ -9,5 +9,8 @@ file IO.
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
 from . import datasets  # noqa: F401
+from . import backends  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 
-__all__ = ["functional", "features", "datasets"]
+__all__ = ["functional", "features", "datasets", "backends",
+           "info", "load", "save"]

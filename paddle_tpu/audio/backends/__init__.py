@@ -1,0 +1,89 @@
+"""Audio IO backends (ref: python/paddle/audio/backends/ — wave_backend
+plus optional paddleaudio soundfile). Host-side stdlib `wave` covers the
+reference's default backend (16/8/32-bit PCM WAV); there is no TPU
+component to file IO."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+from ...tensor_impl import Tensor
+
+
+class AudioInfo:
+    """ref backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable; wave_backend handles "
+            "PCM WAV (the reference's default)")
+
+
+def info(filepath):
+    """ref wave_backend.info."""
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """ref wave_backend.load: returns (waveform Tensor [C, T] (or [T, C]),
+    sample_rate)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else min(
+            num_frames, n - frame_offset)
+        raw = f.readframes(count)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, ch)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    """ref wave_backend.save: float waveform in [-1, 1] -> PCM WAV."""
+    data = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        data = data.T
+    if data.ndim == 1:
+        data = data[:, None]
+    width = bits_per_sample // 8
+    scale = float(2 ** (bits_per_sample - 1) - 1)
+    dtype = {2: np.int16, 4: np.int32}[width]
+    pcm = np.clip(data, -1.0, 1.0)
+    pcm = (pcm * scale).astype(dtype)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(width)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

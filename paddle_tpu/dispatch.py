@@ -83,7 +83,7 @@ def apply(fn, *inputs, op_name=None, **static_kw):
 
     if not needs_grad:
         out = call(*arrays)
-        return _wrap_outputs(out, node=None)
+        return _wrap_outputs(out, node=None, op_name=op_name)
 
     out, vjp_fn = jax.vjp(call, *arrays)
     parents = [x if isinstance(x, Tensor) else None for x in inputs]
@@ -100,11 +100,18 @@ def apply(fn, *inputs, op_name=None, **static_kw):
                     fwd_fn=call, primals=primals_store)
     if hooks is not None:
         node.saved_unpack = hooks[1]
-    return _wrap_outputs(out, node=node)
+    return _wrap_outputs(out, node=node, op_name=op_name)
 
 
-def _wrap_outputs(out, node):
+def _wrap_outputs(out, node, op_name=None):
     leaves, treedef = jax.tree_util.tree_flatten(out)
+    # amp.debugging: tensor checker / op-stats hook (eager values only —
+    # tracers are checked by the compiled-path NanGuard instead)
+    if (getattr(_st._state, "amp_tensor_checker", None) is not None or
+            getattr(_st._state, "amp_op_stats", None) is not None):
+        if not any(isinstance(l, jax.core.Tracer) for l in leaves):
+            from .amp.debugging import _checker_hook
+            _checker_hook(op_name, leaves)
     tensors = []
     for i, leaf in enumerate(leaves):
         differentiable = jnp.issubdtype(leaf.dtype, jnp.floating) or jnp.issubdtype(

@@ -1,0 +1,63 @@
+"""amp.debugging + audio backends (ref: python/paddle/amp/debugging.py,
+audio/backends/wave_backend.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestTensorChecker:
+    def test_nan_aborts_when_enabled(self):
+        cfg = paddle.amp.TensorCheckerConfig(enable=True)
+        paddle.amp.enable_tensor_checker(cfg)
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            with pytest.raises(RuntimeError):
+                _ = x / x  # 0/0 -> nan
+        finally:
+            paddle.amp.disable_tensor_checker()
+        # disabled: no raise
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        _ = x / x
+
+    def test_check_numerics_counts(self):
+        t = paddle.to_tensor(np.array([np.nan, np.inf, 0.0, 1.0], np.float32))
+        n_nan, n_inf, n_zero = paddle.amp.check_numerics(
+            t, debug_mode=paddle.amp.DebugMode.CHECK_NAN_INF)
+        assert (n_nan, n_inf, n_zero) == (1, 1, 1)
+
+
+class TestOperatorStats:
+    def test_collect_and_compare(self, tmp_path):
+        with paddle.amp.collect_operator_stats():
+            a = paddle.to_tensor(np.ones((2, 2), np.float32))
+            b = a @ a
+            c = b + 1.0
+            from paddle_tpu.framework import state as _st
+            stats = dict(_st._state.amp_op_stats)
+        assert any("float32" in k for k in stats)
+        f1, f2 = tmp_path / "a.log", tmp_path / "b.log"
+        f1.write_text("matmul-float32: 2\nadd-float32: 1\n")
+        f2.write_text("matmul-float16: 2\nadd-float32: 1\n")
+        out = paddle.amp.compare_accuracy(str(f1), str(f2),
+                                          str(tmp_path / "diff.csv"))
+        text = open(out).read()
+        assert "matmul-float32" in text and "add-float32" not in text
+
+
+class TestAudioBackends:
+    def test_wav_roundtrip(self, tmp_path):
+        sr = 16000
+        t = np.linspace(0, 1, sr, dtype=np.float32)
+        wave = 0.5 * np.sin(2 * np.pi * 440 * t)[None, :]  # [C=1, T]
+        path = str(tmp_path / "tone.wav")
+        paddle.audio.save(path, wave, sr)
+        info = paddle.audio.info(path)
+        assert info.sample_rate == sr and info.num_channels == 1
+        assert info.bits_per_sample == 16
+        loaded, sr2 = paddle.audio.load(path)
+        assert sr2 == sr
+        np.testing.assert_allclose(np.asarray(loaded.numpy())[0, :100],
+                                   wave[0, :100], atol=2e-4)
+        assert paddle.audio.backends.list_available_backends() == \
+            ["wave_backend"]
