@@ -70,6 +70,8 @@ from . import utils  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
 from . import sysconfig  # noqa: E402,F401
 from . import reader  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from . import version  # noqa: E402,F401
 from . import cost_model  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401

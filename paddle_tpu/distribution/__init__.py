@@ -217,3 +217,10 @@ def kl_divergence(p, q):  # noqa: F811 — registry-aware override
     if out is not None:
         return out
     return p.kl_divergence(q)
+
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+)

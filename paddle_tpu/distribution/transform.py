@@ -1,0 +1,324 @@
+"""paddle.distribution.transform (ref: python/paddle/distribution/
+transform.py): invertible transforms with log-det-jacobians, composable
+into TransformedDistribution. Pure jnp math — every transform is
+jit/grad-compatible.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor, as_tensor_data, wrap
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+def _arr(x):
+    return jnp.asarray(as_tensor_data(x))
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    @property
+    def type(self):
+        return self._type
+
+    def forward(self, x):
+        return wrap(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return wrap(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return wrap(self._forward_log_det_jacobian(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _arr(y)
+        return wrap(-self._forward_log_det_jacobian(self._inverse(yv)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # subclass surface
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| (surjective; inverse returns the positive branch)."""
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive half-line."""
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log2 - x - softplus(-2x)), the stable form
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (surjection onto the simplex;
+    inverse is log, unique up to an additive constant — ref transform.py
+    SoftmaxTransform)."""
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not a bijection; no log-det-jacobian")
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.in_event_shape
+
+
+class ChainTransform(Transform):
+    """Composition t_n(...t_1(x)) (ref ChainTransform)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._type = Type.BIJECTION if all(
+            t.type == Type.BIJECTION for t in self.transforms) else Type.OTHER
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Treat `reinterpreted_batch_rank` trailing dims as event dims: the
+    log-det sums over them (ref IndependentTransform)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._type = base.type
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ldj, axis=tuple(range(ldj.ndim - self.rank, ldj.ndim)))
+
+
+class StackTransform(Transform):
+    """Apply the i-th transform to the i-th slice along `axis`
+    (ref StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, x, method):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, method)(p.squeeze(self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map(x, "_forward")
+
+    def _inverse(self, y):
+        return self._map(y, "_inverse")
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map(x, "_forward_log_det_jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^{K} -> interior of the K+1 simplex via stick
+    breaking (ref StickBreakingTransform)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        K = x.shape[-1]
+        offset = jnp.log(jnp.arange(K, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        ones = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        cum = jnp.cumprod(1 - z, axis=-1)
+        head = z * jnp.concatenate([ones, cum[..., :-1]], axis=-1)
+        return jnp.concatenate([head, cum[..., -1:]], axis=-1)
+
+    def _inverse(self, y):
+        K = y.shape[-1] - 1
+        cum = 1.0 - jnp.cumsum(y[..., :-1], axis=-1)
+        shifted = jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), cum[..., :-1]], axis=-1)
+        z = y[..., :-1] / shifted
+        offset = jnp.log(jnp.arange(K, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        # dy_k/dx_k = z_k (1 - z_k) * prod_{j<k}(1 - z_j)
+        K = x.shape[-1]
+        offset = jnp.log(jnp.arange(K, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        ones = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        cum = jnp.cumprod(1 - z, axis=-1)
+        shifted = jnp.concatenate([ones, cum[..., :-1]], axis=-1)
+        return jnp.sum(jax.nn.log_sigmoid(t) + jax.nn.log_sigmoid(-t) +
+                       jnp.log(shifted), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)

@@ -1,0 +1,42 @@
+"""Version info (ref: python/paddle/version.py, generated at build time)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def nccl():
+    return False
+
+
+def xpu():
+    return False
+
+
+def xpu_xccl():
+    return False
+
+
+def cinn():
+    return False  # XLA plays the compiler role
