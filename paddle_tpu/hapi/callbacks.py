@@ -179,3 +179,113 @@ def config_callbacks(callbacks, model, epochs=None, steps=None, verbose=2,
     params = {"epochs": epochs, "steps": steps, "verbose": verbose,
               "metrics": metrics or []}
     return CallbackList(cbs, model, params)
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce optimizer LR when `monitor` plateaus (ref hapi callbacks
+    ReduceLROnPlateau — callback wrapper over the scheduler semantics)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "max" or (mode == "auto" and "acc" in monitor):
+            self.better = lambda cur, best: cur > best + self.min_delta
+            self.best = -np.inf
+        else:
+            self.better = lambda cur, best: cur < best - self.min_delta
+            self.best = np.inf
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _optimizer(self):
+        return getattr(self.model, "_optimizer", None)
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        cur = float(np.asarray(cur).reshape(-1)[0])
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = self._optimizer()
+                if opt is not None:
+                    old = float(opt.get_lr())
+                    new = max(old * self.factor, self.min_lr)
+                    if old - new > 1e-12:
+                        try:
+                            opt.set_lr(new)
+                        except RuntimeError:
+                            return  # LRScheduler-driven: leave it alone
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: lr {old:g} -> {new:g}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """VisualDL scalar logging (ref hapi callbacks VisualDL). The visualdl
+    package is CUDA-ecosystem tooling not present here; scalars are written
+    as jsonl the dashboard (or anything else) can ingest."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, "scalars.jsonl")
+        row = {"step": self._step, "tag": tag}
+        for k, v in (logs or {}).items():
+            try:
+                row[k] = float(np.asarray(v).reshape(-1)[0])
+            except Exception:
+                continue
+        with open(path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % 10 == 0:
+            self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (ref hapi callbacks WandbCallback): uses the
+    wandb package when importable, else raises at construction (zero-egress
+    images ship without it)."""
+
+    def __init__(self, project=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the `wandb` package") from e
+        import wandb
+        self._wandb = wandb
+        self._run = wandb.init(project=project, **kwargs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._wandb.log({k: float(np.asarray(v).reshape(-1)[0])
+                         for k, v in (logs or {}).items()
+                         if np.isscalar(v) or np.asarray(v).size == 1})

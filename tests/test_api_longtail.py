@@ -151,3 +151,36 @@ class TestKhopSampler:
         edges = list(zip(np.asarray(src.numpy()).tolist(),
                          np.asarray(dst.numpy()).tolist()))
         assert len(edges) == len(set(edges)), f"duplicate edges: {edges}"
+
+
+class TestCallbacks:
+    def test_reduce_lr_on_plateau(self):
+        net = paddle.nn.Linear(4, 1)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        model.prepare(opt, paddle.nn.MSELoss())
+        cb = paddle.callbacks.ReduceLROnPlateau(patience=1, factor=0.5,
+                                                verbose=0)
+        cb.set_model(model)
+        cb.on_epoch_end(0, {"loss": 1.0})
+        cb.on_epoch_end(1, {"loss": 1.0})  # wait=1 >= patience -> reduce
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_visualdl_writes_jsonl(self, tmp_path):
+        cb = paddle.callbacks.VisualDL(log_dir=str(tmp_path))
+        for i in range(10):
+            cb.on_train_batch_end(i, {"loss": 0.5})
+        cb.on_eval_end({"loss": 0.25})
+        content = (tmp_path / "scalars.jsonl").read_text().strip().splitlines()
+        assert len(content) == 2  # one train row (step 10) + one eval row
+
+    def test_wandb_requires_package(self):
+        try:
+            import wandb  # noqa: F401
+            has = True
+        except ImportError:
+            has = False
+        if not has:
+            with pytest.raises(ImportError):
+                paddle.callbacks.WandbCallback()
