@@ -1,0 +1,94 @@
+"""End-to-end mini pretraining: hybrid train step on a mesh -> loss
+drops -> checkpoint -> exact resume -> generation from the trained
+weights. Ties the flagship pieces together the way a user would."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.models.gpt_hybrid import HybridTrainStep
+
+
+def _cfg():
+    return GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=64, compute_dtype="float32",
+                     use_flash=False, remat=False)
+
+
+def _batch(step_idx=0):
+    # a memorizable pattern: ids cycle with period 8
+    rng = np.random.default_rng(step_idx % 4)
+    start = rng.integers(0, 8, size=(8, 1))
+    ids = (start + np.arange(32)[None, :]) % 8
+    return jnp.asarray(ids, jnp.int32)
+
+
+def test_pretrain_checkpoint_resume_generate(tmp_path):
+    mesh = dist_env.create_hybrid_mesh(dp=2, mp=2, pp=2)
+    cfg = _cfg()
+    opt = paddle.optimizer.AdamW(
+        5e-3, grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    step = HybridTrainStep(cfg, opt, mesh=mesh, num_microbatches=2)
+
+    first = float(np.asarray(jax.device_get(step(_batch(0)))))
+    for i in range(1, 12):
+        loss = step(_batch(i))
+    mid = float(np.asarray(jax.device_get(loss)))
+    assert mid < first, (first, mid)
+
+    # checkpoint -> keep training 3 steps -> restore -> the SAME 3 steps
+    # reproduce bit-identical losses (exact resume)
+    snap = jax.tree_util.tree_map(
+        lambda a: np.asarray(jax.device_get(a)),
+        (step._flat(step.params), step.opt_state))
+    after = [float(np.asarray(jax.device_get(step(_batch(12 + i)))))
+             for i in range(3)]
+    flat, opt_state = snap
+    step.params = step._unflat(
+        {k: jnp.asarray(v) for k, v in flat.items()})
+    step.opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
+    if step.mesh is not None:
+        step._place()
+    replay = [float(np.asarray(jax.device_get(step(_batch(12 + i)))))
+              for i in range(3)]
+    np.testing.assert_allclose(replay, after, rtol=1e-6)
+
+    # load trained weights into the Layer model and generate: the model
+    # should continue the period-8 pattern better than chance
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    trained = jax.tree_util.tree_map(
+        lambda a: np.asarray(jax.device_get(a)), step.params)
+    blocks = trained["blocks"]
+    # map functional params back onto the Layer weights
+    gpt = model.gpt
+    gpt.wte.weight._data = jnp.asarray(trained["wte"])
+    gpt.wpe.weight._data = jnp.asarray(trained["wpe"])
+    gpt.ln_f.weight._data = jnp.asarray(trained["lnf_g"])
+    gpt.ln_f.bias._data = jnp.asarray(trained["lnf_b"])
+    model.lm_head.weight._data = jnp.asarray(trained["head_w"])
+    name_map = {
+        "ln1_g": lambda b: b.ln_1.weight, "ln1_b": lambda b: b.ln_1.bias,
+        "qkv_w": lambda b: b.attn.qkv_proj.weight,
+        "qkv_b": lambda b: b.attn.qkv_proj.bias,
+        "out_w": lambda b: b.attn.out_proj.weight,
+        "out_b": lambda b: b.attn.out_proj.bias,
+        "ln2_g": lambda b: b.ln_2.weight, "ln2_b": lambda b: b.ln_2.bias,
+        "up_w": lambda b: b.mlp.up_proj.weight,
+        "up_b": lambda b: b.mlp.up_proj.bias,
+        "down_w": lambda b: b.mlp.down_proj.weight,
+        "down_b": lambda b: b.mlp.down_proj.bias,
+    }
+    for key, get in name_map.items():
+        stacked = blocks[key]
+        for li, blk in enumerate(model.gpt.h):
+            get(blk)._data = jnp.asarray(stacked[li])
+
+    prompt = np.asarray([[0, 1, 2, 3]], np.int64)
+    out = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=8).numpy())[0]
+    want = np.arange(4, 12) % 8
+    acc = (out[4:] == want).mean()
+    assert acc >= 0.5, (out, want, acc)
