@@ -2,5 +2,6 @@
 from . import functional  # noqa: F401
 from .layer import (  # noqa: F401
     FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
-    FusedEcMoe,
+    FusedEcMoe, FusedLinear, FusedDropoutAdd,
+    FusedBiasDropoutResidualLayerNorm, FusedMultiTransformer,
 )

@@ -305,3 +305,119 @@ def fused_ec_moe(x, gate_weight, expert_w1, expert_b1, expert_w2, expert_b2,
 
     return apply(f, x, gate_weight, expert_w1, expert_b1, expert_w2,
                  expert_b2, op_name="linear")
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """layer_norm(residual + dropout(x + bias)) in one program
+    (ref incubate/nn/functional/fused_transformer.py). XLA fuses the chain;
+    the API exists so reference code ports unchanged."""
+    from ...dispatch import apply
+    from ...framework.random import next_key
+    import jax
+    import jax.numpy as jnp
+    keep = 1.0 - dropout_rate
+    key = next_key() if (training and dropout_rate > 0.0) else None
+
+    def f(xv, res, *rest):
+        i = 0
+        if bias is not None:
+            xv = xv + rest[i]; i += 1
+        if training and dropout_rate > 0.0:
+            mask = jax.random.bernoulli(key, keep, xv.shape)
+            xv = jnp.where(mask, xv / keep, 0.0) if mode == "upscale_in_train" \
+                else jnp.where(mask, xv, 0.0)
+        elif mode == "downscale_in_infer":
+            xv = xv * keep
+        h = res + xv
+        mu = jnp.mean(h, -1, keepdims=True)
+        var = jnp.var(h, -1, keepdims=True)
+        out = (h - mu) * jax.lax.rsqrt(var + ln_epsilon)
+        if ln_scale is not None:
+            out = out * rest[i]; i += 1
+        if ln_bias is not None:
+            out = out + rest[i]; i += 1
+        return out
+
+    extra = [a for a in (bias, ln_scale, ln_bias) if a is not None]
+    return apply(f, x, residual, *extra,
+                 op_name="fused_bias_dropout_residual_layer_norm")
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, pre_caches=None, rotary_embs=None,
+        time_step=None, attn_mask=None, dropout_rate=0.0, activation="gelu",
+        training=False, mode="upscale_in_train", trans_qkvw=True,
+        ring_id=-1, name=None):
+    """Whole-transformer-stack fusion (ref incubate/nn/functional/
+    fused_transformer.py fused_multi_transformer — the CUDA inference
+    megakernel). TPU-native: run the L layers under one dispatch; XLA
+    fuses/pipelines. Supports the common pre-LN path with optional
+    additive attn_mask; cache/rotary args of the CUDA decoder are not
+    implemented (use models/generation.py for decode)."""
+    if cache_kvs is not None or pre_caches is not None or \
+            rotary_embs is not None or time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer cache/rotary decode args: use "
+            "GPTForCausalLM.generate (models/generation.py) for decoding")
+    from ...dispatch import apply
+    import jax
+    import jax.numpy as jnp
+    L = len(qkv_weights)
+    act = {"gelu": lambda v: jax.nn.gelu(v, approximate=True),
+           "relu": jax.nn.relu}[activation]
+
+    def ln(h, g, b):
+        mu = jnp.mean(h, -1, keepdims=True)
+        var = jnp.var(h, -1, keepdims=True)
+        out = (h - mu) * jax.lax.rsqrt(var + epsilon)
+        return out * g + b
+
+    def f(xv, *flat):
+        it = iter(flat)
+        take = lambda: next(it)  # noqa: E731
+        h = xv
+        B, S, H = h.shape
+        mask = None
+        params = [[take() for _ in range(12)] for _ in range(L)]
+        if attn_mask is not None:
+            mask = take()
+        for (lng, lnb, qkvw, qkvb, lw, lb, flng, flnb, w1, b1, w2, b2) \
+                in params:
+            inp = ln(h, lng, lnb) if pre_layer_norm else h
+            # qkv weight layout [3, nh, d, H] when trans_qkvw (ref layout)
+            if trans_qkvw:
+                three, nh, d, _ = qkvw.shape
+                qkv = jnp.einsum("bsh,endh->bsend", inp, qkvw) + \
+                    qkvb.reshape(3, nh, d)
+                q, k, v = (qkv[:, :, i] for i in range(3))
+            else:
+                nh_d = qkvw.shape[-1] // 3
+                qkv = inp @ qkvw + qkvb
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                nh = 1  # flat heads
+                q = q.reshape(B, S, -1, qkvw.shape[-1] // 3 // 1)
+            scores = jnp.einsum("bqnd,bknd->bnqk", q, k) / (q.shape[-1] ** 0.5)
+            if mask is not None:
+                scores = scores + mask
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bnqk,bknd->bqnd", probs, v).reshape(B, S, H)
+            h = h + ctx @ lw + lb
+            inp2 = ln(h, flng, flnb) if pre_layer_norm else h
+            h = h + act(inp2 @ w1 + b1) @ w2 + b2
+        return h
+
+    flat = []
+    for i in range(L):
+        flat += [ln_scales[i], ln_biases[i], qkv_weights[i], qkv_biases[i],
+                 linear_weights[i], linear_biases[i], ffn_ln_scales[i],
+                 ffn_ln_biases[i], ffn1_weights[i], ffn1_biases[i],
+                 ffn2_weights[i], ffn2_biases[i]]
+    if attn_mask is not None:
+        flat.append(attn_mask)
+    return apply(f, x, *flat, op_name="fused_multi_transformer")

@@ -129,3 +129,121 @@ class FusedEcMoe(Layer):
         return F.fused_ec_moe(x, self.gate_weight, self.bmm_weight0,
                               self.bmm_bias0, self.bmm_weight1, self.bmm_bias1,
                               act_type=self.act_type)
+
+
+class FusedLinear(Layer):
+    """ref incubate/nn/layer/fused_linear.py — Linear whose matmul+bias
+    XLA emits as one fused op."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = [out_features, in_features] if transpose_weight else \
+            [in_features, out_features]
+        self.weight = self.create_parameter(shape=shape, attr=weight_attr)
+        self.bias = self.create_parameter(shape=[out_features],
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self._transpose)
+
+
+class FusedDropoutAdd(Layer):
+    """ref incubate/nn/layer/fused_dropout_add.py: dropout(x) + y fused."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F.fused_dropout_add(x, y, p=self.p, training=self.training,
+                                   mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """ref incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            shape=[embed_dim], attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(shape=[embed_dim], attr=None,
+                                             is_bias=True)
+
+    def forward(self, x, residual):
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self.epsilon,
+            training=self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """ref incubate/nn/layer/fused_transformer.py FusedMultiTransformer —
+    an L-layer pre-LN transformer stack executed as one fused dispatch."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, epsilon=1e-5, name=None, **kw):
+        super().__init__()
+        assert normalize_before, "post-LN fused stack not supported"
+        self.num_heads = num_heads
+        self.epsilon = epsilon
+        self.activation = activation
+        d = embed_dim // num_heads
+        mk = self.create_parameter
+        self.ln_scales = [mk([embed_dim], default_initializer=Constant(1.0))
+                          for _ in range(num_layers)]
+        self.ln_biases = [mk([embed_dim], is_bias=True)
+                          for _ in range(num_layers)]
+        self.qkv_weights = [mk([3, num_heads, d, embed_dim])
+                            for _ in range(num_layers)]
+        self.qkv_biases = [mk([3 * embed_dim], is_bias=True)
+                           for _ in range(num_layers)]
+        self.linear_weights = [mk([embed_dim, embed_dim])
+                               for _ in range(num_layers)]
+        self.linear_biases = [mk([embed_dim], is_bias=True)
+                              for _ in range(num_layers)]
+        self.ffn_ln_scales = [mk([embed_dim],
+                                 default_initializer=Constant(1.0))
+                              for _ in range(num_layers)]
+        self.ffn_ln_biases = [mk([embed_dim], is_bias=True)
+                              for _ in range(num_layers)]
+        self.ffn1_weights = [mk([embed_dim, dim_feedforward])
+                             for _ in range(num_layers)]
+        self.ffn1_biases = [mk([dim_feedforward], is_bias=True)
+                            for _ in range(num_layers)]
+        self.ffn2_weights = [mk([dim_feedforward, embed_dim])
+                             for _ in range(num_layers)]
+        self.ffn2_biases = [mk([embed_dim], is_bias=True)
+                            for _ in range(num_layers)]
+        for i, group in enumerate((
+                self.ln_scales, self.ln_biases, self.qkv_weights,
+                self.qkv_biases, self.linear_weights, self.linear_biases,
+                self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+                self.ffn1_biases, self.ffn2_weights, self.ffn2_biases)):
+            for li, p in enumerate(group):
+                self.add_parameter(f"p{i}_{li}", p)
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        return F.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            epsilon=self.epsilon, attn_mask=attn_mask, cache_kvs=caches,
+            time_step=time_step, activation=self.activation,
+            training=self.training)

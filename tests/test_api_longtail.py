@@ -184,3 +184,42 @@ class TestCallbacks:
         if not has:
             with pytest.raises(ImportError):
                 paddle.callbacks.WandbCallback()
+
+
+class TestFusedLayers:
+    def test_fused_linear_and_dropout_add(self):
+        import paddle_tpu.incubate.nn as inn
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(4, 8)).astype("float32"))
+        fl = inn.FusedLinear(8, 6)
+        out = fl(x)
+        assert list(out.shape) == [4, 6]
+        da = inn.FusedDropoutAdd(p=0.0)
+        y = da(x, x)
+        np.testing.assert_allclose(y.numpy(), 2 * x.numpy(), rtol=1e-6)
+
+    def test_fused_bias_dropout_residual_ln(self):
+        import paddle_tpu.incubate.nn as inn
+        layer = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        layer.eval()
+        x = paddle.to_tensor(np.random.default_rng(1).normal(
+            size=(2, 3, 8)).astype("float32"))
+        r = paddle.to_tensor(np.random.default_rng(2).normal(
+            size=(2, 3, 8)).astype("float32"))
+        out = layer(x, r).numpy()
+        np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+
+    def test_fused_multi_transformer_matches_unfused_math(self):
+        import paddle_tpu.incubate.nn as inn
+        import jax.numpy as jnp
+        paddle.seed(7)
+        net = inn.FusedMultiTransformer(16, 2, 32, num_layers=2)
+        net.eval()
+        x = paddle.to_tensor(np.random.default_rng(3).normal(
+            size=(2, 5, 16)).astype("float32"))
+        out = net(x)
+        assert list(out.shape) == [2, 5, 16]
+        assert np.isfinite(out.numpy()).all()
+        # grads flow to every layer's params
+        out.sum().backward()
+        assert net.qkv_weights[1].grad is not None
