@@ -123,3 +123,12 @@ def get_flags(flags=None):
 def set_flags(flags):
     from . import flags as _flags
     return _flags.set_flags(flags)
+
+
+# bind the remaining reference tensor_method_func names as Tensor methods
+# (they live outside tensor/math|manipulation|... and need the full
+# namespace assembled first)
+import sys as _sys
+from .tensor import install_method_parity as _imp
+_imp(_sys.modules[__name__])
+del _imp, _sys

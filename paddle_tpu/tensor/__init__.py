@@ -162,3 +162,24 @@ def _install_tensor_methods():
 
 
 _install_tensor_methods()
+
+
+# The reference binds every `tensor_method_func` name as a Tensor method
+# (ref python/paddle/tensor/__init__.py). Most install above; these live
+# in other modules (extras/creation/framework) and are attached once the
+# top-level package finishes importing (paddle_tpu/__init__.py calls this).
+_REF_METHOD_STRAYS = [
+    "add_n", "broadcast_shape", "broadcast_tensors", "cdist",
+    "create_parameter", "create_tensor", "cumulative_trapezoid", "diff",
+    "frexp", "i0e", "i1e", "increment", "logcumsumexp", "logit",
+    "multiplex", "polar", "polygamma", "reverse", "scatter_nd", "sgn",
+    "shard_index", "take", "tensordot", "trapezoid", "unflatten", "vander",
+    "vsplit",
+]
+
+
+def install_method_parity(namespace):
+    for n in _REF_METHOD_STRAYS:
+        fn = getattr(namespace, n, None)
+        if fn is not None and not hasattr(Tensor, n):
+            setattr(Tensor, n, fn)
