@@ -7,18 +7,38 @@ Prints ONE JSON line:
    "vs_baseline": mfu / 0.45, ...}
 vs_baseline compares achieved MFU against the 45% north-star (BASELINE.json).
 
-Runs the flagship hybrid train step (scan-over-layers, remat, pallas flash
-attention, bf16 compute, fused AdamW, donated buffers). Falls back to smaller
-configs on OOM; CPU gets a tiny config so the line always prints.
+Architecture (round-5 rework — four rounds of TPU evidence were lost to
+tunnel outages / timeouts):
+
+  parent (this process, NEVER imports jax, so it can always handle signals)
+    └─ TPU child: runs the config ladder SMALLEST FIRST, appending one full
+       result JSON line to a results file after EVERY successful config.
+       The first line lands within one small-config compile (warm
+       .jax_cache: ~2 min), then bigger configs upgrade it in place.
+    └─ CPU child: tiny config, only if the TPU child produced nothing.
+
+The parent prints the best captured result (highest MFU) exactly once: at
+normal completion, at its own deadline (BENCH_TOTAL_BUDGET_S, default 1680s
+— inside the driver's 30-min cap), or from a SIGTERM/SIGINT handler if the
+driver kills it first. A TPU child that hangs claiming the chip is orphaned,
+never killed (killing mid-claim wedges the tunnel for the next client).
 """
 from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
+import tempfile
 import time
 
-import numpy as np
+_T0 = time.time()
+
+
+def _log(msg):
+    sys.stderr.write(f"[bench +{time.time() - _T0:7.1f}s] {msg}\n")
+    sys.stderr.flush()
 
 
 def peak_flops_bf16(device_kind: str) -> float:
@@ -41,13 +61,9 @@ def model_flops_per_token(cfg, seq_len):
     return 6 * n_params + attn, n_params
 
 
-_T0 = time.time()
-
-
-def _log(msg):
-    sys.stderr.write(f"[bench +{time.time() - _T0:7.1f}s] {msg}\n")
-    sys.stderr.flush()
-
+# --------------------------------------------------------------------------
+# child side: actually runs configs (imports jax)
+# --------------------------------------------------------------------------
 
 def run(model_name, batch, seq, steps=10, warmup=2, use_flash=True):
     import jax
@@ -70,11 +86,15 @@ def run(model_name, batch, seq, steps=10, warmup=2, use_flash=True):
     # reference's ZeRO moment sharding across a GPU pod.
     _, n_params = model_flops_per_token(cfg, seq)
     moment_dtype = "bfloat16" if (on_tpu and n_params > 1.0e9) else "float32"
+    # 2.7B+: even bf16 moments + bf16 params exceed 16G HBM — stream the
+    # moments from pinned host memory instead (fleet stage-3 offload analog)
+    offload = bool(on_tpu and n_params > 2.0e9)
     opt = paddle.optimizer.AdamW(2e-4, grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0),
                                  moment_dtype=moment_dtype)
     param_dtype = jnp.bfloat16 if on_tpu else jnp.float32
-    _log(f"{model_name} bs={batch} seq={seq}: init params...")
-    step = HybridTrainStep(cfg, opt, param_dtype=param_dtype)
+    _log(f"{model_name} bs={batch} seq={seq}: init params"
+         f"{' (moments offloaded to host)' if offload else ''}...")
+    step = HybridTrainStep(cfg, opt, param_dtype=param_dtype, offload=offload)
     key = jax.random.key(0)
     ids = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size, jnp.int32)
 
@@ -98,23 +118,7 @@ def run(model_name, batch, seq, steps=10, warmup=2, use_flash=True):
     peak = peak_flops_bf16(getattr(dev, "device_kind", "unknown"))
     mfu = tokens_per_sec * fpt / peak
     attn = "pallas" if cfg.use_flash else "blockwise"
-    # step-time breakdown: time the forward alone (shares param buffers),
-    # the remainder is backward(+remat recompute)+optimizer
-    breakdown = None
-    if on_tpu and os.environ.get("BENCH_BREAKDOWN", "1") != "0":
-        try:
-            _log("breakdown: forward-only timing...")
-            l = step.loss_only(ids)
-            jax.device_get(l)
-            t0 = time.perf_counter()
-            for _ in range(max(steps // 2, 3)):
-                l = step.loss_only(ids)
-            jax.device_get(l)
-            fwd_s = (time.perf_counter() - t0) / max(steps // 2, 3)
-            breakdown = {"fwd_s": round(fwd_s, 4),
-                         "bwd_opt_s": round(dt - fwd_s, 4)}
-        except Exception as e:  # noqa: BLE001 — breakdown is best-effort
-            _log(f"breakdown probe failed: {str(e)[:120]}")
+    import numpy as np
     return {
         "metric": f"GPT pretrain tokens/sec/chip ({model_name}, seq={seq}, "
                   f"bs={batch}, bf16+remat+attn={attn}, 1 chip)",
@@ -127,171 +131,45 @@ def run(model_name, batch, seq, steps=10, warmup=2, use_flash=True):
         "n_params": n_params,
         "attention": attn,
         "device": getattr(dev, "device_kind", str(dev)),
+        "backend": jax.default_backend(),
         "peak_flops_assumed": peak,
-        **({"breakdown": breakdown} if breakdown else {}),
     }
 
 
-def probe_backend():
-    """Decide which backend to use WITHOUT wedging the whole bench.
+def build_groups(on_tpu):
+    """Config ladder, SMALLEST FIRST so the first result line lands fast.
 
-    TPU plugin init can fail fast (UNAVAILABLE) or hang (a dead client's
-    chip claim takes minutes to expire server-side). Round-3 lesson: ONE
-    600s probe then permanent cpu fallback threw the round's hardware
-    evidence away over a transient wedge. Now: a single claimant child at a
-    time (two concurrent clients would contend for the chip), waited on in
-    60s slices across a long window (BENCH_PROBE_TIMEOUT_S, default 1800s —
-    the var keeps its old meaning of total probe budget). A hung child is
-    simply waited on — the claim resolves server-side and the child then
-    finishes on its own; a child that exits with an error is relaunched
-    after a short backoff. cpu fallback only when the window is exhausted.
-    """
-    import subprocess
-    import tempfile
-    window = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "1800"))
-    code = ("import jax; d = jax.devices()[0]; "
-            "print('BACKEND=' + jax.default_backend())")
-    t0 = time.time()
-    child = None
-    out_f = None
-    attempt = 0
-    fast_fails = 0
-    try:
-        while time.time() - t0 < window:
-            if child is None:
-                attempt += 1
-                _log(f"backend probe attempt {attempt} "
-                     f"({window - (time.time() - t0):.0f}s left)...")
-                out_f = tempfile.NamedTemporaryFile(
-                    "w+", prefix="bench_probe_", delete=False)
-                launched = time.time()
-                child = subprocess.Popen([sys.executable, "-c", code],
-                                         stdout=out_f,
-                                         stderr=subprocess.STDOUT)
-            try:
-                rc = child.wait(timeout=min(
-                    60.0, max(1.0, window - (time.time() - t0))))
-            except subprocess.TimeoutExpired:
-                continue  # still claiming; keep waiting on the SAME child
-            out_f.seek(0)
-            backend = None
-            tail = []
-            for line in out_f:
-                tail.append(line.rstrip())
-                if line.startswith("BACKEND="):
-                    backend = line.split("=", 1)[1].strip()
-            out_f.close()
-            os.unlink(out_f.name)
-            out_f = None
-            if backend is not None:
-                _log(f"backend probe succeeded: {backend}")
-                return backend
-            _log(f"probe child exited rc={rc} without a backend; "
-                 f"output tail: {' | '.join(tail[-3:])[:400]}")
-            # A fast non-zero exit is deterministic breakage, not a wedge —
-            # don't burn the whole window relaunching it.
-            if time.time() - launched < 30.0:
-                fast_fails += 1
-                if fast_fails >= 3:
-                    _log("3 consecutive fast failures; falling back to cpu")
-                    return None
-            else:
-                fast_fails = 0
-            child = None
-            time.sleep(min(15.0, max(0.0, window - (time.time() - t0))))
-    except Exception as e:  # noqa: BLE001  (the JSON line must always print)
-        _log(f"backend probe failed: {e}")
-        return None
-    finally:
-        # Never kill a TPU-attached child (killing mid-claim wedges the
-        # tunnel); if one is still claiming at window end, orphan it — it
-        # exits on its own once the claim resolves (it holds its own
-        # inherited fd, so the parent's handle closes unconditionally).
-        if out_f is not None:
-            out_f.close()
-            if child is None or child.poll() is not None:
-                try:
-                    os.unlink(out_f.name)
-                except OSError:
-                    pass
-            else:
-                _log("orphaning still-blocked probe child (exits on its own)")
-    _log(f"backend probe window ({window:.0f}s) exhausted after "
-         f"{attempt} attempts; falling back to cpu")
-    return None
-
-
-def main():
-    backend = probe_backend()
-    if backend is None:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    if backend is None:
-        # jax.config.update is the only mechanism that reliably forces cpu
-        # here (the plugin's .pth hook overrides env vars). If it fails we
-        # must not risk initializing the wedged TPU backend — emit the
-        # fallback line and stop.
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception as e:  # noqa: BLE001
-            _log(f"could not force cpu platform ({e}); aborting")
-            print(json.dumps({"metric": "GPT pretrain tokens/sec/chip",
-                              "value": 0.0, "unit": "tokens/s/chip",
-                              "vs_baseline": 0.0,
-                              "error": f"cpu fallback unavailable: {e}"}))
-            return
-    # persistent XLA compilation cache: the driver's end-of-round bench run
-    # hits warm artifacts instead of paying the 1.3B-scan compile again
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:
-        pass
-    try:
-        on_tpu = jax.default_backend() == "tpu"
-    except Exception as e:  # noqa: BLE001
-        _log(f"default_backend() raised ({e}); assuming cpu")
-        on_tpu = False
-    result = run_ladder(build_attempts(on_tpu),
-                        lambda m, b, s, f: run(
-                            m, b, s, steps=10 if on_tpu else 2,
-                            warmup=2 if on_tpu else 1, use_flash=f),
-                        log=_log, cleanup=_free_device_memory)
-    if not on_tpu:
-        # honest metadata for the fallback case: point at the committed
-        # on-hardware measurements from earlier in the round
-        result["note"] = ("cpu fallback (TPU tunnel unavailable at capture "
-                          "time); measured-on-TPU evidence for this round "
-                          "is committed in TPU_SMOKE.log "
-                          "(gpt3-1.3B bs8 seq2048: 9838 tok/s, 48.5% MFU)")
-    print(json.dumps(result))
-
-
-def build_attempts(on_tpu):
-    """Fallback ladder: per config, pallas flash first, then the blockwise
+    Each group is one (model, batch, seq) config with its attention
+    variants in preference order: pallas flash first, then the blockwise
     XLA attention (a kernel regression must never zero the round's perf
-    evidence again — round-2 lesson), then smaller batch / smaller model."""
+    evidence — round-2 lesson). Within a group the first success wins and
+    the child moves on to the next (bigger) group to upgrade the result.
+    """
     if not on_tpu:
-        # cpu fallback keeps the JSON line printing; the round's real-TPU
-        # measurements (when the tunnel was up) live in TPU_SMOKE.log
-        return [("gpt3-125M", 2, 256, False)]
-    ladder = []
-    for model_name, batch, seq in [("gpt3-1.3B", 8, 2048),
-                                   ("gpt3-1.3B", 4, 2048),
-                                   ("gpt3-760M", 8, 2048),
-                                   ("gpt3-345M", 8, 2048)]:
-        ladder.append((model_name, batch, seq, True))   # pallas flash
-        ladder.append((model_name, batch, seq, False))  # blockwise XLA
-    return ladder
+        return [[("gpt3-125M", 2, 256, False)]]
+    groups = []
+    for model_name, batches, seq in [("gpt3-345M", [8], 2048),
+                                     ("gpt3-760M", [8], 2048),
+                                     # bs4 is an OOM fallback variant of the
+                                     # same group, not a separate group — a
+                                     # bs8 success must not burn budget on it
+                                     ("gpt3-1.3B", [8, 4], 2048),
+                                     # stretch: host-offloaded moments (run()
+                                     # auto-enables offload > 2e9 params)
+                                     ("gpt3-2.7B", [4], 2048)]:
+        group = []
+        for batch in batches:
+            group.append((model_name, batch, seq, True))   # pallas flash
+            group.append((model_name, batch, seq, False))  # blockwise XLA
+        groups.append(group)
+    return groups
 
 
 def _free_device_memory():
     """Delete every live device array between ladder attempts: a failed
     attempt leaves its params resident (the exception frame pins them) and
-    OOMs every config after it — the round-3 1.3B cascade."""
+    OOMs every config after it — the round-3 1.3B cascade. Also run between
+    SUCCESSFUL configs so the next (bigger) model starts from empty HBM."""
     import gc
     import jax
     gc.collect()
@@ -304,28 +182,252 @@ def _free_device_memory():
     gc.collect()
 
 
-def run_ladder(attempts, runner, log=lambda m: None, cleanup=None):
-    """Try each (model, batch, seq, use_flash) until one produces a result;
-    the returned dict records which attention path actually ran."""
+def run_groups(groups, runner, emit, log=lambda m: None, cleanup=None,
+               deadline=None):
+    """Walk the ladder smallest->largest. Within a group, first success
+    wins; every success is emit()ed immediately (progressive evidence).
+    Returns the best result seen (highest mfu, then value)."""
+    best = None
     last_err = None
-    for model_name, batch, seq, use_flash in attempts:
-        attn = "pallas" if use_flash else "blockwise"
-        try:
-            return runner(model_name, batch, seq, use_flash)
-        except Exception as e:  # OOM or compile failure: walk down the ladder
-            last_err = e
-            log(f"bench config {model_name} bs={batch} attn={attn} failed: "
-                f"{str(e)[:200]}")
+    for group in groups:
+        if deadline is not None and time.time() > deadline:
+            log("child deadline reached; stopping ladder")
+            break
+        for model_name, batch, seq, use_flash in group:
+            attn = "pallas" if use_flash else "blockwise"
+            try:
+                result = runner(model_name, batch, seq, use_flash)
+            except Exception as e:  # OOM or compile failure: next variant
+                last_err = e
+                log(f"bench config {model_name} bs={batch} attn={attn} "
+                    f"failed: {str(e)[:200]}")
+                if cleanup is not None:
+                    try:
+                        cleanup()
+                    except Exception as ce:  # noqa: BLE001
+                        log(f"inter-attempt cleanup failed: {ce}")
+                continue
+            emit(result)
+            if _better(result, best):
+                best = result
             if cleanup is not None:
                 try:
                     cleanup()
                 except Exception as ce:  # noqa: BLE001
-                    log(f"inter-attempt cleanup failed: {ce}")
-            continue
+                    log(f"inter-group cleanup failed: {ce}")
+            break  # group satisfied; move to the next (bigger) config
+    if best is not None:
+        return best
     return {"metric": "GPT pretrain tokens/sec/chip", "value": 0.0,
             "unit": "tokens/s/chip", "vs_baseline": 0.0,
-            "error": str(last_err)[:300]}
+            "error": str(last_err)[:300] if last_err else "no config ran"}
+
+
+def _better(a, b):
+    if b is None:
+        return True
+    ka = (a.get("mfu", 0.0) or 0.0, a.get("value", 0.0) or 0.0)
+    kb = (b.get("mfu", 0.0) or 0.0, b.get("value", 0.0) or 0.0)
+    return ka > kb
+
+
+def child_main(kind, results_path):
+    """Runs in a subprocess. kind: 'tpu' (default backend — the plugin
+    claims the chip) or 'cpu' (forced CPU platform)."""
+    if kind == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    if kind == "cpu":
+        try:
+            # jax.config.update is the only mechanism that reliably forces
+            # cpu (the TPU plugin's .pth hook overrides env vars).
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:  # noqa: BLE001
+            _log(f"could not force cpu platform ({e})")
+    # persistent XLA compilation cache: the driver's end-of-round bench run
+    # hits warm artifacts instead of paying the 1.3B-scan compile again
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+    t_claim = time.time()
+    backend = jax.default_backend()  # may block while claiming the chip
+    _log(f"child[{kind}]: backend={backend} "
+         f"(claim took {time.time() - t_claim:.1f}s)")
+    on_tpu = backend == "tpu"
+    if kind == "tpu" and not on_tpu:
+        # TPU init fell back to another platform (plugin failure). Exiting
+        # without results lets the parent keep retrying the real chip — a
+        # silent CPU number must never masquerade as the TPU result.
+        _log("child[tpu]: backend is not tpu; exiting for relaunch")
+        return 3
+
+    def emit(result):
+        with open(results_path, "a") as f:
+            f.write(json.dumps(result) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        _log(f"child[{kind}]: emitted {result.get('metric', '?')} "
+             f"value={result.get('value')} mfu={result.get('mfu')}")
+
+    deadline = None
+    budget = os.environ.get("BENCH_CHILD_BUDGET_S")
+    if budget:
+        deadline = time.time() + float(budget)
+    best = run_groups(build_groups(on_tpu),
+                      lambda m, b, s, f: run(m, b, s,
+                                             steps=10 if on_tpu else 2,
+                                             warmup=2 if on_tpu else 1,
+                                             use_flash=f),
+                      emit, log=_log, cleanup=_free_device_memory,
+                      deadline=deadline)
+    if best.get("value", 0.0) <= 0.0:
+        # total failure: surface the root-cause error in the results file so
+        # the final JSON carries it instead of a generic message
+        best.setdefault("backend", backend)
+        emit(best)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# parent side: pure python, signal-safe, never touches jax
+# --------------------------------------------------------------------------
+
+def _read_results(path):
+    results = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    results.append(json.loads(line))
+                except ValueError:
+                    pass
+    except OSError:
+        pass
+    return results
+
+
+def _best_of(results):
+    best = None
+    for r in results:
+        if _better(r, best):
+            best = r
+    return best
+
+
+class _Parent:
+    def __init__(self):
+        self.results_path = tempfile.NamedTemporaryFile(
+            prefix="bench_results_", suffix=".jsonl", delete=False).name
+        self.printed = False
+        self.child = None
+
+    def emit_best(self, note=None):
+        """Print the final JSON line exactly once."""
+        if self.printed:
+            return
+        self.printed = True
+        best = _best_of(_read_results(self.results_path))
+        if best is None:
+            best = {"metric": "GPT pretrain tokens/sec/chip", "value": 0.0,
+                    "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                    "error": "no config completed within the bench window"}
+        if note and "note" not in best:
+            best["note"] = note
+        print(json.dumps(best))
+        sys.stdout.flush()
+
+    def on_signal(self, signum, frame):
+        _log(f"parent got signal {signum}; emitting best-so-far")
+        self.emit_best(note="emitted from signal handler (driver timeout); "
+                            "result is the best config completed so far")
+        # Never kill a TPU-attached child (killing mid-claim wedges the
+        # tunnel); orphan it — it exits on its own once the claim resolves.
+        os._exit(0)
+
+    def launch(self, kind):
+        _log(f"launching {kind} child...")
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child", kind,
+             self.results_path],
+            stdout=sys.stderr, stderr=sys.stderr)
+
+
+def main():
+    parent = _Parent()
+    signal.signal(signal.SIGTERM, parent.on_signal)
+    signal.signal(signal.SIGINT, parent.on_signal)
+
+    total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "1680"))
+    t_end = _T0 + total_budget
+    # reserve a slice at the end for the CPU fallback if TPU yields nothing
+    cpu_reserve = float(os.environ.get("BENCH_CPU_RESERVE_S", "300"))
+
+    def _have_real_result():
+        return any(r.get("value", 0.0) > 0.0
+                   for r in _read_results(parent.results_path))
+
+    child = parent.launch("tpu")
+    fast_fails = 0
+    launched = time.time()
+    while True:
+        now = time.time()
+        # leave the reserve slice for cpu fallback only while we have nothing
+        deadline = t_end - (0 if _have_real_result() else cpu_reserve)
+        if now >= deadline:
+            if child.poll() is None:
+                _log("parent deadline; orphaning still-running TPU child")
+            break
+        try:
+            rc = child.wait(timeout=min(15.0, max(1.0, deadline - now)))
+        except subprocess.TimeoutExpired:
+            continue
+        # child exited; a value>0 line means real evidence was captured
+        # (error-only lines keep the retry loop going)
+        if _have_real_result():
+            _log(f"TPU child exited rc={rc} with results captured")
+            break
+        if rc != 0 and time.time() - launched < 30.0:
+            fast_fails += 1
+            if fast_fails >= 3:
+                _log("3 consecutive fast failures; giving up on TPU")
+                break
+        else:
+            fast_fails = 0
+        if time.time() >= deadline - 20.0:
+            break
+        _log(f"TPU child exited rc={rc} with no usable result; relaunching "
+             f"({deadline - time.time():.0f}s left)...")
+        time.sleep(10.0)
+        child = parent.launch("tpu")
+        launched = time.time()
+
+    if not _have_real_result():
+        # CPU fallback: honest metadata pointing at committed on-hardware
+        # measurements from earlier in the round
+        _log("no TPU result; running CPU fallback child...")
+        remaining = max(30.0, t_end - time.time() + 60.0)
+        cpu_child = parent.launch("cpu")
+        try:
+            cpu_child.wait(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            _log("CPU fallback child still running at budget end")
+        parent.emit_best(
+            note="cpu fallback (TPU tunnel unavailable at capture time); "
+                 "measured-on-TPU evidence for this round is committed in "
+                 "TPU_SMOKE.log")
+    else:
+        parent.emit_best()
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
+        sys.exit(child_main(sys.argv[2], sys.argv[3]))
     main()
