@@ -14,6 +14,7 @@ from .auto_parallel import (  # noqa: F401
     ProcessMesh, Shard, Replicate, Partial, shard_tensor, reshard, shard_layer,
     shard_optimizer, dtensor_from_fn, dtensor_from_local, to_static, DistModel,
 )
+from .auto_parallel_static import Engine, Strategy  # noqa: F401
 from .pipeline import pipeline_spmd, run_pipeline, PipelineLayer, LayerDesc  # noqa: F401
 from .ring_attention import (  # noqa: F401
     ring_attention, ring_attention_spmd, ulysses_attention, ulysses_attention_spmd,

@@ -1,0 +1,512 @@
+"""Semi-auto parallel static `Engine` — the reference's flagship entry point
+(ref: python/paddle/distributed/auto_parallel/static/engine.py:55 Engine,
+auto_parallel/strategy.py Strategy).
+
+The reference Engine builds a serial Program, plans a distribution
+(Planner), partitions + reshards it (Parallelizer), then drives it with the
+StandaloneExecutor. The TPU-native pipeline collapses the middle: the model's
+`shard_tensor` placements (dist_spec) ARE the plan, `jax.jit` over the mesh
+is partitioner+reshard (GSPMD inserts every collective the reshard pass
+would have emitted), and the compiled step is the executor. `Strategy`
+toggles map onto compile-time knobs:
+
+    amp            -> auto_cast tracing dtype / O2 param cast
+    recompute      -> jax.checkpoint on the loss closure (policy registry)
+    gradient_merge -> accumulate_steps fused into the step (lax.cond)
+    sharding       -> optimizer-slot ZeRO axis (+ FSDP specs at stage 3)
+    pipeline       -> microbatched scan schedule (flagship GPT path)
+
+Two backends behind one API:
+  * any `nn.Layer`       -> jit.TrainStep (generic SPMD step)
+  * a GPT `GPTConfig`    -> models.gpt_hybrid.HybridTrainStep (the flagship
+                            TP x PP x DP x ZeRO path), so `Engine.fit` drives
+                            the same program the perf work tunes.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor_impl import Tensor
+from . import env
+
+
+class _Config:
+    """Attribute bag mirroring the reference's BaseConfig sub-configs
+    (ref: auto_parallel/strategy.py:20)."""
+
+    def __init__(self, **defaults):
+        self._fields = list(defaults)
+        for k, v in defaults.items():
+            setattr(self, k, v)
+
+    def from_dict(self, d):
+        for k, v in (d or {}).items():
+            setattr(self, k, v)
+            if k not in self._fields:
+                self._fields.append(k)
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self._fields}
+
+    def get(self, k, d=None):
+        return getattr(self, k, d)
+
+    def __repr__(self):
+        return f"_Config({self.to_dict()})"
+
+
+class Strategy:
+    """Parallelization/optimization config (ref: auto_parallel/strategy.py:141).
+
+    >>> s = Strategy()
+    >>> s.amp.enable = True
+    >>> s.recompute.enable = True
+    >>> s.gradient_merge.enable, s.gradient_merge.k_steps = True, 4
+    """
+
+    def __init__(self, config=None):
+        self.auto_mode = "semi"
+        self.seed = None
+        self.amp = _Config(enable=False, dtype="bfloat16", level="O1",
+                           custom_white_list=None, custom_black_list=None,
+                           init_loss_scaling=2.0 ** 16,
+                           use_dynamic_loss_scaling=True)
+        self.recompute = _Config(enable=False, checkpoints=None,
+                                 policy="full")
+        self.sharding = _Config(enable=False, stage=1, degree=-1,
+                                axis=None, offload=False)
+        self.gradient_merge = _Config(enable=False, k_steps=1, avg=True)
+        self.pipeline = _Config(enable=False, schedule_mode="1F1B",
+                                micro_batch_size=1, accumulate_steps=1,
+                                vpp_degree=1)
+        self.fused_passes = _Config(enable=False, fused_passes_list=[])
+        self.dataset = _Config(enable=False, num_shards=1)
+        if config:
+            for key, sub in dict(config).items():
+                cur = getattr(self, key, None)
+                if isinstance(cur, _Config):
+                    cur.from_dict(sub)
+                else:
+                    setattr(self, key, sub)
+
+    def to_dict(self):
+        out = {"auto_mode": self.auto_mode, "seed": self.seed}
+        for k in ("amp", "recompute", "sharding", "gradient_merge",
+                  "pipeline", "fused_passes", "dataset"):
+            out[k] = getattr(self, k).to_dict()
+        return out
+
+
+def _as_batch_items(batch):
+    if isinstance(batch, dict):
+        return list(batch.values())
+    if isinstance(batch, (list, tuple)):
+        return list(batch)
+    return [batch]
+
+
+def _split_sample(items, split):
+    """First `split` items feed the model, the rest are labels (ref:
+    engine.py _prepare_data_spec sample_split semantics). split=None: the
+    last item is the label when there are >= 2 items."""
+    if split is None:
+        split = len(items) - 1 if len(items) >= 2 else len(items)
+    return items[:split], items[split:]
+
+
+class Engine:
+    """Auto-parallel training/eval/predict driver (ref:
+    auto_parallel/static/engine.py:55).
+
+    >>> engine = auto.Engine(model, loss, optimizer, metrics, strategy=s)
+    >>> engine.fit(train_dataset, epochs=2, batch_size=64)
+    >>> engine.evaluate(valid_dataset, batch_size=64)
+    >>> engine.predict(test_dataset, batch_size=64)
+    >>> engine.save("./ckpt"); engine.load("./ckpt")
+    """
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None, mesh=None):
+        from ..models.gpt import GPTConfig
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        if metrics is None:
+            metrics = []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+            else [metrics]
+        self._cluster = cluster
+        self._strategy = strategy or Strategy()
+        self._mesh = mesh if mesh is not None else env.get_mesh()
+        self._mode = "train"
+        self._train_step = None
+        self._eval_fn = None
+        self._predict_fn = None
+        self._history = None
+        self._is_gpt_config = isinstance(model, GPTConfig)
+        if self._strategy.seed is not None:
+            from ..framework.random import seed as _seed
+            _seed(self._strategy.seed)
+
+    # -- build ---------------------------------------------------------------
+
+    def _accumulate_steps(self):
+        s = self._strategy
+        k = 1
+        if s.gradient_merge.enable:
+            k = max(k, int(s.gradient_merge.k_steps))
+        if s.pipeline.enable:
+            k = max(k, int(s.pipeline.accumulate_steps))
+        return k
+
+    def _sharding_axis(self):
+        s = self._strategy.sharding
+        if not s.enable or self._mesh is None:
+            return None
+        if s.axis:
+            return s.axis if s.axis in self._mesh.axis_names else None
+        for cand in ("sharding", "dp", "sdp"):
+            if cand in self._mesh.axis_names:
+                return cand
+        return self._mesh.axis_names[0] if self._mesh.axis_names else None
+
+    def _ensure_train_step(self):
+        if self._train_step is not None:
+            return
+        if self._optimizer is None:
+            raise ValueError("Engine needs an optimizer to train "
+                             "(ref engine.py: optimizer required in train)")
+        s = self._strategy
+        axis = self._sharding_axis()
+        if axis is not None:
+            self._optimizer._shard_opt_states_axis = axis
+        if self._is_gpt_config:
+            self._train_step = self._build_gpt_step()
+            return
+        model = self._model
+        if s.amp.enable and s.amp.level == "O2":
+            from .. import amp as _amp
+            model, self._optimizer = _amp.decorate(
+                model, self._optimizer, level="O2", dtype=s.amp.dtype)
+        from ..jit.train_step import TrainStep
+        self._train_step = TrainStep(
+            model, self._loss, self._optimizer, mesh=self._mesh,
+            remat=bool(s.recompute.enable),
+            accumulate_steps=self._accumulate_steps())
+
+    def _build_gpt_step(self):
+        """Flagship path: Strategy -> HybridTrainStep knobs. The model IS the
+        GPTConfig; pipeline/recompute/amp map onto the hybrid step's config
+        fields so Engine.fit drives the exact tuned program."""
+        from ..models.gpt_hybrid import HybridTrainStep
+        s = self._strategy
+        cfg = self._model
+        if s.recompute.enable:
+            cfg.remat = True
+            if s.recompute.policy and s.recompute.policy != "full":
+                cfg.remat_policy = s.recompute.policy
+        if s.amp.enable:
+            cfg.compute_dtype = s.amp.dtype
+        if s.sharding.enable and s.sharding.offload:
+            self._optimizer._offload_opt_states = True
+        if s.gradient_merge.enable and not s.pipeline.enable:
+            import warnings
+            warnings.warn(
+                "Strategy.gradient_merge on the flagship GPT path requires "
+                "pipeline microbatching (pipeline.accumulate_steps); the "
+                "k_steps setting is not applied to HybridTrainStep")
+        num_micro = 1
+        if s.pipeline.enable:
+            cfg.pp_schedule = {"1F1B": "1f1b", "FThenB": "gpipe",
+                               "VPP": "1f1b"}.get(
+                                   s.pipeline.schedule_mode, "1f1b")
+            if s.pipeline.vpp_degree > 1:
+                cfg.pp_interleave = int(s.pipeline.vpp_degree)
+            num_micro = max(int(s.pipeline.accumulate_steps), 1)
+        zero_stage = int(s.sharding.stage) if s.sharding.enable else 1
+        return HybridTrainStep(
+            self._model, self._optimizer, mesh=self._mesh,
+            num_microbatches=num_micro,
+            seed=self._strategy.seed or 0, zero_stage=zero_stage)
+
+    def _make_loader(self, data, batch_size, collate_fn=None, shuffle=False):
+        from ..io import DataLoader
+        if data is None:
+            return None
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            return data  # already an iterable loader
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          collate_fn=collate_fn, drop_last=True)
+
+    # -- train ---------------------------------------------------------------
+
+    def fit(self, train_data, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, save_dir=None,
+            save_freq=1, valid_data=None, valid_freq=1,
+            valid_sample_split=None, valid_steps=None, collate_fn=None,
+            callbacks=None, verbose=2, nvprof_range=None):
+        """ref: engine.py:854 fit. Returns a history dict of per-epoch logs."""
+        self._mode = "train"
+        loader = self._make_loader(train_data, batch_size,
+                                   collate_fn=collate_fn, shuffle=True)
+        history = {"loss": []}
+        for epoch in range(epochs):
+            losses = []
+            for step_i, batch in enumerate(loader):
+                if steps_per_epoch is not None and step_i >= steps_per_epoch:
+                    break
+                loss = self.run(batch, mode="train",
+                                sample_split=train_sample_split)
+                losses.append(float(np.asarray(loss)))
+                if verbose and log_freq and (step_i + 1) % log_freq == 0:
+                    print(f"epoch {epoch} step {step_i + 1}: "
+                          f"loss {losses[-1]:.6f}")
+            epoch_loss = float(np.mean(losses)) if losses else float("nan")
+            history["loss"].append(epoch_loss)
+            if verbose:
+                print(f"epoch {epoch}: loss {epoch_loss:.6f}")
+            if valid_data is not None and (epoch + 1) % valid_freq == 0:
+                logs = self.evaluate(valid_data, batch_size=batch_size,
+                                     steps=valid_steps,
+                                     valid_sample_split=valid_sample_split,
+                                     verbose=0)
+                for k, v in logs.items():
+                    history.setdefault("val_" + k, []).append(v)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, f"epoch{epoch}"))
+        self._history = history
+        return history
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, collate_fn=None, callbacks=None, verbose=2):
+        """ref: engine.py:1025 evaluate. Returns {"loss": ..., metric: ...}."""
+        self._mode = "eval"
+        loader = self._make_loader(valid_data, batch_size,
+                                   collate_fn=collate_fn)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step_i, batch in enumerate(loader):
+            if steps is not None and step_i >= steps:
+                break
+            items = [self._to_array(x) for x in _as_batch_items(batch)]
+            inputs, labels = _split_sample(items, valid_sample_split)
+            loss, outs = self._run_eval(tuple(inputs), tuple(labels))
+            losses.append(float(np.asarray(loss)))
+            self._update_metrics(outs, labels)
+        logs = {"loss": float(np.mean(losses)) if losses else float("nan")}
+        for m in self._metrics:
+            logs[m.name() if callable(getattr(m, "name", None)) else str(m)] \
+                = m.accumulate()
+        if verbose:
+            print("eval:", logs)
+        return logs
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1,
+                steps=None, collate_fn=None, callbacks=None, verbose=2):
+        """ref: engine.py:1136 predict. Returns the list of per-batch
+        forward outputs (numpy)."""
+        self._mode = "predict"
+        loader = self._make_loader(test_data, batch_size,
+                                   collate_fn=collate_fn)
+        outputs = []
+        for step_i, batch in enumerate(loader):
+            if steps is not None and step_i >= steps:
+                break
+            items = [self._to_array(x) for x in _as_batch_items(batch)]
+            inputs, _ = _split_sample(items, test_sample_split)
+            out = self._run_forward(tuple(inputs))
+            outputs.append(jax.tree_util.tree_map(
+                lambda a: np.asarray(jax.device_get(a)), out))
+        return outputs
+
+    # -- single-step execution (ref: engine.py:1376 run) ---------------------
+
+    def run(self, data=None, feed=None, fetch_list=None, mode=None,
+            sample_split=None):
+        mode = mode or self._mode
+        items = [self._to_array(x) for x in _as_batch_items(
+            data if data is not None else feed)]
+        inputs, labels = _split_sample(items, sample_split)
+        if mode == "train":
+            self._ensure_train_step()
+            s = self._strategy
+            if self._is_gpt_config:
+                return self._train_step(inputs[0])
+            if s.amp.enable and s.amp.level in ("O1", "OD"):
+                from .. import amp as _amp
+                with _amp.auto_cast(level=s.amp.level, dtype=s.amp.dtype,
+                                    custom_white_list=s.amp.custom_white_list,
+                                    custom_black_list=s.amp.custom_black_list):
+                    return self._train_step(tuple(inputs), tuple(labels))
+            return self._train_step(tuple(inputs), tuple(labels))
+        if mode == "eval":
+            loss, _ = self._run_eval(tuple(inputs), tuple(labels))
+            return loss
+        return self._run_forward(tuple(inputs))
+
+    def _to_array(self, x):
+        if isinstance(x, Tensor):
+            return x._data
+        return jnp.asarray(x)
+
+    def _run_eval(self, inputs, labels):
+        if self._is_gpt_config:
+            self._ensure_train_step()
+            return self._train_step.loss_only(inputs[0]), None
+        if self._eval_fn is None:
+            self._ensure_train_step()
+            # trigger compile of the train path lazily only if never trained;
+            # eval shares its param capture
+            if self._train_step._jitted is None:
+                # params exist pre-compile; build_eval needs sample shapes
+                self._train_step._sample_inputs = inputs
+                self._train_step._sample_labels = labels
+            self._eval_fn = self._train_step.build_eval()
+        ts = self._train_step
+        return self._eval_fn(ts._params, ts._buffers, inputs, labels)
+
+    def _run_forward(self, inputs):
+        if self._is_gpt_config:
+            from ..models.gpt_hybrid import gpt_forward
+            self._ensure_train_step()
+            ts = self._train_step
+            return gpt_forward(ts.params, inputs[0], self._model,
+                               ts.mesh, ts.num_microbatches)
+        if self._predict_fn is None:
+            self._ensure_train_step()
+            from ..jit.functional import functional_call
+
+            def fwd(params, buffers, ins):
+                out, _ = functional_call(self._model, params, buffers, ins)
+                return out
+            self._predict_fn = jax.jit(fwd)
+        ts = self._train_step
+        return self._predict_fn(ts._params, ts._buffers, inputs)
+
+    def _update_metrics(self, outs, labels):
+        if outs is None or not self._metrics:
+            return
+        from ..framework import state as _st
+        with _st.functional_trace():
+            out_t = jax.tree_util.tree_map(Tensor, outs)
+            lab_t = [Tensor(l) for l in labels]
+            for m in self._metrics:
+                if hasattr(m, "compute"):
+                    r = m.compute(out_t if not isinstance(out_t, (list, tuple))
+                                  else out_t[0], *lab_t)
+                    m.update(np.asarray(r._data if isinstance(r, Tensor)
+                                        else r))
+                else:
+                    m.update(out_t, lab_t)
+
+    # -- io ------------------------------------------------------------------
+
+    def save(self, path, training=True):
+        """ref: engine.py:1621. Saves params (+ optimizer state when
+        training=True) via the checkpoint layer."""
+        from ..framework import io as fio
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if self._train_step is None:
+            state = {"params": {}, "step": 0}
+        elif self._is_gpt_config:
+            ts = self._train_step
+            host = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+                lambda a: np.asarray(jax.device_get(a)), tree)
+            state = {"params": host(ts._flat(ts.params)),
+                     "opt_state": host(ts.opt_state),
+                     "step": ts._step_count}
+        else:
+            state = self._train_step.state_for_checkpoint()
+        if not training:
+            state.pop("opt_state", None)
+        fio.save(state, path + ".pdparams")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        """ref: engine.py:1705."""
+        from ..framework import io as fio
+        state = fio.load(path + ".pdparams")
+        self._ensure_train_step()
+        if not load_optimizer:
+            state.pop("opt_state", None)
+        if self._is_gpt_config:
+            ts = self._train_step
+            flat = state["params"]
+            if isinstance(flat, dict) and set(flat) == set(ts._names):
+                ts.params = ts._unflat({n: jnp.asarray(a)
+                                        for n, a in flat.items()})
+            else:  # a full nested pytree saved by other tooling
+                ts.params = jax.tree_util.tree_map(jnp.asarray, flat)
+            if load_optimizer and "opt_state" in state:
+                ts.opt_state = jax.tree_util.tree_map(jnp.asarray,
+                                                      state["opt_state"])
+            if ts.mesh is not None:
+                ts._place()
+        else:
+            self._train_step.restore_from_checkpoint(
+                {**{"params": state.get("params", {}),
+                    "opt_state": state.get("opt_state",
+                                           self._train_step._opt_state),
+                    "buffers": state.get("buffers", {}),
+                    "step": state.get("step", 0)}})
+        return self
+
+    # -- introspection -------------------------------------------------------
+
+    def cost(self, inputs_spec=None, labels_spec=None, mode=None):
+        """XLA cost analysis of the compiled step (the reference estimates
+        via its cost model; here the compiler reports measured numbers).
+        Returns (flops_per_step, memory_analysis) — ref: engine.py:1757."""
+        if self._train_step is None or getattr(self._train_step, "_jitted",
+                                               None) is None:
+            return None, None
+        jitted = self._train_step._jitted
+        try:
+            if self._is_gpt_config:
+                return None, None
+            ts = self._train_step
+            lowered = jitted.lower(
+                ts._params, ts._opt_state, ts._buffers,
+                jnp.zeros((), jnp.float32), jax.random.key(0),
+                ts._sample_inputs, ts._sample_labels)
+            compiled = lowered.compile()
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            return ca.get("flops"), compiled.memory_analysis()
+        except Exception:  # noqa: BLE001 — introspection is best-effort
+            return None, None
+
+    @property
+    def main_program(self):
+        """HLO of the compiled train step (Program analog)."""
+        ts = self._train_step
+        if ts is None or getattr(ts, "_jitted", None) is None:
+            return None
+        return "<compiled XLA SPMD train step>"
+
+    @property
+    def serial_main_program(self):
+        return self.main_program
+
+    @property
+    def history(self):
+        return self._history
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def to_mode(self, mode):
+        self._mode = mode

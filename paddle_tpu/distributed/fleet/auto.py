@@ -1,0 +1,17 @@
+"""`paddle.distributed.fleet.auto` — user-facing auto-parallel namespace
+(ref: python/paddle/distributed/fleet/__init__.py exposes `auto` as the
+semi-auto API: Engine/Strategy plus the dygraph shard_* interface)."""
+from ..auto_parallel_static import Engine, Strategy  # noqa: F401
+from ..auto_parallel import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, reshard,
+    shard_layer, shard_optimizer, dtensor_from_fn, dtensor_from_local,
+    to_static, DistModel,
+)
+
+fetch = None  # the reference's fetch-collection hook has no XLA analog
+
+__all__ = [
+    "Engine", "Strategy", "ProcessMesh", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+    "dtensor_from_fn", "dtensor_from_local", "to_static", "DistModel",
+]

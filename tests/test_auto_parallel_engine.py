@@ -1,0 +1,249 @@
+"""auto_parallel static Engine (ref: auto_parallel/static/engine.py:55,
+strategy.py:141). Covers the generic nn.Layer backend (fit/evaluate/predict,
+Strategy toggles, save/load) and the flagship GPTConfig backend with loss
+parity vs a directly-driven HybridTrainStep on the 8-dev mesh."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import Engine, Strategy
+from paddle_tpu.distributed.fleet import auto
+from paddle_tpu.io import TensorDataset
+
+
+def _dataset(n=32, din=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, din).astype(np.float32)
+    w = rng.randn(din, 1).astype(np.float32)
+    y = x @ w + 0.1 * rng.randn(n, 1).astype(np.float32)
+    return TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+
+
+class TestStrategy:
+    def test_defaults(self):
+        s = Strategy()
+        assert s.auto_mode == "semi"
+        assert not s.amp.enable and not s.recompute.enable
+        assert s.gradient_merge.k_steps == 1
+        assert s.pipeline.schedule_mode == "1F1B"
+
+    def test_from_dict_and_to_dict(self):
+        s = Strategy({"amp": {"enable": True, "dtype": "float16"},
+                      "sharding": {"enable": True, "stage": 2}})
+        assert s.amp.enable and s.amp.dtype == "float16"
+        assert s.sharding.stage == 2
+        d = s.to_dict()
+        assert d["amp"]["enable"] is True and d["sharding"]["stage"] == 2
+
+    def test_exported_via_fleet_auto(self):
+        assert auto.Engine is Engine and auto.Strategy is Strategy
+
+
+class TestEngineLayer:
+    def test_fit_reduces_loss(self):
+        model = _mlp()
+        engine = Engine(model, nn.MSELoss(),
+                        paddle.optimizer.Adam(0.05,
+                                              parameters=model.parameters()))
+        hist = engine.fit(_dataset(), epochs=3, batch_size=8, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_evaluate_and_predict(self):
+        model = _mlp()
+        engine = Engine(model, nn.MSELoss(),
+                        paddle.optimizer.Adam(0.05,
+                                              parameters=model.parameters()))
+        engine.fit(_dataset(), epochs=2, batch_size=8, verbose=0)
+        logs = engine.evaluate(_dataset(seed=1), batch_size=8, verbose=0)
+        assert np.isfinite(logs["loss"])
+        outs = engine.predict(_dataset(seed=1), batch_size=8, verbose=0)
+        assert len(outs) == 4 and np.asarray(outs[0]).shape == (8, 1)
+
+    def test_strategy_recompute_and_gradient_merge(self):
+        model = _mlp()
+        s = Strategy({"recompute": {"enable": True},
+                      "gradient_merge": {"enable": True, "k_steps": 2}})
+        engine = Engine(model, nn.MSELoss(),
+                        paddle.optimizer.SGD(0.05,
+                                             parameters=model.parameters()),
+                        strategy=s)
+        hist = engine.fit(_dataset(), epochs=3, batch_size=8, verbose=0)
+        assert engine._train_step.accumulate_steps == 2
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_strategy_amp_o2_casts_params(self):
+        model = _mlp()
+        s = Strategy({"amp": {"enable": True, "level": "O2",
+                              "dtype": "bfloat16"}})
+        engine = Engine(model, nn.MSELoss(),
+                        paddle.optimizer.Adam(0.01,
+                                              parameters=model.parameters()),
+                        strategy=s)
+        engine.fit(_dataset(), epochs=1, batch_size=8, verbose=0)
+        dtypes = {p._data.dtype for _, p in model.named_parameters()}
+        assert dtypes == {jnp.dtype(jnp.bfloat16)}
+
+    def test_save_load_roundtrip(self):
+        model = _mlp()
+        engine = Engine(model, nn.MSELoss(),
+                        paddle.optimizer.Adam(0.05,
+                                              parameters=model.parameters()))
+        engine.fit(_dataset(), epochs=1, batch_size=8, verbose=0)
+        before = engine.evaluate(_dataset(seed=1), batch_size=8,
+                                 verbose=0)["loss"]
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "ckpt")
+            engine.save(path)
+            model2 = _mlp(seed=123)
+            engine2 = Engine(model2, nn.MSELoss(),
+                             paddle.optimizer.Adam(
+                                 0.05, parameters=model2.parameters()))
+            # engine2 needs shapes: run one eval batch then load
+            engine2.load(path)
+            after = engine2.evaluate(_dataset(seed=1), batch_size=8,
+                                     verbose=0)["loss"]
+        np.testing.assert_allclose(after, before, rtol=1e-4)
+
+    def test_run_single_batch(self):
+        model = _mlp()
+        engine = Engine(model, nn.MSELoss(),
+                        paddle.optimizer.Adam(0.05,
+                                              parameters=model.parameters()))
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        y = np.zeros((4, 1), np.float32)
+        loss = engine.run([x, y], mode="train")
+        assert np.isfinite(float(np.asarray(loss)))
+
+    def test_metrics_in_evaluate(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 8).astype(np.float32)
+        y = rng.randint(0, 3, (32, 1)).astype(np.int64)
+        ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+        engine = Engine(model, nn.CrossEntropyLoss(),
+                        paddle.optimizer.Adam(0.01,
+                                              parameters=model.parameters()),
+                        metrics=paddle.metric.Accuracy())
+        engine.fit(ds, epochs=1, batch_size=8, verbose=0)
+        logs = engine.evaluate(ds, batch_size=8, verbose=0)
+        assert "acc" in logs and 0.0 <= logs["acc"] <= 1.0
+
+
+@pytest.mark.usefixtures("devices8")
+class TestEngineGPT:
+    def test_gpt_engine_matches_hybrid_step(self):
+        """Engine-driven flagship GPT == directly-driven HybridTrainStep
+        (same seed, same mesh, same strategy knobs) — VERDICT r4 #2 gate."""
+        from paddle_tpu.models.gpt import GPTConfig
+        from paddle_tpu.models.gpt_hybrid import HybridTrainStep
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+        mesh = Mesh(devs, ("dp", "mp", "sharding"))
+
+        def small_cfg():
+            return GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                             num_heads=4, max_seq_len=32, ffn_mult=4,
+                             use_flash=False, compute_dtype="float32")
+
+        ids = np.random.RandomState(0).randint(0, 128, (4, 32),
+                                               dtype=np.int64)
+
+        ref_opt = paddle.optimizer.AdamW(1e-3)
+        ref = HybridTrainStep(small_cfg(), ref_opt, mesh=mesh, seed=0,
+                              zero_stage=1)
+        ref_losses = [float(np.asarray(jax.device_get(ref(ids))))
+                      for _ in range(3)]
+
+        s = Strategy()
+        engine = Engine(small_cfg(), None, paddle.optimizer.AdamW(1e-3),
+                        strategy=s, mesh=mesh)
+        eng_losses = [float(np.asarray(jax.device_get(
+            engine.run([ids], mode="train")))) for _ in range(3)]
+        np.testing.assert_allclose(eng_losses, ref_losses, rtol=1e-5)
+
+    def test_gpt_engine_strategy_pipeline_and_sharding(self):
+        """Strategy pipeline/sharding/recompute knobs reach the hybrid step
+        on a pp2 x dp2 x sharding2 mesh."""
+        from paddle_tpu.models.gpt import GPTConfig
+        from paddle_tpu.distributed import env
+
+        mesh = env.create_hybrid_mesh(dp=2, mp=1, pp=2, sharding=2, sp=1)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                        num_heads=4, max_seq_len=32, ffn_mult=4,
+                        use_flash=False, compute_dtype="float32")
+        s = Strategy({"pipeline": {"enable": True, "schedule_mode": "1F1B",
+                                   "accumulate_steps": 4},
+                      "sharding": {"enable": True, "stage": 1,
+                                   "axis": "sharding"},
+                      "recompute": {"enable": True}})
+        engine = Engine(cfg, None, paddle.optimizer.AdamW(1e-3),
+                        strategy=s, mesh=mesh)
+        ids = np.random.RandomState(0).randint(0, 128, (16, 32),
+                                               dtype=np.int64)
+        l0 = float(np.asarray(jax.device_get(engine.run([ids]))))
+        l1 = float(np.asarray(jax.device_get(engine.run([ids]))))
+        assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+        assert engine._train_step.num_microbatches == 4
+        assert engine._optimizer._shard_opt_states_axis == "sharding"
+
+
+@pytest.mark.usefixtures("devices8")
+def test_pp_bf16_on_cpu_raises_not_aborts():
+    """bf16 + pipeline crashes XLA's CPU backend (hard abort in
+    hlo_instruction.cc) — the framework must surface a catchable error."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models.gpt_hybrid import HybridTrainStep
+    from paddle_tpu.distributed import env
+
+    mesh = env.create_hybrid_mesh(dp=2, mp=1, pp=2, sharding=2, sp=1)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                    num_heads=4, max_seq_len=32, use_flash=False,
+                    compute_dtype="bfloat16")
+    step = HybridTrainStep(cfg, paddle.optimizer.AdamW(1e-3), mesh=mesh,
+                           num_microbatches=4)
+    ids = np.random.RandomState(0).randint(0, 128, (16, 32), dtype=np.int64)
+    with pytest.raises(ValueError, match="bfloat16"):
+        step(ids)
+
+
+@pytest.mark.usefixtures("devices8")
+def test_gpt_engine_save_load_roundtrip(tmp_path):
+    """Engine.save/load on the flagship GPTConfig backend (review fix)."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("dp", "mp", "sharding"))
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=32, ffn_mult=4,
+                    use_flash=False, compute_dtype="float32")
+    ids = np.random.RandomState(0).randint(0, 128, (4, 32), dtype=np.int64)
+    engine = Engine(cfg, None, paddle.optimizer.AdamW(1e-3), mesh=mesh)
+    engine.run([ids], mode="train")
+    path = str(tmp_path / "gpt_ckpt")
+    engine.save(path)
+    l_ref = float(np.asarray(jax.device_get(
+        engine._train_step.loss_only(ids))))
+
+    cfg2 = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=32, ffn_mult=4,
+                     use_flash=False, compute_dtype="float32")
+    engine2 = Engine(cfg2, None, paddle.optimizer.AdamW(1e-3), mesh=mesh)
+    engine2._ensure_train_step()
+    engine2.load(path)
+    l2 = float(np.asarray(jax.device_get(
+        engine2._train_step.loss_only(ids))))
+    np.testing.assert_allclose(l2, l_ref, rtol=1e-5)
