@@ -9,10 +9,22 @@ control flow: `lax.cond`, `lax.while_loop`, `lax.scan`.
 Semantics: every rewritten site calls a runtime helper that checks whether the
 condition/iterable is a jax tracer. Concrete values take the ordinary Python
 path (bit-identical eager semantics); traced values lower to the lax
-primitive. Unconvertible constructs (break/continue, early return inside a
-converted branch, global/nonlocal) are left as plain Python — fine eagerly,
-and under tracing they produce a ConversionError with guidance instead of a
-raw tracer-leak error.
+primitive.
+
+break/continue/early-return (ref: dy2static/break_continue_transformer.py:133,
+return_transformer.py) are rewritten into carried bool flags BEFORE the
+control-flow conversion: `break` -> `_jst_brkN = True`, `continue` ->
+`_jst_contN = True`, `return X` -> `_jst_retval = X; _jst_retflag = True`;
+statements after a flag-setter are wrapped in `if not flag:` guards, while
+conditions gain `and not (brk or retflag)` (for-loops freeze their carry
+instead — bounded trip count), and the function gets a single tail
+`return _jst_retval`. The `_jst_retval` carrier starts as None and is
+promoted to typed zeros on the untaken path (the reference's
+RETURN_NO_VALUE placeholder), so the lax carry structure stays stable.
+
+Still unconvertible (global/nonlocal, escapes inside try/with, loop else
+with escapes) are left as plain Python — fine eagerly; under tracing they
+produce a ConversionError with guidance instead of a raw tracer-leak error.
 
 Value-vs-object deviation (same as the reference): converted branches merge
 variables by value; `and`/`or` on tensors evaluate both operands.
@@ -138,7 +150,34 @@ def _check_statics(name, before, after, dyn_idx):
 # ---------------------------------------------------------------------------
 # runtime conversion helpers (targets of the AST rewrite)
 
-def convert_ifelse(pred, true_fn, false_fn):
+def _is_ret_name(n):
+    """Names whose carry slot may start undefined/None and become dynamic:
+    early-return value carriers and frozen-loop-var snapshots. These get
+    typed-zeros placeholders instead of the strict static check."""
+    return n.startswith(("_jst_ret", "__jst_ret", "_jst_lasti"))
+
+
+def _zeros_like_dyn(x):
+    d = _data_of(x)
+    return jnp.zeros(jnp.shape(d), jnp.result_type(d))
+
+
+def _promote_ret_slots(init, probe, names):
+    """Early-return value carriers (`_jst_retval`) start as None; when the
+    body turns them dynamic, replace the init slot with typed zeros so the
+    lax carry structure is stable (the reference's RETURN_NO_VALUE
+    placeholder, ref: dy2static/return_transformer.py)."""
+    if not names:
+        return tuple(init)
+    out = list(init)
+    for i, nm in enumerate(names):
+        if (i < len(probe) and _is_ret_name(nm)
+                and not _is_dynamic(out[i]) and _is_dynamic(probe[i])):
+            out[i] = _zeros_like_dyn(probe[i])
+    return tuple(out)
+
+
+def convert_ifelse(pred, true_fn, false_fn, names=None):
     """ref: convert_operators.py convert_ifelse."""
     if not _is_traced(pred):
         return true_fn() if _truth(pred) else false_fn()
@@ -147,6 +186,20 @@ def convert_ifelse(pred, true_fn, false_fn):
     if len(out_t) != len(out_f):
         raise ConversionError("converted if/else branches assign different "
                               "variable sets")
+    # early-return carriers: the branch that doesn't return provides typed
+    # zeros (never read — the retflag guard gates every read)
+    filled_t, filled_f = [], []
+    if names:
+        for i, nm in enumerate(names):
+            if not _is_ret_name(nm):
+                continue
+            t_dyn, f_dyn = _is_dynamic(out_t[i]), _is_dynamic(out_f[i])
+            if t_dyn and not f_dyn:
+                out_f[i] = _zeros_like_dyn(out_t[i])
+                filled_f.append(i)
+            elif f_dyn and not t_dyn:
+                out_t[i] = _zeros_like_dyn(out_f[i])
+                filled_t.append(i)
     # a variable bound in only one branch stays undefined after the cond
     # (ref: dy2static UndefinedVar) — reading it later raises clearly
     for i in range(len(out_t)):
@@ -162,6 +215,20 @@ def convert_ifelse(pred, true_fn, false_fn):
             "tensors; make both branches assign tensor values")
     _check_statics("if/else", out_t, out_f, dyn_t)
     pred_arr = jnp.asarray(_data_of(pred)).reshape(()).astype(bool)
+
+    def _with_fill(fn, filled, template):
+        if not filled:
+            return fn
+
+        def wrapped():
+            vals = list(fn())
+            for i in filled:
+                vals[i] = _zeros_like_dyn(template[i])
+            return tuple(vals)
+        return wrapped
+
+    true_fn = _with_fill(true_fn, filled_t, out_t)
+    false_fn = _with_fill(false_fn, filled_f, out_f)
     # branches are traced twice: the probe above (for structure/static
     # checks; its dynamic outputs are dead and XLA DCEs them) and inside
     # lax.cond so only ONE branch executes at runtime. Closing over the
@@ -189,7 +256,27 @@ def convert_ifelse_expr(pred, true_thunk, false_thunk):
     return Tensor(out) if isinstance(a, Tensor) or isinstance(b, Tensor) else out
 
 
-def convert_while_loop(cond_fn, body_fn, init):
+def _stop_requested(vals, names):
+    """Concrete break/return flag in a rewritten loop carry: the python-path
+    loops must actually STOP (an escape-rewritten `for` only freezes its
+    body; without this an unbounded iterable would be consumed forever)."""
+    if not names:
+        return False
+    for v, n in zip(vals, names):
+        if (n.startswith("_jst_brk") or n == _RETFLAG) \
+                and not _is_traced(v):
+            d = _data_of(v)
+            if isinstance(d, _Undefined):
+                continue
+            try:
+                if bool(d):
+                    return True
+            except TypeError:
+                continue
+    return False
+
+
+def convert_while_loop(cond_fn, body_fn, init, names=None):
     """ref: convert_operators.py convert_while_loop."""
     c0 = cond_fn(*init)
     if not _is_traced(c0) and not any(_is_traced(v) for v in init):
@@ -199,8 +286,9 @@ def convert_while_loop(cond_fn, body_fn, init):
             vals = tuple(body_fn(*vals))
             cond_v = cond_fn(*vals)
         return vals
-    extract, rebuild, dyn_idx = _pack(init)
     probe = tuple(body_fn(*init))
+    init = _promote_ret_slots(init, probe, names)
+    extract, rebuild, dyn_idx = _pack(init)
     _check_statics("while", init, probe, dyn_idx)
 
     def cond_w(dyn):
@@ -227,7 +315,7 @@ def convert_while_loop(cond_fn, body_fn, init):
     return rebuild(out_dyn)
 
 
-def convert_for_range(range_args, body_fn, init):
+def convert_for_range(range_args, body_fn, init, names=None):
     """`for i in range(...)` — python loop when bounds are concrete,
     lax.while_loop otherwise. Returns (final_i, vars)."""
     args = tuple(range_args)
@@ -245,13 +333,19 @@ def convert_for_range(range_args, body_fn, init):
                        int(_data_of(step))):
             vals = tuple(body_fn(i, *vals))
             i_final = i
+            if _stop_requested(vals, names):
+                break
         return i_final, vals
 
-    start = jnp.asarray(_data_of(start), jnp.int32)
-    stop = jnp.asarray(_data_of(stop), jnp.int32)
-    step = jnp.asarray(_data_of(step), jnp.int32)
-    extract, rebuild, dyn_idx = _pack(init)
+    # canonical python-int dtype (int64 under the package's x64 mode, so the
+    # counter matches what python ints in the body promote to)
+    idt = jnp.result_type(int)
+    start = jnp.asarray(_data_of(start), idt)
+    stop = jnp.asarray(_data_of(stop), idt)
+    step = jnp.asarray(_data_of(step), idt)
     probe = tuple(body_fn(0, *init))
+    init = _promote_ret_slots(init, probe, names)
+    extract, rebuild, dyn_idx = _pack(init)
     _check_statics("for", init, probe, dyn_idx)
 
     def cond_w(carry):
@@ -264,7 +358,7 @@ def convert_for_range(range_args, body_fn, init):
         return (i + step, out)
 
     init_dyn = extract(init)
-    specs = (jax.ShapeDtypeStruct((), jnp.int32),
+    specs = (jax.ShapeDtypeStruct((), idt),
              tuple(jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
                    for a in init_dyn))
     probe_c = jax.eval_shape(body_w, specs)
@@ -275,15 +369,16 @@ def convert_for_range(range_args, body_fn, init):
     return i_end - step, rebuild(out_dyn)
 
 
-def convert_for_iter(iterable, body_fn, init):
+def convert_for_iter(iterable, body_fn, init, names=None):
     """`for x in xs` — lax.scan over axis 0 for tensors, python otherwise.
     Returns (final_x, vars)."""
     data = _data_of(iterable)
     if isinstance(data, (jax.Array, jax.core.Tracer)) and jnp.ndim(data) > 0:
         wrap = isinstance(iterable, Tensor)
-        extract, rebuild, dyn_idx = _pack(init)
         x0 = Tensor(data[0]) if wrap else data[0]
         probe = tuple(body_fn(x0, *init))
+        init = _promote_ret_slots(init, probe, names)
+        extract, rebuild, dyn_idx = _pack(init)
         _check_statics("for", init, probe, dyn_idx)
 
         def step(dyn, x):
@@ -304,6 +399,8 @@ def convert_for_iter(iterable, body_fn, init):
     for x in iterable:
         vals = tuple(body_fn(x, *vals))
         x_final = x
+        if _stop_requested(vals, names):
+            break
     return x_final, vals
 
 
@@ -436,6 +533,328 @@ def _ends_with_return(body):
         and body[-1].value is not None
 
 
+# ---------------------------------------------------------------------------
+# escape rewrite: break/continue/early-return -> carried flags
+# (ref: dy2static/break_continue_transformer.py:133, return_transformer.py)
+
+_RETFLAG = "_jst_retflag"
+_RETVAL = "_jst_retval"
+
+
+class _CannotRewrite(Exception):
+    pass
+
+
+def _mk_assign(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value)
+
+
+def _mk_name(n):
+    return ast.Name(id=n, ctx=ast.Load())
+
+
+def _not_any(flags):
+    flags = sorted(flags)
+    test = _mk_name(flags[0]) if len(flags) == 1 else \
+        ast.BoolOp(op=ast.Or(), values=[_mk_name(f) for f in flags])
+    return ast.UnaryOp(op=ast.Not(), operand=test)
+
+
+def _contains_return(node):
+    """A Return in this statement's scope (not inside nested defs)."""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, n):
+            self.found = True
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+    v = V()
+    v.visit(node)
+    return v.found
+
+
+def _contains_assign_to(nodes, name):
+    for node in nodes:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return True
+    return False
+
+
+def _loop_has_escape(node):
+    """break/continue belonging to THIS loop, or a return anywhere in it."""
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_depth = 0
+            self.found = False
+
+        def visit_Break(self, n):
+            if self.loop_depth == 0:
+                self.found = True
+
+        visit_Continue = visit_Break
+
+        def visit_Return(self, n):
+            self.found = True
+
+        def visit_While(self, n):
+            self.loop_depth += 1
+            self.generic_visit(n)
+            self.loop_depth -= 1
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for s in node.body:
+        v.visit(s)
+    return v.found
+
+
+def _tail_returns_ok(stmts):
+    """True when every Return sits in tail position the existing machinery
+    already handles: last statement of the block, or a trailing If whose
+    branches are themselves all-tail (visit_If both_return)."""
+    if not stmts:
+        return True
+    *init, last = stmts
+    if any(_contains_return(s) for s in init):
+        return False
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        if not _contains_return(last):
+            return True
+        # both branches must be all-tail AND both must actually return
+        # (a fall-through branch would make this an early return)
+        if not last.body or not last.orelse:
+            return False
+        return _tail_returns_ok(last.body) and _tail_returns_ok(last.orelse) \
+            and _block_returns(last.body) and _block_returns(last.orelse)
+    return not _contains_return(last)
+
+
+def _block_returns(stmts):
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If) and last.body and last.orelse:
+        return _block_returns(last.body) and _block_returns(last.orelse)
+    return False
+
+
+class _EscapeRewriter(ast.NodeTransformer):
+    """Rewrites escapes to flags. Two modes:
+      * loops-only (function has only tail returns): each loop containing
+        break/continue is rewritten in place; unconvertible loops are left
+        as-is (python fallback).
+      * full (function has early returns): every `return X` becomes
+        `_jst_retval = X; _jst_retflag = True` with guards, and the function
+        gets flag inits at the top and one tail `return _jst_retval`."""
+
+    def __init__(self):
+        self.uid = 0
+        self.rewrite_returns = False
+
+    # -- entry ---------------------------------------------------------------
+    def rewrite(self, fdef):
+        self.rewrite_returns = not _tail_returns_ok(fdef.body)
+        if self.rewrite_returns:
+            body, _ = self._block(fdef.body, brk=None, cont=None)
+            fdef.body = [
+                _mk_assign(_RETFLAG, ast.Constant(value=False)),
+                _mk_assign(_RETVAL, ast.Constant(value=None)),
+            ] + body + [ast.Return(value=_mk_name(_RETVAL))]
+        else:
+            fdef.body = self._loops_only_block(fdef.body)
+        return fdef
+
+    def _loops_only_block(self, stmts):
+        out = []
+        for st in stmts:
+            if isinstance(st, (ast.While, ast.For)) and _loop_has_escape(st):
+                try:
+                    out.extend(self._loop(st))
+                except _CannotRewrite:
+                    out.append(st)  # python fallback (old behavior)
+                continue
+            if isinstance(st, ast.If):
+                st = ast.copy_location(ast.If(
+                    test=st.test, body=self._loops_only_block(st.body),
+                    orelse=self._loops_only_block(st.orelse)), st)
+            elif isinstance(st, (ast.While, ast.For)):
+                st = ast.copy_location(type(st)(
+                    **{**{f: getattr(st, f) for f in st._fields},
+                       "body": self._loops_only_block(st.body)}), st)
+            out.append(st)
+        return out
+
+    # -- full rewrite --------------------------------------------------------
+    def _block(self, stmts, brk, cont):
+        """Returns (new_stmts, flags set by them). brk/cont are the innermost
+        loop's flag names (None outside loops)."""
+        out = []
+        for idx, st in enumerate(stmts):
+            rest = stmts[idx + 1:]
+            if isinstance(st, ast.Break):
+                if brk is None:
+                    raise _CannotRewrite()
+                out.append(_mk_assign(brk, ast.Constant(value=True)))
+                return out, {brk}  # rest is unreachable
+            if isinstance(st, ast.Continue):
+                if cont is None:
+                    raise _CannotRewrite()
+                out.append(_mk_assign(cont, ast.Constant(value=True)))
+                return out, {cont}
+            if isinstance(st, ast.Return):
+                val = st.value if st.value is not None \
+                    else ast.Constant(value=None)
+                out.append(_mk_assign(_RETVAL, val))
+                out.append(_mk_assign(_RETFLAG, ast.Constant(value=True)))
+                return out, {_RETFLAG}
+            new_st, flags = self._stmt(st, brk, cont)
+            out.extend(new_st)
+            if flags:
+                if rest:
+                    rest_new, rest_flags = self._block(rest, brk, cont)
+                    guard = ast.If(test=_not_any(flags), body=rest_new,
+                                   orelse=[])
+                    out.append(guard)
+                    return out, flags | rest_flags
+                return out, flags
+        return out, set()
+
+    def _stmt(self, st, brk, cont):
+        if isinstance(st, ast.If):
+            b, fb = self._block(st.body, brk, cont)
+            o, fo = self._block(st.orelse, brk, cont) if st.orelse \
+                else ([], set())
+            node = ast.copy_location(
+                ast.If(test=st.test, body=b or [ast.Pass()], orelse=o), st)
+            return [node], fb | fo
+        if isinstance(st, (ast.While, ast.For)):
+            new_stmts = self._loop(st)
+            flags = {_RETFLAG} if _contains_assign_to(new_stmts, _RETFLAG) \
+                else set()
+            return new_stmts, flags
+        if isinstance(st, (ast.Try, ast.With)) and (
+                _contains_return(st) or _stmt_has_loose_break(st)):
+            raise _CannotRewrite()
+        return [st], set()
+
+    def _loop(self, node):
+        """Rewrite one loop's own break/continue (+ any returns when in full
+        mode). Returns the replacement statement list."""
+        if node.orelse:
+            raise _CannotRewrite()  # loop-else + escapes: python fallback
+        self.uid += 1
+        brk = f"_jst_brk{self.uid}"
+        cont = f"_jst_cont{self.uid}"
+        body, _ = self._block(node.body, brk, cont)
+        used_brk = _contains_assign_to(body, brk)
+        used_cont = _contains_assign_to(body, cont)
+        uses_ret = self.rewrite_returns and _contains_assign_to(body, _RETFLAG)
+        if used_cont:
+            body = [_mk_assign(cont, ast.Constant(value=False))] + body
+        stmts = []
+        if used_brk:
+            stmts.append(_mk_assign(brk, ast.Constant(value=False)))
+        stop = set()
+        if used_brk:
+            stop.add(brk)
+        if uses_ret:
+            stop.add(_RETFLAG)
+        if isinstance(node, ast.While):
+            test = node.test
+            if stop:
+                test = ast.BoolOp(op=ast.And(),
+                                  values=[_not_any(stop), test])
+            new_loop = ast.While(test=test, body=body, orelse=[])
+        else:
+            # for-loops freeze: once break/return fires, the WHOLE body
+            # no-ops for the remaining (bounded) iterations — guard wraps
+            # everything so pre-flag statements don't re-execute
+            post = []
+            if stop:
+                # python leaves the loop var(s) at the break iteration; the
+                # frozen loop keeps iterating, so snapshot every target name
+                # inside the guard and restore afterwards (covers tuple
+                # targets like `for a, b in pairs`)
+                tnames = sorted(
+                    n.id for n in ast.walk(node.target)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Store))
+                snaps = []
+                for j, tn in enumerate(tnames):
+                    lasti = f"_jst_lasti{self.uid}_{j}"
+                    snaps.append(_mk_assign(lasti, _mk_name(tn)))
+                    post.append(_mk_assign(
+                        tn, _jst_call("pick", _get_local_default(lasti),
+                                      _get_local_default(tn))))
+                body = snaps + body
+                body = [ast.If(test=_not_any(stop), body=body, orelse=[])]
+            new_loop = ast.For(target=node.target, iter=node.iter,
+                               body=body, orelse=[])
+        stmts.append(ast.copy_location(new_loop, node))
+        if not isinstance(node, ast.While):
+            stmts.extend(post)
+        return stmts
+
+
+def _stmt_has_loose_break(node):
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_depth = 0
+            self.found = False
+
+        def visit_Break(self, n):
+            if self.loop_depth == 0:
+                self.found = True
+
+        visit_Continue = visit_Break
+
+        def visit_While(self, n):
+            self.loop_depth += 1
+            self.generic_visit(n)
+            self.loop_depth -= 1
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+    v = V()
+    v.visit(node)
+    return v.found
+
+
+def _rewrite_escapes(fdef):
+    """Apply the escape rewrite; on any unconvertible construct leave the
+    function body untouched (python fallback, ConversionError under
+    tracing)."""
+    import copy
+    try:
+        return _EscapeRewriter().rewrite(copy.deepcopy(fdef))
+    except _CannotRewrite:
+        return fdef
+
+
 _JST = "__jst_rt"
 
 
@@ -526,7 +945,10 @@ class _Dy2Static(ast.NodeTransformer):
             targets=[_tuple_store(outputs)],
             value=_jst_call("convert_ifelse", node.test,
                             ast.Name(id=tname, ctx=ast.Load()),
-                            ast.Name(id=fname, ctx=ast.Load())))
+                            ast.Name(id=fname, ctx=ast.Load()),
+                            ast.Tuple(elts=[ast.Constant(value=o)
+                                            for o in outputs],
+                                      ctx=ast.Load())))
         stmts = [tdef, fdef, call]
         if both_return:
             stmts.append(ast.Return(
@@ -557,7 +979,10 @@ class _Dy2Static(ast.NodeTransformer):
             targets=[_tuple_store(carry)],
             value=_jst_call("convert_while_loop",
                             ast.Name(id=cname, ctx=ast.Load()),
-                            ast.Name(id=bname, ctx=ast.Load()), init))
+                            ast.Name(id=bname, ctx=ast.Load()), init,
+                            ast.Tuple(elts=[ast.Constant(value=c)
+                                            for c in carry],
+                                      ctx=ast.Load())))
         return [cdef, bdef, call]
 
     # --- for ----------------------------------------------------------------
@@ -591,13 +1016,17 @@ class _Dy2Static(ast.NodeTransformer):
             isinstance(node.iter.func, ast.Name) and \
             node.iter.func.id == "range" and not node.iter.keywords and \
             not any(isinstance(a, ast.Starred) for a in node.iter.args)
+        cnames = ast.Tuple(elts=[ast.Constant(value=c) for c in carry],
+                           ctx=ast.Load())
         if is_range:
             rargs = ast.Tuple(elts=list(node.iter.args), ctx=ast.Load())
             value = _jst_call("convert_for_range", rargs,
-                              ast.Name(id=bname, ctx=ast.Load()), init)
+                              ast.Name(id=bname, ctx=ast.Load()), init,
+                              cnames)
         else:
             value = _jst_call("convert_for_iter", node.iter,
-                              ast.Name(id=bname, ctx=ast.Load()), init)
+                              ast.Name(id=bname, ctx=ast.Load()), init,
+                              cnames)
         lv = f"__jst_lv{uid}"
         call = ast.Assign(
             targets=[ast.Tuple(elts=[ast.Name(id=lv, ctx=ast.Store()),
@@ -704,6 +1133,8 @@ def _make_converted(target, bound_self):
         if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
             raise TypeError("not a function def")
         fdef.decorator_list = []
+        fdef = _rewrite_escapes(fdef)
+        tree.body[0] = fdef
         arg_names = {a.arg for a in fdef.args.args + fdef.args.kwonlyargs}
         if fdef.args.vararg:
             arg_names.add(fdef.args.vararg.arg)

@@ -204,8 +204,23 @@ class TestLoops:
         _run_both(fn, paddle.to_tensor([3.0]))
 
 
-class TestUnconvertible:
-    def test_break_raises_clear_error(self):
+class TestBreak:
+    """ref: dy2static/break_continue_transformer.py:133 — break converts to
+    a carried bool flag + guarded body + augmented loop condition."""
+
+    def test_while_true_break(self):
+        def fn(x):
+            i = x * 0
+            while True:
+                x = x + 1
+                i = i + 1
+                if i >= 5:
+                    break
+            return x
+
+        _run_both(fn, paddle.to_tensor([1.0]))
+
+    def test_while_cond_and_break(self):
         def fn(x):
             while x.sum() > 0:
                 x = x - 1
@@ -213,8 +228,167 @@ class TestUnconvertible:
                     break
             return x
 
-        with pytest.raises(ConversionError):
-            paddle.jit.to_static(fn)(paddle.to_tensor([5.0]))
+        _run_both(fn, paddle.to_tensor([5.0]))
+        _run_both(fn, paddle.to_tensor([-1.0]))
+
+    def test_for_range_break(self):
+        def fn(x):
+            s = x * 0
+            for i in range(10):
+                if s.sum() > 6:
+                    break
+                s = s + x
+            return s
+
+        _run_both(fn, paddle.to_tensor([2.0]))
+
+    def test_for_iter_break(self):
+        def fn(xs):
+            s = xs[0] * 0
+            for v in xs:
+                if v.sum() > 3:
+                    break
+                s = s + v
+            return s
+
+        _run_both(fn, paddle.to_tensor([0.0, 1.0, 2.0, 3.0, 4.0, 5.0]))
+
+    def test_statements_before_break_check_do_not_rerun(self):
+        """for-loop freeze must cover the whole body: counters placed before
+        the break check stop advancing once the flag fires."""
+        def fn(x):
+            n = x * 0
+            for i in range(8):
+                n = n + 1
+                if n.sum() >= 3:
+                    break
+            return n
+
+        out = _run_both(fn, paddle.to_tensor([0.0]))
+        assert float(np.asarray(out.numpy())[0]) == 3.0
+
+
+class TestContinue:
+    def test_for_range_continue(self):
+        def fn(x):
+            s = x * 0
+            for i in range(6):
+                if (x * 0 + i).sum() % 2 == 0:
+                    continue
+                s = s + i
+            return s
+
+        _run_both(fn, paddle.to_tensor([0.0]))
+
+    def test_while_continue(self):
+        def fn(x):
+            i = x * 0
+            s = x * 0
+            while i.sum() < 6:
+                i = i + 1
+                if i.sum() == 3:
+                    continue
+                s = s + i
+            return s
+
+        _run_both(fn, paddle.to_tensor([0.0]))
+
+    def test_continue_and_break_same_loop(self):
+        def fn(x):
+            s = x * 0
+            for i in range(10):
+                if (x * 0 + i).sum() == 2:
+                    continue
+                if s.sum() > 10:
+                    break
+                s = s + i
+            return s
+
+        _run_both(fn, paddle.to_tensor([0.0]))
+
+
+class TestEarlyReturn:
+    """ref: dy2static/return_transformer.py — early returns become a
+    retflag/retval carrier pair with guarded continuation."""
+
+    def test_return_inside_if_with_tail_code(self):
+        def fn(x):
+            if x.sum() > 0:
+                return x * 2
+            y = x + 10
+            return y * 3
+
+        _run_both(fn, paddle.to_tensor([3.0]))
+        _run_both(fn, paddle.to_tensor([-3.0]))
+
+    def test_return_inside_loop(self):
+        def fn(x):
+            s = x * 0
+            for i in range(10):
+                if s.sum() > 6:
+                    return s * 100
+                s = s + x
+            return s
+
+        _run_both(fn, paddle.to_tensor([2.0]))    # early exit path
+        _run_both(fn, paddle.to_tensor([0.1]))    # runs to the end
+
+    def test_return_inside_while(self):
+        def fn(x):
+            i = x * 0
+            while i.sum() < 100:
+                x = x * 2
+                if x.sum() > 50:
+                    return x + 1
+                i = i + 1
+            return x
+
+        _run_both(fn, paddle.to_tensor([1.0]))
+
+    def test_multiple_early_returns(self):
+        def fn(x):
+            if x.sum() > 10:
+                return x * 1
+            if x.sum() > 5:
+                return x * 2
+            if x.sum() > 0:
+                return x * 3
+            return x * 4
+
+        for v in (20.0, 7.0, 2.0, -1.0):
+            _run_both(fn, paddle.to_tensor([v]))
+
+    def test_early_return_composes_with_break(self):
+        def fn(x, thresh):
+            s = x * 0
+            for i in range(16):
+                s = s + x
+                if s.sum() > thresh.sum():
+                    break
+            if s.sum() > thresh.sum() * 2:
+                return s * 0.5
+            return s
+
+        eager = fn(paddle.to_tensor([1.5]), paddle.to_tensor([6.0]))
+        static = paddle.jit.to_static(fn)(paddle.to_tensor([1.5]),
+                                          paddle.to_tensor([6.0]))
+        np.testing.assert_allclose(np.asarray(eager.numpy()),
+                                   np.asarray(static.numpy()), rtol=1e-5)
+
+
+class TestUnconvertible:
+    def test_loop_else_with_break_falls_back_to_python(self):
+        """for/else + break keeps exact python semantics eagerly."""
+        def fn(x):
+            for i in range(3):
+                if i == 5:
+                    break
+            else:
+                x = x + 1
+            return x
+
+        out = paddle.jit.to_static(fn)(paddle.to_tensor([1.0]))
+        assert float(out.numpy()[0]) == 2.0
 
 
 class TestLayerForward:
@@ -240,3 +414,77 @@ class TestLayerForward:
         out = static_net(x)
         np.testing.assert_allclose(np.asarray(eager.numpy()),
                                    np.asarray(out.numpy()), rtol=1e-5)
+
+
+class TestLoopVarAfterBreak:
+    def test_for_range_loop_var_keeps_break_value(self):
+        def fn(x):
+            i = 0
+            for i in range(10):
+                if i == 3:
+                    break
+            return x + i
+
+        out = paddle.jit.to_static(fn)(paddle.to_tensor([1.0]))
+        assert float(out.numpy()[0]) == 4.0
+
+    def test_for_range_loop_var_traced_break(self):
+        def fn(x):
+            j = x * 0
+            for i in range(10):
+                j = j + i
+                if j.sum() > 5:
+                    break
+            return j + i
+
+        _run_both(fn, paddle.to_tensor([1.0]))
+
+
+class TestBreakStopsIteration:
+    def test_generator_break_stops_consuming(self):
+        """Concrete break must STOP pulling from the iterable (not just
+        freeze the body) — a streaming dataloader must not be exhausted."""
+        pulled = []
+
+        def gen():
+            for i in range(1000):
+                pulled.append(i)
+                yield i
+
+        def fn(x):
+            for v in gen():
+                x = x + v
+                if v == 3:
+                    break
+            return x
+
+        out = paddle.jit.to_static(fn)(paddle.to_tensor([0.0]))
+        assert float(out.numpy()[0]) == 6.0  # 0+1+2+3
+        assert len(pulled) <= 5  # 4 consumed + at most one lookahead
+
+    def test_concrete_range_break_stops_early(self):
+        calls = []
+
+        def fn(x):
+            for i in range(1000):
+                calls.append(i)
+                x = x + 1
+                if i == 3:
+                    break
+            return x
+
+        out = paddle.jit.to_static(fn)(paddle.to_tensor([0.0]))
+        assert float(out.numpy()[0]) == 4.0
+        assert len(calls) <= 5
+
+
+def test_tuple_target_loop_vars_keep_break_values():
+    def fn(xs):
+        a = xs[0][0] * 0
+        b = a
+        for a, b in zip([xs[0], xs[1], xs[2]], [xs[1], xs[2], xs[0]]):
+            if a.sum() > 1.5:
+                break
+        return a * 10 + b
+
+    _run_both(fn, paddle.to_tensor([[1.0, 0.0], [2.0, 0.0], [3.0, 0.0]]))
