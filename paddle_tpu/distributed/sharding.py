@@ -22,8 +22,18 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                            buffer_max_size=2 ** 23, segment_size=2 ** 20,
                            sync_comm=False, dp_group=None,
                            exclude_layer=None):
-    """ref signature: level in {'os', 'os_g', 'p_g_os'}."""
+    """ref signature: level in {'os', 'os_g', 'p_g_os'}.
+
+    offload=True places optimizer slot states in HOST memory
+    (memory_kind='pinned_host'); the compiled step streams them to the chip
+    for the update and back (ref: fleet/meta_parallel/sharding/
+    group_sharded_stage3.py:84 cpu offload). On a 16G chip this moves the
+    8-bytes/param fp32 adam moments off HBM — the single-chip enabler for
+    2.7B-class configs.
+    """
     stage = {"os": 1, "os_g": 2, "p_g_os": 3}.get(level, 1)
+    if offload:
+        optimizer._offload_opt_states = True
     mesh = env.get_mesh()
     axis = "sharding" if (mesh and mesh.shape.get("sharding", 1) > 1) else (
         "dp" if (mesh and mesh.shape.get("dp", 1) > 1) else None)

@@ -1,0 +1,55 @@
+"""Host-offload placement helpers shared by jit.TrainStep and
+models.gpt_hybrid.HybridTrainStep (ref: fleet/meta_parallel/sharding/
+group_sharded_stage3.py:84 cpu offload -> memory_kind='pinned_host').
+
+Each train-step class supplies its own device-sharding tree (its slot
+placement policy); everything else — host-kind derivation, the in-jit vs
+around-the-jit transfer decision, and the tree moves — lives here once.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def in_jit_transfers_supported():
+    """Only the TPU backend implements the annotate_device_placement
+    custom-call that in-jit `device_put`-to-memory-kind lowers to; other
+    backends must move the buffers around the compiled call instead."""
+    return jax.default_backend() == "tpu"
+
+
+def with_memory_kind(sharding, kind):
+    """Sharding in the given memory space; works with or without a mesh."""
+    if sharding is not None:
+        return sharding.with_memory_kind(kind)
+    from jax.sharding import SingleDeviceSharding
+    return SingleDeviceSharding(jax.devices()[0], memory_kind=kind)
+
+
+def host_shardings(opt_state, dev_shardings):
+    """pinned_host placements for every non-scalar leaf (the scalar step
+    counter stays on device — transferring it buys nothing)."""
+    return jax.tree_util.tree_map(
+        lambda a, s: with_memory_kind(s, "pinned_host")
+        if jnp.ndim(a) > 0 else s,
+        opt_state, dev_shardings)
+
+
+def move_opt(opt_state, shardings):
+    """device_put a state tree onto a matching sharding tree (works both
+    eagerly and inside a traced step on TPU)."""
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, s) if s is not None else a,
+        opt_state, shardings)
+
+
+def fetch_stash(enabled, dev_tree, host_tree):
+    """(fetch, stash) closures for the compiled step: host->device before
+    the optimizer update, device->host after (XLA overlaps the copies with
+    compute). Identity when offload is off or unsupported in-jit."""
+    if not enabled:
+        ident = lambda o: o  # noqa: E731
+        return ident, ident
+    return (lambda o: move_opt(o, dev_tree),
+            lambda o: move_opt(o, host_tree))

@@ -51,6 +51,15 @@ class TrainStep:
         self._buffers = capture_buffers(model)
         self._specs = param_specs(model)
         self._opt_state = optimizer.init_state(self._params)
+        # host offload of optimizer states (ref: fleet sharding stage-3
+        # offload, group_sharded_stage3.py:84): slots live in pinned host
+        # memory between steps. On TPU the compiled step streams them
+        # chip-side and back (in-jit device_put, overlapped by XLA); other
+        # backends move them around the jit call (the CPU backend has no
+        # annotate_device_placement kernel).
+        from ..framework import offload as _ol
+        self._offload = bool(getattr(optimizer, "_offload_opt_states", False))
+        self._offload_in_jit = _ol.in_jit_transfers_supported()
         self._grad_accum = (
             {n: jnp.zeros_like(a) for n, a in self._params.items()}
             if self.accumulate_steps > 1 else None)
@@ -63,6 +72,23 @@ class TrainStep:
         if self.mesh is None:
             return None
         return NamedSharding(self.mesh, spec if spec is not None else P())
+
+    def _opt_dev_shardings(self):
+        """Device-memory sharding per optimizer-state leaf (mesh GSPMD specs
+        when there is a mesh, single-device placement otherwise)."""
+        from ..framework import offload as _ol
+        if self.mesh is not None:
+            return self._opt_shardings()
+        dev = _ol.with_memory_kind(None, "device")
+        return jax.tree_util.tree_map(lambda a: dev, self._opt_state)
+
+    def _opt_host_shardings(self):
+        from ..framework import offload as _ol
+        return _ol.host_shardings(self._opt_state, self._opt_dev_shardings())
+
+    def _move_opt(self, opt_state, shardings):
+        from ..framework import offload as _ol
+        return _ol.move_opt(opt_state, shardings)
 
     def _param_shardings(self):
         return {n: self._sharding_for(self._specs.get(n)) for n in self._params}
@@ -99,7 +125,8 @@ class TrainStep:
             return
         p_sh = self._param_shardings()
         self._params = {n: jax.device_put(a, p_sh[n]) for n, a in self._params.items()}
-        o_sh = self._opt_shardings()
+        o_sh = self._opt_host_shardings() if self._offload \
+            else self._opt_shardings()
         self._opt_state = jax.tree_util.tree_map(
             lambda a, s: jax.device_put(a, s), self._opt_state, o_sh,
             is_leaf=lambda x: isinstance(x, jax.Array))
@@ -113,6 +140,15 @@ class TrainStep:
         grad_clip = getattr(optimizer, "_grad_clip", None)
         mesh = self.mesh
         remat = self.remat
+        # TPU host offload: slots arrive in pinned host memory; the step
+        # streams them to HBM for the fused update and back (XLA overlaps
+        # the copies with compute)
+        from ..framework import offload as _ol
+        offload_in = self._offload and self._offload_in_jit
+        o_host_tree = self._opt_host_shardings() if offload_in else None
+        fetch_opt, stash_opt = _ol.fetch_stash(
+            offload_in, self._opt_dev_shardings() if offload_in else None,
+            o_host_tree)
 
         def loss_from(params, buffers, key, inputs, labels):
             out, new_buffers = functional_call(model, params, buffers, inputs,
@@ -142,11 +178,13 @@ class TrainStep:
         def step_fn(params, opt_state, buffers, lr, key, inputs, labels):
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_from, has_aux=True)(params, buffers, key, inputs, labels)
-            new_params, new_opt = apply_update(params, grads, opt_state, lr)
-            return loss, new_params, new_opt, new_buffers
+            new_params, new_opt = apply_update(params, grads,
+                                               fetch_opt(opt_state), lr)
+            return loss, new_params, stash_opt(new_opt), new_buffers
 
         def accum_step_fn(params, opt_state, buffers, gacc, micro, lr, key,
                           inputs, labels):
+            opt_state = fetch_opt(opt_state)
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_from, has_aux=True)(params, buffers, key, inputs, labels)
             # mean over the k micro-batches == one big-batch gradient
@@ -164,13 +202,14 @@ class TrainStep:
 
             new_params, new_opt, new_gacc = jax.lax.cond(
                 fire, do_update, no_update, None)
-            return loss, new_params, new_opt, new_buffers, new_gacc, micro + 1
+            return (loss, new_params, stash_opt(new_opt), new_buffers,
+                    new_gacc, micro + 1)
 
         if k > 1:
             donate = (0, 1, 3) if self.donate else ()
             if mesh is not None:
                 p_sh = self._param_shardings()
-                o_sh = self._opt_shardings()
+                o_sh = o_host_tree if offload_in else self._opt_shardings()
                 rep = NamedSharding(mesh, P())
                 b_sh = {n: rep for n in self._buffers}
                 dp_axes = tuple(a for a in ("dp", "sdp")
@@ -189,7 +228,7 @@ class TrainStep:
         donate = (0, 1) if self.donate else ()
         if mesh is not None:
             p_sh = self._param_shardings()
-            o_sh = self._opt_shardings()
+            o_sh = o_host_tree if offload_in else self._opt_shardings()
             rep = NamedSharding(mesh, P())
             b_sh = {n: rep for n in self._buffers}
             dp_axes = tuple(a for a in ("dp", "sdp") if a in mesh.axis_names)
@@ -251,7 +290,16 @@ class TrainStep:
             self._sample_labels = lab_arrays
             if self.mesh is not None:
                 self.shard_params()
+            elif self._offload:
+                self._opt_state = self._move_opt(self._opt_state,
+                                                 self._opt_host_shardings())
             self._jitted = self._build(None, len(in_arrays))
+        # offload on backends without in-jit memory transfers (CPU): move the
+        # slots chip-side around the compiled call instead
+        offload_out = self._offload and not self._offload_in_jit
+        if offload_out:
+            self._opt_state = self._move_opt(self._opt_state,
+                                             self._opt_dev_shardings())
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         if self.accumulate_steps > 1:
             (loss, self._params, self._opt_state, self._buffers,
@@ -263,6 +311,9 @@ class TrainStep:
             loss, self._params, self._opt_state, self._buffers = self._jitted(
                 self._params, self._opt_state, self._buffers, lr, next_key(),
                 in_arrays, lab_arrays)
+        if offload_out:
+            self._opt_state = self._move_opt(self._opt_state,
+                                             self._opt_host_shardings())
         self._step += 1
         self.optimizer._step_count = self._step
         return Tensor(loss)

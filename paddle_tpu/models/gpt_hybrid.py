@@ -88,7 +88,12 @@ def gpt_param_specs(config: GPTConfig, pp=1, zero_stage=1):
         "down_w": P(*lead, "mp", z3), "down_b": P(*lead, None),
     }
     return {
-        "wte": P("mp", z3),
+        # wte is NOT hidden-FSDP-sharded at stage 3: a z3 spec turns the
+        # embedding lookup into a gather whose output GSPMD can only reshard
+        # to the batch-sharded activation layout via full rematerialization
+        # (an all-gather of [B,S,H] every step). Vocab-over-mp only: with
+        # batch-sharded ids the gather output is born in the right sharding.
+        "wte": P("mp", None),
         "wpe": P(),
         "lnf_g": P(), "lnf_b": P(),
         "head_w": P(z3, "mp"),
@@ -126,6 +131,16 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
         raise ValueError(f"unknown remat_policy {pol_name!r}; "
                          f"choose from {sorted(POLICIES)}")
     if pp > 1:
+        if (jax.default_backend() == "cpu"
+                and jnp.dtype(compute) == jnp.dtype(jnp.bfloat16)):
+            # XLA's CPU backend hard-aborts ("Invalid binary instruction
+            # opcode copy", hlo_instruction.cc:1585) partitioning the
+            # bf16 ppermute pipeline — fail with a catchable error instead
+            # of killing the interpreter. TPU (the real target) is fine.
+            raise ValueError(
+                "pipeline parallelism with compute_dtype='bfloat16' "
+                "crashes the XLA CPU backend; use compute_dtype='float32' "
+                "for CPU runs (bf16 is for TPU)")
         if pol_name != "full":
             import warnings
             warnings.warn(
@@ -178,6 +193,12 @@ class HybridTrainStep:
     # optimizer._shard_opt_states_axis), 3 = + params FSDP-sharded over
     # ('dp','sharding') with per-layer all-gather in the scan
     zero_stage: int = 1
+    # host offload of optimizer moments (ref: fleet group_sharded_stage3.py:84
+    # cpu offload): slots live in pinned host memory between steps; on TPU the
+    # compiled step streams them to HBM for the update and back. Moves the
+    # 8-bytes/param fp32 adam moments off the 16G chip — the single-chip
+    # enabler for 2.7B-class configs.
+    offload: bool = False
 
     def __post_init__(self):
         key = jax.random.key(self.seed)
@@ -196,10 +217,53 @@ class HybridTrainStep:
         flat, self._treedef = jax.tree_util.tree_flatten_with_path(self.params)
         self._names = ["/".join(str(p) for p in path) for path, _ in flat]
         self.opt_state = self.optimizer.init_state(self._flat(self.params))
+        if getattr(self.optimizer, "_offload_opt_states", False):
+            self.offload = True
+        from ..framework import offload as _ol
+        self._offload_in_jit = _ol.in_jit_transfers_supported()
         if self.mesh is not None:
             self._place()
+        if self.offload:
+            self.opt_state = self._move_opt(self.opt_state,
+                                            self._opt_host_shardings())
         self._jitted = None
         self._step_count = 0
+
+    # -- host offload helpers (mirror jit/train_step.py) ---------------------
+    def _opt_dev_shardings(self):
+        if self.mesh is not None:
+            mesh = self.mesh
+            # recompute the same placement _place() used
+            flat_specs = self._flat(self._specs())
+            zero_axis = getattr(self.optimizer, "_shard_opt_states_axis", None)
+
+            def spec_of(name, arr):
+                if jnp.ndim(arr) == 0:
+                    return NamedSharding(mesh, P())
+                base = flat_specs[name]
+                replicated = all(a is None for a in tuple(base)) \
+                    if len(tuple(base)) else True
+                if (zero_axis and mesh.shape.get(zero_axis, 1) > 1
+                        and replicated
+                        and arr.shape[0] % mesh.shape[zero_axis] == 0):
+                    return NamedSharding(
+                        mesh, P(zero_axis, *([None] * (arr.ndim - 1))))
+                return NamedSharding(mesh, base)
+            return {"step": NamedSharding(mesh, P()),
+                    "slots": {n: {k: spec_of(n, v) for k, v in s.items()}
+                              for n, s in self.opt_state["slots"].items()}}
+        from ..framework import offload as _ol
+        dev = _ol.with_memory_kind(None, "device")
+        return jax.tree_util.tree_map(lambda a: dev, self.opt_state)
+
+    def _opt_host_shardings(self):
+        from ..framework import offload as _ol
+        return _ol.host_shardings(self.opt_state, self._opt_dev_shardings())
+
+    @staticmethod
+    def _move_opt(opt_state, shardings):
+        from ..framework import offload as _ol
+        return _ol.move_opt(opt_state, shardings)
 
     def _flat(self, tree):
         leaves = jax.tree_util.tree_leaves(tree)
@@ -219,27 +283,11 @@ class HybridTrainStep:
         self.params = jax.tree_util.tree_map(
             lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
             self.params, specs)
-        # ZeRO: sharded slots follow params; scalars replicated
-        flat_specs = self._flat(specs)
-        zero_axis = getattr(self.optimizer, "_shard_opt_states_axis", None)
-
-        def slot_spec(name, arr):
-            if jnp.ndim(arr) == 0:
-                return P()
-            base = flat_specs[name]
-            replicated = all(a is None for a in tuple(base)) if len(tuple(base)) \
-                else True
-            if (zero_axis and self.mesh.shape.get(zero_axis, 1) > 1 and replicated
-                    and arr.shape[0] % self.mesh.shape[zero_axis] == 0):
-                return P(zero_axis, *([None] * (arr.ndim - 1)))
-            return base
-
-        new_slots = {}
-        for name, slots in self.opt_state["slots"].items():
-            new_slots[name] = {
-                k: jax.device_put(v, NamedSharding(mesh, slot_spec(name, v)))
-                for k, v in slots.items()}
-        self.opt_state = {"step": self.opt_state["step"], "slots": new_slots}
+        # ZeRO: sharded slots follow params; scalars replicated — the single
+        # source of slot placement is _opt_dev_shardings (shared with the
+        # host-offload fetch/stash path)
+        self.opt_state = self._move_opt(self.opt_state,
+                                        self._opt_dev_shardings())
 
     def _build(self):
         config, mesh, M = self.config, self.mesh, self.num_microbatches
@@ -248,6 +296,11 @@ class HybridTrainStep:
         flat = self._flat
 
         mp = mesh.shape.get("mp", 1) if mesh is not None else 1
+        from ..framework import offload as _ol
+        offload_in = self.offload and self._offload_in_jit
+        fetch_opt, stash_opt = _ol.fetch_stash(
+            offload_in, self._opt_dev_shardings() if offload_in else None,
+            self._opt_host_shardings() if offload_in else None)
 
         def step_fn(flat_params, opt_state, ids, lr):
             def loss_fn(fp):
@@ -271,8 +324,8 @@ class HybridTrainStep:
             wd_mask = {n: not (n.endswith("_b") or "ln" in n or n == "wpe")
                        for n in flat_params}
             new_params, new_opt = optimizer.apply_gradients(
-                flat_params, grads, opt_state, lr, wd_mask=wd_mask)
-            return loss, new_params, new_opt
+                flat_params, grads, fetch_opt(opt_state), lr, wd_mask=wd_mask)
+            return loss, new_params, stash_opt(new_opt)
 
         jit_kwargs = dict(donate_argnums=(0, 1))
         if mesh is not None:
@@ -287,8 +340,15 @@ class HybridTrainStep:
         ids = jnp.asarray(ids)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         flat_params = self._flat(self.params)
+        offload_out = self.offload and not self._offload_in_jit
+        if offload_out:  # backend without in-jit memory transfers (CPU)
+            self.opt_state = self._move_opt(self.opt_state,
+                                            self._opt_dev_shardings())
         loss, flat_params, self.opt_state = self._jitted(
             flat_params, self.opt_state, ids, lr)
+        if offload_out:
+            self.opt_state = self._move_opt(self.opt_state,
+                                            self._opt_host_shardings())
         self.params = self._unflat(flat_params)
         self._step_count += 1
         return loss
