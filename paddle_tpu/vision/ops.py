@@ -54,31 +54,103 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=No
     return Tensor(jnp.asarray(keep))
 
 
-def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
-              sampling_ratio=-1, aligned=True):
-    """Simplified RoIAlign via bilinear grid sampling."""
-    from ..nn.functional.common import grid_sample
+def _roi_align_fixed_grid(feat, bx, oh, ow, spatial_scale, gh, gw, aligned):
+    """RoIAlign with a fixed (gh x gw) sampling grid per bin, fully
+    vectorized: one gather + mean over the sample axis (ref semantics of
+    vision/ops.py:1628 / the PHI roi_align kernel). feat: [R, C, H, W]
+    (already one feature map per roi), bx: [R, 4]."""
+    R, C, H, W = feat.shape
+    offset = 0.5 if aligned else 0.0
+    x1 = bx[:, 0] * spatial_scale - offset
+    y1 = bx[:, 1] * spatial_scale - offset
+    x2 = bx[:, 2] * spatial_scale - offset
+    y2 = bx[:, 3] * spatial_scale - offset
+    roi_w = x2 - x1
+    roi_h = y2 - y1
+    if not aligned:  # legacy: force malformed rois to be 1x1
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+    bin_h = roi_h / oh
+    bin_w = roi_w / ow
+    # sample coords: y[r, i, iy] / x[r, j, ix]
+    iy = (jnp.arange(gh) + 0.5) / gh
+    ix = (jnp.arange(gw) + 0.5) / gw
+    ys = y1[:, None, None] + (jnp.arange(oh)[None, :, None] + iy[None, None])\
+        * bin_h[:, None, None]                       # [R, oh, gh]
+    xs = x1[:, None, None] + (jnp.arange(ow)[None, :, None] + ix[None, None])\
+        * bin_w[:, None, None]                       # [R, ow, gw]
+    yy = ys[:, :, None, :, None]                     # [R, oh, 1, gh, 1]
+    xx = xs[:, None, :, None, :]                     # [R, 1, ow, 1, gw]
+    yy, xx = jnp.broadcast_arrays(yy, xx)            # [R, oh, ow, gh, gw]
+    # reference exclusion is y < -1 or y > H (boundary values clamp+interp)
+    valid = (yy >= -1.0) & (yy <= H) & (xx >= -1.0) & (xx <= W)
+    yc = jnp.clip(yy, 0.0, H - 1)
+    xc = jnp.clip(xx, 0.0, W - 1)
+    y0 = jnp.floor(yc)
+    x0 = jnp.floor(xc)
+    y1i = jnp.minimum(y0 + 1, H - 1)
+    x1i = jnp.minimum(x0 + 1, W - 1)
+    ly = yc - y0
+    lx = xc - x0
+    flat = feat.reshape(R, C, H * W)
 
-    def f(feat, bx):
-        oh, ow = (output_size, output_size) if isinstance(output_size, int) \
-            else output_size
-        n = bx.shape[0]
-        x1, y1, x2, y2 = [bx[:, i] * spatial_scale for i in range(4)]
-        H, W = feat.shape[2], feat.shape[3]
-        ys = jnp.linspace(0, 1, oh)
-        xs = jnp.linspace(0, 1, ow)
-        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
-        cy = y1[:, None, None] + gy[None] * (y2 - y1)[:, None, None]
-        cx = x1[:, None, None] + gx[None] * (x2 - x1)[:, None, None]
-        # normalize to [-1, 1] for grid_sample
-        ny = cy / (H - 1) * 2 - 1
-        nx = cx / (W - 1) * 2 - 1
-        grid = jnp.stack([nx, ny], axis=-1)
-        # one roi per batch-0 feature (single-image simplification)
-        feats = jnp.broadcast_to(feat[0:1], (n,) + feat.shape[1:])
-        from ..nn.functional.common import grid_sample as _gs
-        return _gs(Tensor(feats), Tensor(grid))._data
-    return _apply(f, x, boxes, op_name="roi_align")
+    def take(yi, xi):
+        idx = (yi.astype(jnp.int32) * W + xi.astype(jnp.int32)).reshape(R, -1)
+        got = jnp.take_along_axis(flat, idx[:, None, :], axis=-1)
+        return got.reshape(R, C, oh, ow, gh, gw)
+
+    v = ((1 - ly) * (1 - lx))[:, None] * take(y0, x0) \
+        + ((1 - ly) * lx)[:, None] * take(y0, x1i) \
+        + (ly * (1 - lx))[:, None] * take(y1i, x0) \
+        + (ly * lx)[:, None] * take(y1i, x1i)
+    v = jnp.where(valid[:, None], v, 0.0)
+    return v.mean(axis=(-2, -1))                     # [R, C, oh, ow]
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Region-of-Interest align (ref: python/paddle/vision/ops.py:1628).
+
+    `boxes_num[i]` rois belong to image i (rois are concatenated in image
+    order); each roi bilinearly samples ITS image's feature map. On TPU the
+    sampling is one batched gather + mean (static shapes, MXU-friendly).
+    `sampling_ratio<=0` uses the reference's adaptive per-roi grid
+    (ceil(roi_size/bin)) — data-dependent, so it requires concrete boxes
+    (eager); pass sampling_ratio>0 for a jit-compatible fixed grid.
+    """
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def f(feat, bx, bn):
+        img_idx = jnp.repeat(jnp.arange(feat.shape[0]), bn,
+                             total_repeat_length=bx.shape[0])
+        per_roi = feat[img_idx]                       # [R, C, H, W]
+        if sampling_ratio > 0:
+            return _roi_align_fixed_grid(per_roi, bx, oh, ow, spatial_scale,
+                                         sampling_ratio, sampling_ratio,
+                                         aligned)
+        # adaptive grid (ceil(roi_h/oh) x ceil(roi_w/ow)): needs concrete
+        # boxes; grid counts are per-roi so loop rois (eager path — the
+        # detection pipelines that use adaptive sampling are eager anyway)
+        if isinstance(bx, jax.core.Tracer):
+            raise ValueError(
+                "roi_align with sampling_ratio<=0 is data-dependent "
+                "(adaptive grid); pass sampling_ratio>0 under jit")
+        offset = 0.5 if aligned else 0.0
+        outs = []
+        for r in range(bx.shape[0]):
+            roi_h = float(bx[r, 3] - bx[r, 1]) * spatial_scale
+            roi_w = float(bx[r, 2] - bx[r, 0]) * spatial_scale
+            if not aligned:
+                roi_h, roi_w = max(roi_h, 1.0), max(roi_w, 1.0)
+            gh = max(int(np.ceil(roi_h / oh)), 1)
+            gw = max(int(np.ceil(roi_w / ow)), 1)
+            outs.append(_roi_align_fixed_grid(
+                per_roi[r:r + 1], bx[r:r + 1], oh, ow, spatial_scale,
+                gh, gw, aligned)[0])
+        return jnp.stack(outs) if outs else \
+            jnp.zeros((0, feat.shape[1], oh, ow), feat.dtype)
+    return _apply(f, x, boxes, boxes_num, op_name="roi_align")
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,

@@ -170,3 +170,127 @@ class TestModelsAndHub:
         assert hasattr(net, "forward")
         with pytest.raises(RuntimeError):
             paddle.hub.list(d, source="github")
+
+
+class TestRoiAlign:
+    """roi_align vs a reference-semantics numpy oracle (ref:
+    python/paddle/vision/ops.py:1628) — batch>=2, boxes_num mapping,
+    sampling_ratio, aligned True/False."""
+
+    @staticmethod
+    def _oracle(x, boxes, boxes_num, out_hw, scale, sampling_ratio, aligned):
+        oh, ow = out_hw
+        R = boxes.shape[0]
+        N, C, H, W = x.shape
+        img_of = np.repeat(np.arange(N), boxes_num)
+        out = np.zeros((R, C, oh, ow), np.float64)
+        off = 0.5 if aligned else 0.0
+
+        def bil(feat, y, xx):
+            if y < -1.0 or y > H or xx < -1.0 or xx > W:
+                return np.zeros((C,), np.float64)
+            y = min(max(y, 0.0), H - 1)
+            xx = min(max(xx, 0.0), W - 1)
+            y0, x0 = int(np.floor(y)), int(np.floor(xx))
+            y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+            ly, lx = y - y0, xx - x0
+            return ((1 - ly) * (1 - lx) * feat[:, y0, x0]
+                    + (1 - ly) * lx * feat[:, y0, x1]
+                    + ly * (1 - lx) * feat[:, y1, x0]
+                    + ly * lx * feat[:, y1, x1])
+
+        for r in range(R):
+            feat = x[img_of[r]].astype(np.float64)
+            x1c, y1c, x2c, y2c = boxes[r] * scale
+            x1c, y1c, x2c, y2c = x1c - off, y1c - off, x2c - off, y2c - off
+            rw, rh = x2c - x1c, y2c - y1c
+            if not aligned:
+                rw, rh = max(rw, 1.0), max(rh, 1.0)
+            bh, bw = rh / oh, rw / ow
+            gh = sampling_ratio if sampling_ratio > 0 \
+                else max(int(np.ceil(rh / oh)), 1)
+            gw = sampling_ratio if sampling_ratio > 0 \
+                else max(int(np.ceil(rw / ow)), 1)
+            for i in range(oh):
+                for j in range(ow):
+                    acc = np.zeros((C,), np.float64)
+                    for iy in range(gh):
+                        for ix in range(gw):
+                            yy = y1c + (i + (iy + 0.5) / gh) * bh
+                            xx = x1c + (j + (ix + 0.5) / gw) * bw
+                            acc += bil(feat, yy, xx)
+                    out[r, :, i, j] = acc / (gh * gw)
+        return out
+
+    def _data(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 12, 16).astype(np.float32)
+        boxes = np.array([[1.0, 1.0, 8.0, 9.0],
+                          [0.0, 0.0, 15.0, 11.0],
+                          [4.5, 2.5, 10.0, 7.0],
+                          [2.0, 3.0, 13.0, 10.0],
+                          [6.0, 1.0, 14.0, 11.5]], np.float32)
+        boxes_num = np.array([2, 3], np.int32)  # rois 0-1 -> img0, 2-4 -> img1
+        return x, boxes, boxes_num
+
+    @pytest.mark.parametrize("aligned", [True, False])
+    @pytest.mark.parametrize("sampling_ratio", [2, -1])
+    def test_matches_oracle_batch2(self, aligned, sampling_ratio):
+        from paddle_tpu.vision.ops import roi_align
+        x, boxes, boxes_num = self._data()
+        got = roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                        paddle.to_tensor(boxes_num), output_size=(4, 5),
+                        spatial_scale=0.5, sampling_ratio=sampling_ratio,
+                        aligned=aligned)
+        ref = self._oracle(x, boxes, boxes_num, (4, 5), 0.5,
+                           sampling_ratio, aligned)
+        assert _np(got).shape == (5, 3, 4, 5)
+        np.testing.assert_allclose(_np(got), ref, rtol=1e-4, atol=1e-5)
+
+    def test_rois_map_to_their_images(self):
+        """img0 != img1 features: a roi assigned to img1 must NOT match the
+        img0 extraction (the round-4 'single-image simplification' bug)."""
+        from paddle_tpu.vision.ops import roi_align
+        x, boxes, boxes_num = self._data()
+        got = roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                        paddle.to_tensor(boxes_num), output_size=4,
+                        sampling_ratio=2)
+        wrong = self._oracle(x, boxes, np.array([5, 0], np.int32), (4, 4),
+                             1.0, 2, True)  # everything on image 0
+        assert not np.allclose(_np(got)[2:], wrong[2:], atol=1e-3)
+
+    def test_fixed_grid_is_jittable(self):
+        import jax
+        from paddle_tpu.vision.ops import roi_align
+        x, boxes, boxes_num = self._data()
+
+        def f(xv, bx, bn):
+            return roi_align(xv, bx, bn, output_size=3, sampling_ratio=2)._data
+
+        out = jax.jit(f)(x, boxes, boxes_num)
+        ref = self._oracle(x, boxes, boxes_num, (3, 3), 1.0, 2, True)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_adaptive_under_jit_raises(self):
+        import jax
+        from paddle_tpu.vision.ops import roi_align
+        x, boxes, boxes_num = self._data()
+
+        def f(xv, bx, bn):
+            return roi_align(xv, bx, bn, output_size=3, sampling_ratio=-1)._data
+
+        with pytest.raises(ValueError, match="sampling_ratio"):
+            jax.jit(f)(x, boxes, boxes_num)
+
+
+def test_roi_align_exact_boundary_sample_clamps():
+    """A sample landing exactly on y == H must clamp+interpolate (reference
+    excludes only y < -1 or y > H), not zero out."""
+    from paddle_tpu.vision.ops import roi_align
+    x = np.ones((1, 1, 4, 4), np.float32)
+    boxes = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
+    bn = np.array([1], np.int32)
+    out = roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                    paddle.to_tensor(bn), output_size=1, spatial_scale=1.0,
+                    sampling_ratio=1, aligned=False)
+    np.testing.assert_allclose(_np(out), np.ones((1, 1, 1, 1)), atol=1e-6)
