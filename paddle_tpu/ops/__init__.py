@@ -1,0 +1,5 @@
+"""Op library: pallas kernels, fused compositions, custom-op registry."""
+from .custom import (  # noqa: F401
+    register_custom_op, get_custom_op, list_custom_ops, deregister_custom_op,
+    CustomOp,
+)

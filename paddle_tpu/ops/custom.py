@@ -1,0 +1,112 @@
+"""Custom-op registration — the TPU-native analog of the reference's
+custom-operator machinery (ref: python/paddle/utils/cpp_extension/
+cpp_extension.py:79 setup(), paddle/fluid/framework/custom_operator.cc).
+
+The reference compiles user C++/CUDA kernels and registers them with the
+operator registry (+ optional PD_BUILD_GRAD_OP backward). Here the kernel
+language for device code is jax/pallas, so registration is a Python-level
+affair: `register_custom_op` installs a user kernel (any jax-traceable
+callable — typically a `pallas_call`) into the dispatch table so it
+
+  * dispatches through `dispatch.apply` (eager tape autograd, AMP casting),
+  * composes with `jit.to_static` / `TrainStep` (it is ordinary traceable
+    jax inside),
+  * carries a user backward via `jax.custom_vjp` when `vjp_fwd`/`vjp_bwd`
+    are given (the PD_BUILD_GRAD_OP analog) — otherwise jax autodiff
+    differentiates through the kernel body.
+
+Host-side (CPU) custom ops — the literal C++ path — live in
+`paddle_tpu.utils.cpp_extension.load`, which compiles C++ sources with g++
+and binds them via ctypes (the reference's JIT `load()` analog).
+
+Example::
+
+    import jax.numpy as jnp
+    from paddle_tpu.ops.custom import register_custom_op
+
+    @register_custom_op("fused_scale_tanh", amp="white")
+    def fused_scale_tanh(x, scale=2.0):
+        return jnp.tanh(x) * scale          # or a pl.pallas_call(...)
+
+    y = fused_scale_tanh(tensor)            # Tensor in, Tensor out, taped
+"""
+from __future__ import annotations
+
+import jax
+
+from ..dispatch import apply as _apply, WHITE_OPS, BLACK_OPS
+
+_REGISTRY: dict[str, "CustomOp"] = {}
+
+
+class CustomOp:
+    """A registered custom op: callable on Tensors, recorded on the tape."""
+
+    def __init__(self, name, fn, vjp_fwd=None, vjp_bwd=None, amp=None,
+                 nondiff_argnums=()):
+        if (vjp_fwd is None) != (vjp_bwd is None):
+            raise ValueError("vjp_fwd and vjp_bwd must be given together")
+        self.name = name
+        self.raw_fn = fn
+        self.has_custom_vjp = vjp_fwd is not None
+        if self.has_custom_vjp:
+            cv = jax.custom_vjp(fn, nondiff_argnums=tuple(nondiff_argnums))
+            cv.defvjp(vjp_fwd, vjp_bwd)
+            self.fn = cv
+        else:
+            self.fn = fn
+        if amp == "white":
+            WHITE_OPS.add(name)
+        elif amp == "black":
+            BLACK_OPS.add(name)
+        elif amp not in (None, "auto"):
+            raise ValueError(f"amp must be 'white', 'black' or None, "
+                             f"got {amp!r}")
+        self.amp = amp
+
+    def __call__(self, *inputs, **static_kw):
+        return _apply(self.fn, *inputs, op_name=self.name, **static_kw)
+
+    def __repr__(self):
+        grad = "custom_vjp" if self.has_custom_vjp else "autodiff"
+        return f"<CustomOp {self.name} ({grad})>"
+
+
+def register_custom_op(name, fn=None, *, vjp_fwd=None, vjp_bwd=None,
+                       amp=None, nondiff_argnums=(), overwrite=False):
+    """Register `fn` (jax arrays in/out) as op `name`. Usable directly or as
+    a decorator. Returns the CustomOp callable (Tensors in/out).
+
+    vjp_fwd(x...) -> (out, residuals) and vjp_bwd(residuals, cotangent) ->
+    grads follow `jax.custom_vjp` conventions. amp='white' computes in the
+    autocast dtype (MXU ops), amp='black' forces fp32 (numerics)."""
+    def _register(f):
+        if name in _REGISTRY and not overwrite:
+            raise ValueError(
+                f"custom op {name!r} already registered; pass overwrite=True "
+                f"to replace it")
+        op = CustomOp(name, f, vjp_fwd=vjp_fwd, vjp_bwd=vjp_bwd, amp=amp,
+                      nondiff_argnums=nondiff_argnums)
+        _REGISTRY[name] = op
+        return op
+
+    if fn is not None:
+        return _register(fn)
+    return _register
+
+
+def get_custom_op(name):
+    """Look up a registered op by name (KeyError if absent)."""
+    return _REGISTRY[name]
+
+
+def list_custom_ops():
+    return sorted(_REGISTRY)
+
+
+def deregister_custom_op(name):
+    op = _REGISTRY.pop(name, None)
+    if op is not None:
+        WHITE_OPS.discard(name)
+        BLACK_OPS.discard(name)
+    return op

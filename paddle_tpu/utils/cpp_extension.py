@@ -1,0 +1,104 @@
+"""JIT C++ extension loading (ref: python/paddle/utils/cpp_extension/
+cpp_extension.py:79 setup(), extension_utils.py _jit_compile / load()).
+
+The reference compiles user C++/CUDA operator sources against the paddle
+runtime and registers the results as framework operators. In the TPU-native
+stack, DEVICE custom ops are pallas/jax kernels registered via
+`paddle_tpu.ops.custom.register_custom_op` (no compilation step — see that
+module). This module keeps the literal C++ path for HOST-side ops — data
+loaders, tokenizers, CPU pre/post-processing — the same role the repo's own
+`native/dataio.cpp` plays: `load()` compiles the sources with g++ into a
+shared object and returns a ctypes handle.
+
+Example::
+
+    lib = load(name="my_ops", sources=["my_ops.cc"])   # g++ -O3 -shared
+    lib.my_kernel.restype = None
+    lib.my_kernel.argtypes = [...]
+
+Functions are plain `extern "C"` symbols operating on raw buffers (pass
+numpy arrays via ctypes; zero-copy through ndarray.ctypes).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+
+class BuildError(RuntimeError):
+    pass
+
+
+DEFAULT_CXX_FLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared"]
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
+         extra_include_paths=None, build_directory=None, verbose=False):
+    """Compile C++ `sources` into `lib{name}.so` and return the ctypes CDLL
+    (ref: cpp_extension load()). Re-links only when sources are newer than
+    the cached object."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    out_path = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    for s in srcs:
+        if not os.path.exists(s):
+            raise FileNotFoundError(s)
+    if (not os.path.exists(out_path)
+            or any(os.path.getmtime(s) > os.path.getmtime(out_path)
+                   for s in srcs)):
+        cmd = ["g++", *DEFAULT_CXX_FLAGS]
+        for inc in (extra_include_paths or []):
+            cmd += ["-I", inc]
+        cmd += (extra_cxx_cflags or [])
+        cmd += srcs
+        cmd += ["-o", out_path]
+        cmd += (extra_ldflags or [])
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise BuildError(
+                f"g++ failed (rc={proc.returncode}):\n{proc.stderr[-4000:]}")
+    return ctypes.CDLL(out_path)
+
+
+class CppExtension:
+    """Descriptor for setup()-style builds (ref: CppExtension). Thin data
+    holder: `setup` compiles each extension eagerly via `load`."""
+
+    def __init__(self, sources, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*args, **kwargs):  # noqa: N802 — reference-parity name
+    raise NotImplementedError(
+        "CUDA custom ops do not exist on TPU. Device custom kernels are "
+        "pallas/jax functions — register them with "
+        "paddle_tpu.ops.custom.register_custom_op (no compilation step).")
+
+
+def setup(name="", ext_modules=None, **kwargs):
+    """Compile every CppExtension now and return {ext_name: CDLL}
+    (ref: cpp_extension.py:79 setup). The reference installs an importable
+    python module; here the compiled host library handles are returned
+    directly (and cached on disk), which fits the single-process TPU
+    runtime."""
+    exts = ext_modules or []
+    if not isinstance(exts, (list, tuple)):
+        exts = [exts]
+    out = {}
+    for i, ext in enumerate(exts):
+        if isinstance(ext, CppExtension):
+            ext_name = ext.kwargs.get("name", f"{name}_{i}" if name else
+                                      f"ext_{i}")
+            out[ext_name] = load(ext_name, ext.sources,
+                                 **{k: v for k, v in ext.kwargs.items()
+                                    if k != "name"})
+        else:
+            raise TypeError(f"unsupported extension type {type(ext)}")
+    return out

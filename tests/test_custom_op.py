@@ -1,0 +1,220 @@
+"""Custom-op registration (ref: python/paddle/utils/cpp_extension/
+cpp_extension.py:79 setup + custom_operator.cc registry): pallas/jax device
+ops via register_custom_op (autograd/amp/jit composition) and host-side C++
+via utils.cpp_extension.load (g++ -> ctypes)."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.ops import (register_custom_op, get_custom_op,
+                            list_custom_ops, deregister_custom_op)
+
+
+@pytest.fixture
+def cleanup_ops():
+    before = set(list_custom_ops())
+    yield
+    for name in set(list_custom_ops()) - before:
+        deregister_custom_op(name)
+
+
+class TestRegisterCustomOp:
+    def test_forward_and_autodiff_backward(self, cleanup_ops):
+        @register_custom_op("scale_tanh")
+        def scale_tanh(x, scale=2.0):
+            return jnp.tanh(x) * scale
+
+        x = paddle.to_tensor(np.array([0.3, -0.5], np.float32),
+                             stop_gradient=False)
+        y = scale_tanh(x, scale=3.0)
+        np.testing.assert_allclose(y.numpy(), np.tanh([0.3, -0.5]) * 3.0,
+                                   rtol=1e-6)
+        y.sum().backward()
+        expect = 3.0 * (1 - np.tanh([0.3, -0.5]) ** 2)
+        np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-5)
+
+    def test_custom_vjp_is_used(self, cleanup_ops):
+        calls = []
+
+        def fwd(x):
+            calls.append("fwd")
+            return jnp.square(x), (x,)
+
+        def bwd(res, g):
+            calls.append("bwd")
+            (x,) = res
+            return (g * 7.0,)  # deliberately NOT the true gradient
+
+        @register_custom_op("weird_square", vjp_fwd=fwd, vjp_bwd=bwd)
+        def weird_square(x):
+            return jnp.square(x)
+
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = weird_square(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])  # custom rule won
+        assert "bwd" in calls
+
+    def test_pallas_kernel_op(self, cleanup_ops):
+        """A real pallas_call kernel (interpret mode off-TPU) registered as
+        a custom op, with autodiff via custom_vjp."""
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0 + 1.0
+
+        def pallas_affine_raw(x):
+            return pl.pallas_call(
+                _kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=jax.default_backend() != "tpu",
+            )(x)
+
+        def fwd(x):
+            return pallas_affine_raw(x), ()
+
+        def bwd(res, g):
+            return (g * 2.0,)
+
+        op = register_custom_op("pallas_affine", pallas_affine_raw,
+                                vjp_fwd=fwd, vjp_bwd=bwd)
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32),
+                             stop_gradient=False)
+        y = op(x)
+        np.testing.assert_allclose(y.numpy(), np.arange(8) * 2.0 + 1.0)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(8, 2.0))
+
+    def test_train_through_custom_op(self, cleanup_ops):
+        """The VERDICT gate: a model whose forward uses the registered op
+        trains (eager loop AND compiled TrainStep)."""
+        @register_custom_op("smooth_abs", amp="white")
+        def smooth_abs(x, eps=1e-3):
+            return jnp.sqrt(x * x + eps)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return smooth_abs(self.fc(x)).sum(-1, keepdim=True)
+
+        paddle.seed(0)
+        net = Net()
+        opt = paddle.optimizer.Adam(0.05, parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+        y = paddle.to_tensor(np.zeros((16, 1), np.float32))
+        loss_fn = nn.MSELoss()
+        first = None
+        for _ in range(5):
+            loss = loss_fn(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+        assert float(loss.numpy()) < first
+
+        paddle.seed(0)
+        net2 = Net()
+        step = paddle.jit.TrainStep(net2, loss_fn,
+                                    paddle.optimizer.Adam(0.05))
+        l0 = float(step(x, y).numpy())
+        l1 = float(step(x, y).numpy())
+        assert np.isfinite(l1) and l1 < l0
+
+    def test_amp_white_casts_to_bf16(self, cleanup_ops):
+        seen = {}
+
+        @register_custom_op("probe_dtype", amp="white")
+        def probe_dtype(x):
+            seen["dtype"] = x.dtype
+            return x * 1.0
+
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16",
+                                  custom_white_list=["probe_dtype"]):
+            probe_dtype(x)
+        assert seen["dtype"] == jnp.bfloat16
+
+    def test_registry_and_duplicate_protection(self, cleanup_ops):
+        op = register_custom_op("dup_op")(lambda x: x)
+        assert get_custom_op("dup_op") is op
+        assert "dup_op" in list_custom_ops()
+        with pytest.raises(ValueError, match="already registered"):
+            register_custom_op("dup_op")(lambda x: x)
+        register_custom_op("dup_op", overwrite=True)(lambda x: x + 1)
+
+    def test_composes_with_to_static(self, cleanup_ops):
+        @register_custom_op("tri_mul")
+        def tri_mul(x):
+            return x * 3.0
+
+        def f(t):
+            return tri_mul(t) + 1
+
+        sf = paddle.jit.to_static(f)
+        out = sf(paddle.to_tensor(np.array([2.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [7.0])
+
+
+class TestCppExtension:
+    def test_load_compiles_and_runs(self, tmp_path):
+        from paddle_tpu.utils import cpp_extension
+        src = tmp_path / "my_ops.cc"
+        src.write_text("""
+extern "C" void saxpy(float a, const float* x, const float* y, float* out,
+                      long n) {
+    for (long i = 0; i < n; ++i) out[i] = a * x[i] + y[i];
+}
+""")
+        lib = cpp_extension.load(name="test_saxpy", sources=[str(src)],
+                                 build_directory=str(tmp_path))
+        lib.saxpy.restype = None
+        lib.saxpy.argtypes = [ctypes.c_float,
+                              ctypes.POINTER(ctypes.c_float),
+                              ctypes.POINTER(ctypes.c_float),
+                              ctypes.POINTER(ctypes.c_float), ctypes.c_long]
+        x = np.arange(5, dtype=np.float32)
+        y = np.ones(5, dtype=np.float32)
+        out = np.zeros(5, dtype=np.float32)
+        fp = ctypes.POINTER(ctypes.c_float)
+        lib.saxpy(2.0, x.ctypes.data_as(fp), y.ctypes.data_as(fp),
+                  out.ctypes.data_as(fp), 5)
+        np.testing.assert_allclose(out, 2.0 * x + y)
+
+    def test_setup_with_cpp_extension(self, tmp_path):
+        from paddle_tpu.utils import cpp_extension
+        src = tmp_path / "twice.cc"
+        src.write_text("""
+extern "C" long twice(long v) { return v * 2; }
+""")
+        libs = cpp_extension.setup(
+            name="demo",
+            ext_modules=[cpp_extension.CppExtension(
+                sources=[str(src)], name="twice_lib",
+                build_directory=str(tmp_path))])
+        lib = libs["twice_lib"]
+        lib.twice.restype = ctypes.c_long
+        assert lib.twice(21) == 42
+
+    def test_cuda_extension_points_to_pallas(self):
+        from paddle_tpu.utils import cpp_extension
+        with pytest.raises(NotImplementedError, match="pallas"):
+            cpp_extension.CUDAExtension()
+
+    def test_build_error_surfaces_compiler_output(self, tmp_path):
+        from paddle_tpu.utils import cpp_extension
+        bad = tmp_path / "bad.cc"
+        bad.write_text("this is not C++")
+        with pytest.raises(cpp_extension.BuildError):
+            cpp_extension.load(name="bad", sources=[str(bad)],
+                               build_directory=str(tmp_path))
