@@ -43,6 +43,14 @@ class DistributedStrategy:
         self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
         self.gradient_merge = False
         self.gradient_merge_configs = {}
+        # ref: fleet/meta_optimizers/lars_optimizer.py:23 / dgc_optimizer.py
+        self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005,
+                             "exclude_from_weight_decay": [], "epsilon": 0}
+        self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                            "sparsity": [0.999]}
         self.find_unused_parameters = False
 
 
@@ -156,11 +164,43 @@ class Fleet:
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):
+        strategy = strategy or self._strategy
+        # lars/dgc swap FIRST so the zero/gradient-merge attributes below
+        # land on the optimizer that will actually run
+        from ...optimizer import Momentum
+        from ...optimizer.meta import LarsMomentum, DGCMomentum
+        if strategy is not None and getattr(strategy, "lars", False) \
+                and isinstance(optimizer, Momentum):
+            # ref: lars_optimizer.py:23 — swap a Momentum inner optimizer
+            # for LarsMomentum per strategy.lars_configs
+            cfg = strategy.lars_configs
+            optimizer = LarsMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameter_list,
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                exclude_from_weight_decay=cfg.get(
+                    "exclude_from_weight_decay", []),
+                epsilon=cfg.get("epsilon", 0),
+                grad_clip=optimizer._grad_clip)
+        elif strategy is not None and getattr(strategy, "dgc", False) \
+                and isinstance(optimizer, Momentum):
+            # ref: dgc_optimizer.py:444
+            cfg = strategy.dgc_configs
+            optimizer = DGCMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameter_list,
+                rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                rampup_step=cfg.get("rampup_step", 1),
+                sparsity=cfg.get("sparsity", [0.999]),
+                use_nesterov=optimizer._nesterov,
+                grad_clip=optimizer._grad_clip)
         optimizer._zero_stage = self._zero_stage
         optimizer._shard_opt_states_axis = (
             "sharding" if self._zero_stage >= 1 and
             (get_mesh() and get_mesh().shape.get("sharding", 1) > 1) else None)
-        strategy = strategy or self._strategy
         if strategy is not None and getattr(strategy, "gradient_merge", False):
             # ref: fleet/meta_optimizers/gradient_merge_optimizer.py —
             # TrainStep fuses the k-step accumulation into the compiled step

@@ -267,6 +267,9 @@ class Lamb(Optimizer):
         return (p32 - lr * trust * r).astype(p.dtype), {"moment1": m, "moment2": v}
 
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
+__all__ = ["Optimizer", "SGD", "Momentum", "LarsMomentum", "DGCMomentum", "Adagrad", "Adadelta", "RMSProp",
            "Adam", "AdamW", "Adamax", "Lamb", "lr"]
 from .lbfgs import LBFGS  # noqa: E402,F401
+from .meta import (  # noqa: E402,F401
+    LarsMomentum, LarsMomentumOptimizer, DGCMomentum, DGCMomentumOptimizer,
+)

@@ -1,0 +1,145 @@
+"""Fleet meta-optimizer analogs as first-class optimizers.
+
+LARS (ref: python/paddle/distributed/fleet/meta_optimizers/
+lars_optimizer.py:23 + the lars_momentum PHI kernel): layer-wise adaptive
+rate scaling for large-batch SGD — per-parameter trust ratio
+``||p|| / (||g|| + wd*||p|| + eps)`` scales the learning rate before a
+momentum update.
+
+DGC (ref: fleet/meta_optimizers/dgc_optimizer.py:444 DGCMomentumOptimizer +
+paddle/fluid/operators/dgc_op): Deep Gradient Compression — momentum
+correction with a local residual accumulator; each step only the
+top-(1-sparsity) fraction of |accumulated gradient| entries fire an update,
+the rest stay local. The reference sparsifies the NCCL allreduce payload;
+under GSPMD the collective is compiler-emitted, so the TPU-native analog
+applies the same sparsify-with-residual rule on the (already reduced)
+gradient — identical convergence dynamics, expressed as a pure update rule
+that fuses into the compiled train step. Dense (pre-rampup) steps run plain
+momentum, matching the reference's warmup.
+
+Both rules are pure jnp on static shapes (the DGC mask is a quantile
+threshold, not a dynamic top-k gather) so they fuse into TrainStep's XLA
+program like every other optimizer here.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class LarsMomentum(Optimizer):
+    """ref: LarsMomentumOptimizer (lars_optimizer.py:23 wires it under
+    strategy.lars; kernel: phi lars_momentum).
+
+    velocity = mu * velocity + local_lr * (g + wd * p)
+    p        = p - velocity
+    local_lr = lr * lars_coeff * ||p|| / (||g|| + wd * ||p|| + eps)
+               (falls back to lr when either norm is 0)
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 exclude_from_weight_decay=None, epsilon=0.0,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._exclude = list(exclude_from_weight_decay or [])
+        self._epsilon = epsilon
+
+    def _decay_for(self, p):
+        name = getattr(p, "name", "") or ""
+        return not any(term in name for term in self._exclude)
+
+    def _create_slots(self, arr):
+        return {"velocity": jnp.zeros_like(arr, dtype=jnp.float32)}
+
+    def _update(self, p, g, slots, lr, step, decay_on=True):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        wd = self._lars_wd if decay_on else 0.0
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+        trust = lr * self._lars_coeff * p_norm / (
+            g_norm + wd * p_norm + self._epsilon + 1e-30)
+        local_lr = jnp.where((p_norm > 0.0) & (g_norm > 0.0), trust, lr)
+        v = self._momentum * slots["velocity"] + local_lr * (g32 + wd * p32)
+        return (p32 - v).astype(p.dtype), {"velocity": v}
+
+
+LarsMomentumOptimizer = LarsMomentum
+
+
+class DGCMomentum(Optimizer):
+    """ref: DGCMomentumOptimizer (dgc_optimizer.py:444).
+
+    u = m * u + g                (momentum correction)
+    v = v + u                    (local residual accumulation)
+    mask = |v| >= quantile(|v|, sparsity)
+    p -= lr * v * mask           (only the large entries fire)
+    v, u *= (1 - mask)           (the rest stay local)
+
+    sparsity ramps through `sparsity` list between rampup_begin_step and
+    rampup_begin_step + rampup_step; before rampup begins, steps are plain
+    dense momentum (the reference runs the vanilla momentum op there).
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
+                 parameters=None, use_nesterov=False, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None,
+                 num_trainers=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(int(rampup_step), 1)
+        self._sparsity = [float(s) for s in
+                          (sparsity if isinstance(sparsity, (list, tuple))
+                           else [sparsity])]
+
+    def _create_slots(self, arr):
+        return {"velocity": jnp.zeros_like(arr, dtype=jnp.float32),
+                "residual": jnp.zeros_like(arr, dtype=jnp.float32)}
+
+    def _sparsity_at(self, step):
+        """Current sparsity (traced-step safe): index the ramp table."""
+        table = jnp.asarray(self._sparsity, jnp.float32)
+        per = max(math.ceil(self._rampup_step / len(self._sparsity)), 1)
+        idx = jnp.clip((step - self._rampup_begin) // per, 0,
+                       len(self._sparsity) - 1)
+        return table[idx.astype(jnp.int32)]
+
+    def _update(self, p, g, slots, lr, step, decay_on=True):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        u, v = slots["velocity"], slots["residual"]
+
+        # dense branch (pre-rampup): plain momentum on the velocity slot
+        u_dense = self._momentum * u + g32
+        upd_dense = g32 + self._momentum * u_dense if self._nesterov \
+            else u_dense
+
+        # dgc branch: momentum correction + residual + quantile mask
+        u_dgc = self._momentum * u + g32
+        v_dgc = v + u_dgc
+        s = self._sparsity_at(step)
+        absv = jnp.abs(v_dgc)
+        thr = jnp.quantile(absv.reshape(-1), jnp.clip(s, 0.0, 1.0))
+        mask = (absv >= thr).astype(jnp.float32)
+        fired = v_dgc * mask
+
+        dense = step <= self._rampup_begin
+        new_p = jnp.where(dense, p32 - lr * upd_dense, p32 - lr * fired)
+        new_u = jnp.where(dense, u_dense, u_dgc * (1.0 - mask))
+        new_v = jnp.where(dense, v, v_dgc * (1.0 - mask))
+        return new_p.astype(p.dtype), {"velocity": new_u, "residual": new_v}
+
+
+DGCMomentumOptimizer = DGCMomentum
