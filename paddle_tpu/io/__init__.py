@@ -1,9 +1,12 @@
 """paddle_tpu.io — datasets & loading (ref: python/paddle/io/*).
 
-DataLoader uses a thread-pool prefetch pipeline (host-side batch assembly
-overlapped with device steps) instead of the reference's multiprocess C++
-workers: on TPU the loader's job is to keep host->HBM transfers ahead of the
-step loop, and threads + jnp.asarray achieve that without pickling overhead.
+DataLoader defaults to a thread-pool prefetch pipeline (host-side batch
+assembly overlapped with device steps): on TPU the loader's job is to keep
+host->HBM transfers ahead of the step loop, and threads + jnp.asarray
+achieve that without pickling overhead. For transform-heavy *python*
+pipelines (GIL-bound vision preprocessing) `worker_mode="process"` forks
+real worker processes like the reference's multiprocess loader
+(ref: io/dataloader/dataloader_iter.py:439) — see process_workers.py.
 """
 from __future__ import annotations
 
@@ -49,11 +52,17 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 worker_mode="thread"):
+        if worker_mode not in ("thread", "process"):
+            raise ValueError("worker_mode must be 'thread' or 'process'")
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         from ..incubate.autotune import dataloader_num_workers
         self.num_workers = dataloader_num_workers(num_workers)
+        self.worker_mode = worker_mode
+        self.worker_init_fn = worker_init_fn
+        self._user_collate = collate_fn
         self.prefetch_factor = max(prefetch_factor, 2)
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
@@ -103,8 +112,44 @@ class DataLoader:
         if self.num_workers <= 0:
             yield from self._iter_batches()
             return
+        if self.worker_mode == "process":
+            yield from self._iter_process()
+            return
         # threaded prefetch: producer assembles batches ahead of the consumer
-        q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        yield from self._iter_threads()
+
+
+    def _iter_process(self):
+        """Multiprocess fetch (ref: dataloader_iter.py:439): workers collate
+        at the numpy level; the parent re-wraps leaves as Tensors."""
+        from .process_workers import ProcessPool, np_collate
+        if self._iterable or self.batch_sampler is None:
+            import warnings
+            warnings.warn(
+                "worker_mode='process' supports map-style batched datasets; "
+                "falling back to threads for this dataset")
+            yield from self._iter_threads()
+            return
+        # the explicit-default case routes to the numpy collate: Tensor
+        # construction must not happen in a forked child (device handles
+        # are not fork-safe); user collates get their output forced to
+        # numpy in the worker and re-wrapped here
+        user = self._user_collate
+        if user is default_collate_fn:
+            user = None
+        worker_collate = user or np_collate
+        pool = ProcessPool(self.dataset, worker_collate, self.num_workers,
+                           prefetch_factor=self.prefetch_factor,
+                           worker_init_fn=self.worker_init_fn)
+        try:
+            for batch in pool.run(self.batch_sampler):
+                yield _wrap_np(batch)
+        finally:
+            pool.shutdown()
+
+    def _iter_threads(self):
+        q: queue.Queue = queue.Queue(
+            maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
         err = []
 
@@ -128,5 +173,19 @@ class DataLoader:
             yield item
 
 
+def _wrap_np(batch):
+    """Wrap numpy-collated leaves as Tensors (nested structure preserved)."""
+    if isinstance(batch, np.ndarray):
+        return Tensor(batch)
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(_wrap_np(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: _wrap_np(v) for k, v in batch.items()}
+    return batch
+
+
 def get_worker_info():
-    return None
+    """ref: paddle.io.get_worker_info — WorkerInfo in a worker process,
+    None in the main process / thread workers."""
+    from .process_workers import get_worker_info as _gwi
+    return _gwi()

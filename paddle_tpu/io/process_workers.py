@@ -1,0 +1,191 @@
+"""Multiprocess DataLoader workers (ref: python/paddle/io/dataloader/
+dataloader_iter.py:439 _DataLoaderIterMultiProcess + worker.py).
+
+Thread workers (the default) keep host->HBM transfers ahead of the step
+loop, but heavy *python* transforms (vision pipelines) serialize on the
+GIL. Process mode forks worker processes that fetch+collate batches at the
+numpy level and ship them back pickled through pipes; the parent re-wraps
+leaves as Tensors and preserves batch order with a sequence buffer. Workers
+must not touch jax (fork inherits the initialized backend; device handles
+are not fork-safe) — which is exactly why collation stays numpy-side here.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import traceback
+
+import numpy as np
+
+_worker_info = None
+
+
+class WorkerInfo:
+    """ref: io/dataloader/worker.py WorkerInfo."""
+
+    def __init__(self, id, num_workers, dataset, seed=None):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers})")
+
+
+def get_worker_info():
+    """Inside a worker process: this worker's info; None in the parent
+    (ref: paddle.io.get_worker_info)."""
+    return _worker_info
+
+
+def np_collate(batch):
+    """Numpy-level default collate — same nesting rules as
+    default_collate_fn but never constructs Tensors (workers must stay off
+    jax)."""
+    sample = batch[0]
+    if hasattr(sample, "_data"):  # Tensor snuck into a dataset: view as np
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return type(sample)(np_collate(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: np_collate([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return np.asarray(batch)
+
+
+def _tree_to_numpy(obj):
+    """Force results to numpy before pickling back: Tensor leaves carry
+    device buffers that neither pickle nor belong in a forked child."""
+    if hasattr(obj, "_data"):
+        return np.asarray(obj._data)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_numpy(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_numpy(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
+                 num_workers, worker_init_fn, base_seed):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset,
+                              seed=(base_seed + worker_id
+                                    if base_seed is not None else None))
+    np.random.seed(((base_seed or 0) + worker_id) % (2 ** 31))
+    if worker_init_fn is not None:
+        try:
+            worker_init_fn(worker_id)
+        except Exception:  # noqa: BLE001
+            result_queue.put((-1, "error", traceback.format_exc()))
+            return
+    while True:
+        task = index_queue.get()
+        if task is None:
+            break
+        seq, indices = task
+        try:
+            samples = [dataset[i] for i in indices]
+            result_queue.put((seq, "ok", _tree_to_numpy(collate_fn(samples))))
+        except Exception:  # noqa: BLE001
+            result_queue.put((seq, "error", traceback.format_exc()))
+
+
+class ProcessPool:
+    """Order-preserving multiprocess fetch pool over a map-style dataset."""
+
+    def __init__(self, dataset, collate_fn, num_workers, prefetch_factor=2,
+                 worker_init_fn=None, base_seed=None):
+        ctx = multiprocessing.get_context("fork")
+        self.num_workers = num_workers
+        self.prefetch = max(prefetch_factor, 1)
+        if base_seed is None:
+            # fresh randomness per pool (per epoch): augmentation must not
+            # replay byte-identical across epochs
+            base_seed = int.from_bytes(__import__("os").urandom(4), "little")
+        self._index_queues = [ctx.SimpleQueue() for _ in range(num_workers)]
+        self._result_queue = ctx.Queue()
+        self._workers = []
+        for wid in range(num_workers):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(dataset, collate_fn, self._index_queues[wid],
+                      self._result_queue, wid, num_workers, worker_init_fn,
+                      base_seed),
+                daemon=True)
+            p.start()
+            self._workers.append(p)
+        self._alive = True
+
+    def run(self, index_batches):
+        """Yield collated batches in order over `index_batches` (an iterable
+        of index lists)."""
+        it = iter(enumerate(index_batches))
+        outstanding = 0
+        next_worker = 0
+        next_yield = 0
+        buffered = {}
+
+        def dispatch_one():
+            nonlocal outstanding, next_worker
+            try:
+                seq, indices = next(it)
+            except StopIteration:
+                return False
+            self._index_queues[next_worker].put((seq, list(indices)))
+            next_worker = (next_worker + 1) % self.num_workers
+            outstanding += 1
+            return True
+
+        for _ in range(self.num_workers * self.prefetch):
+            if not dispatch_one():
+                break
+        import queue as _queue
+        while outstanding:
+            try:
+                seq, status, payload = self._result_queue.get(timeout=5.0)
+            except _queue.Empty:
+                dead = [p for p in self._workers if not p.is_alive()]
+                if dead:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) died without a result "
+                        f"(exitcodes {[p.exitcode for p in dead]}) — "
+                        f"OOM-kill or crash in the dataset/transform code")
+                continue
+            outstanding -= 1
+            if status == "error":
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker failed:\n{payload}")
+            buffered[seq] = payload
+            dispatch_one()
+            while next_yield in buffered:
+                yield buffered.pop(next_yield)
+                next_yield += 1
+
+    def shutdown(self):
+        if not self._alive:
+            return
+        self._alive = False
+        for q in self._index_queues:
+            try:
+                q.put(None)
+            except Exception:  # noqa: BLE001
+                pass
+        for p in self._workers:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
