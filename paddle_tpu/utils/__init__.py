@@ -103,3 +103,8 @@ def generate(key):
 
 class unique_name:
     generate = staticmethod(generate)
+
+
+from . import dlpack  # noqa: E402,F401
+from . import download  # noqa: E402,F401
+from . import cpp_extension  # noqa: E402,F401

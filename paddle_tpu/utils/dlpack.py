@@ -1,0 +1,46 @@
+"""DLPack interop (ref: python/paddle/utils/dlpack.py:27).
+
+jax arrays speak the DLPack protocol natively; these wrappers adapt the
+reference API. Modern consumers (torch.from_dlpack, np.from_dlpack, jax)
+exchange protocol OBJECTS (__dlpack__/__dlpack_device__) rather than raw
+capsules, so to_dlpack returns a lightweight exporter object implementing
+the protocol and from_dlpack accepts any such object (torch tensors, numpy
+arrays, other Tensors...)."""
+from __future__ import annotations
+
+import jax
+
+from ..tensor_impl import Tensor, as_tensor_data
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+class _DLPackExporter:
+    """Protocol shim: carries the producing array across frameworks."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._arr.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._arr.__dlpack_device__()
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack exporter (zero-copy where the consumer allows).
+    Feed the result to torch.from_dlpack / np.from_dlpack / jax."""
+    return _DLPackExporter(as_tensor_data(x))
+
+
+def from_dlpack(dlpack):
+    """DLPack-protocol object (torch tensor, numpy array, exporter from
+    to_dlpack, ...) -> Tensor."""
+    if not hasattr(dlpack, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack needs an object implementing the DLPack protocol "
+            "(__dlpack__/__dlpack_device__) — pass the producing tensor "
+            "itself, e.g. from_dlpack(torch_tensor)")
+    arr = jax.dlpack.from_dlpack(dlpack)
+    return Tensor(arr)

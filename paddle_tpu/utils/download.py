@@ -1,0 +1,48 @@
+"""Weight-file resolution (ref: python/paddle/utils/download.py
+get_weights_path_from_url / get_path_from_url).
+
+This deployment is zero-egress: nothing is ever fetched over the network.
+A URL resolves to `$PADDLE_TPU_HOME/weights/<basename>` (default
+~/.cache/paddle_tpu); pre-populate that directory (or pass an absolute
+path) and the pretrained=True machinery picks the file up. A missing file
+raises with exact instructions instead of a silent random-init model.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "WEIGHTS_HOME"]
+
+
+def _home():
+    return os.environ.get(
+        "PADDLE_TPU_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+
+
+def __getattr__(name):
+    # WEIGHTS_HOME tracks PADDLE_TPU_HOME changes at read time
+    if name == "WEIGHTS_HOME":
+        return os.path.join(_home(), "weights")
+    raise AttributeError(name)
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    """Resolve `url` to a local cached path (no network: the file must
+    already exist in the cache)."""
+    if os.path.isabs(url) and os.path.exists(url):
+        return url
+    root = root_dir or os.path.join(_home(), "weights")
+    fname = os.path.basename(url.split("?")[0]) or "weights.pdparams"
+    path = os.path.join(root, fname)
+    if check_exist and not os.path.exists(path):
+        raise FileNotFoundError(
+            f"weight file {fname!r} not found in {root} (zero-egress "
+            f"environment: downloads are disabled). Place the file at "
+            f"{path} or set PADDLE_TPU_HOME to the cache that contains it.")
+    return path
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """ref: download.py get_weights_path_from_url."""
+    return get_path_from_url(url, md5sum=md5sum)

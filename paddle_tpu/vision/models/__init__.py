@@ -20,3 +20,22 @@ from .misc import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
     GoogLeNet, googlenet, InceptionV3, inception_v3,
 )
+
+
+def load_pretrained(model, arch, weight_path=None):
+    """Offline pretrained-weight loading (ref: each builder's
+    `pretrained=True` -> get_weights_path_from_url -> set_state_dict).
+
+    Zero-egress: weights resolve through paddle_tpu.utils.download against
+    the local cache ($PADDLE_TPU_HOME/weights/<arch>.pdparams) or an
+    explicit `weight_path`. Missing files raise with placement
+    instructions rather than silently returning random init."""
+    from ...framework import io as fio
+    from ...utils.download import get_weights_path_from_url
+    path = weight_path or get_weights_path_from_url(f"{arch}.pdparams")
+    state = fio.load(path)
+    if isinstance(state, dict) and "model" in state and \
+            not any(hasattr(v, "shape") for v in state.values()):
+        state = state["model"]
+    model.set_state_dict(state)
+    return model

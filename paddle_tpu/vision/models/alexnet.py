@@ -30,4 +30,8 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    return AlexNet(**kwargs)
+    model = AlexNet(**kwargs)
+    if pretrained:
+        from . import load_pretrained
+        load_pretrained(model, "alexnet")
+    return model

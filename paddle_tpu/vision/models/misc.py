@@ -52,11 +52,11 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    return SqueezeNet("1.0", **kwargs)
+    return _maybe_pretrained(SqueezeNet("1.0", **kwargs), "squeezenet1_0", pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    return SqueezeNet("1.1", **kwargs)
+    return _maybe_pretrained(SqueezeNet("1.1", **kwargs), "squeezenet1_1", pretrained)
 
 
 # -- ShuffleNetV2 ------------------------------------------------------------
@@ -134,31 +134,31 @@ class ShuffleNetV2(nn.Layer):
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):
-    return ShuffleNetV2(1.0, **kwargs)
+    return _maybe_pretrained(ShuffleNetV2(1.0, **kwargs), "shufflenet_v2_x1_0", pretrained)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kwargs):
-    return ShuffleNetV2(0.5, **kwargs)
+    return _maybe_pretrained(ShuffleNetV2(0.5, **kwargs), "shufflenet_v2_x0_5", pretrained)
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):
-    return ShuffleNetV2(0.25, **kwargs)
+    return _maybe_pretrained(ShuffleNetV2(0.25, **kwargs), "shufflenet_v2_x0_25", pretrained)
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kwargs):
-    return ShuffleNetV2(1.5, **kwargs)
+    return _maybe_pretrained(ShuffleNetV2(1.5, **kwargs), "shufflenet_v2_x1_5", pretrained)
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
-    return ShuffleNetV2(2.0, **kwargs)
+    return _maybe_pretrained(ShuffleNetV2(2.0, **kwargs), "shufflenet_v2_x2_0", pretrained)
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kwargs):
-    return ShuffleNetV2(0.33, **kwargs)
+    return _maybe_pretrained(ShuffleNetV2(0.33, **kwargs), "shufflenet_v2_x0_33", pretrained)
 
 
 def shufflenet_v2_swish(pretrained=False, **kwargs):
-    return ShuffleNetV2(1.0, act="swish", **kwargs)
+    return _maybe_pretrained(ShuffleNetV2(1.0, act="swish", **kwargs), "shufflenet_v2_swish", pretrained)
 
 
 # -- DenseNet ----------------------------------------------------------------
@@ -220,23 +220,23 @@ class DenseNet(nn.Layer):
 
 
 def densenet121(pretrained=False, **kwargs):
-    return DenseNet(121, **kwargs)
+    return _maybe_pretrained(DenseNet(121, **kwargs), "densenet121", pretrained)
 
 
 def densenet161(pretrained=False, **kwargs):
-    return DenseNet(161, **kwargs)
+    return _maybe_pretrained(DenseNet(161, **kwargs), "densenet161", pretrained)
 
 
 def densenet169(pretrained=False, **kwargs):
-    return DenseNet(169, **kwargs)
+    return _maybe_pretrained(DenseNet(169, **kwargs), "densenet169", pretrained)
 
 
 def densenet201(pretrained=False, **kwargs):
-    return DenseNet(201, **kwargs)
+    return _maybe_pretrained(DenseNet(201, **kwargs), "densenet201", pretrained)
 
 
 def densenet264(pretrained=False, **kwargs):
-    return DenseNet(264, **kwargs)
+    return _maybe_pretrained(DenseNet(264, **kwargs), "densenet264", pretrained)
 
 
 # -- GoogLeNet ---------------------------------------------------------------
@@ -289,7 +289,7 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
-    return GoogLeNet(**kwargs)
+    return _maybe_pretrained(GoogLeNet(**kwargs), "googlenet", pretrained)
 
 
 # -- InceptionV3 (compact faithful topology) ---------------------------------
@@ -326,4 +326,11 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    return InceptionV3(**kwargs)
+    return _maybe_pretrained(InceptionV3(**kwargs), "inception_v3", pretrained)
+
+
+def _maybe_pretrained(model, arch, pretrained):
+    if pretrained:
+        from . import load_pretrained
+        load_pretrained(model, arch)
+    return model

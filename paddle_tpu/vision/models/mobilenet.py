@@ -216,16 +216,23 @@ class MobileNetV3Small(MobileNetV3):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV1(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV1(scale=scale, **kwargs), "mobilenet_v1", pretrained)
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV2(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV2(scale=scale, **kwargs), "mobilenet_v2", pretrained)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3Large(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV3Large(scale=scale, **kwargs), "mobilenet_v3_large", pretrained)
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3Small(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV3Small(scale=scale, **kwargs), "mobilenet_v3_small", pretrained)
+
+
+def _maybe_pretrained(model, arch, pretrained):
+    if pretrained:
+        from . import load_pretrained
+        load_pretrained(model, arch)
+    return model

@@ -113,7 +113,11 @@ class ResNet(nn.Layer):
 
 
 def _resnet(arch, Block, depth, pretrained, **kwargs):
-    return ResNet(Block, depth, **kwargs)
+    model = ResNet(Block, depth, **kwargs)
+    if pretrained:
+        from . import load_pretrained
+        load_pretrained(model, arch)
+    return model
 
 
 def resnet18(pretrained=False, **kwargs):

@@ -52,7 +52,11 @@ class VGG(nn.Layer):
 
 
 def _vgg(arch, cfg, batch_norm, pretrained, **kwargs):
-    return VGG(make_layers(cfgs[cfg], batch_norm), **kwargs)
+    model = VGG(make_layers(cfgs[cfg], batch_norm), **kwargs)
+    if pretrained:
+        from . import load_pretrained
+        load_pretrained(model, arch)
+    return model
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):

@@ -26,10 +26,62 @@ def box_iou(boxes1, boxes2):
     return _apply(f, boxes1, boxes2, op_name="box_iou")
 
 
+def nms_static(boxes, scores, iou_threshold=0.3, top_k=64):
+    """jit-compatible fixed-size NMS: exactly `top_k` output slots, padded
+    with -1 (inference graphs need static shapes; the reference's dynamic
+    keep-list is the eager path below). O(top_k * N) select-and-suppress
+    via lax.fori_loop — each round takes the best remaining box and
+    suppresses overlaps."""
+    b = jnp.asarray(boxes)
+    s = jnp.asarray(scores)
+    N = b.shape[0]
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+
+    def body(i, carry):
+        live_s, keep = carry
+        best = jnp.argmax(live_s)
+        valid = live_s[best] > -jnp.inf
+        keep = keep.at[i].set(jnp.where(valid, best, -1))
+        xx1 = jnp.maximum(b[best, 0], b[:, 0])
+        yy1 = jnp.maximum(b[best, 1], b[:, 1])
+        xx2 = jnp.minimum(b[best, 2], b[:, 2])
+        yy2 = jnp.minimum(b[best, 3], b[:, 3])
+        inter = jnp.clip(xx2 - xx1, 0) * jnp.clip(yy2 - yy1, 0)
+        iou = inter / (areas[best] + areas - inter + 1e-10)
+        suppress = (iou > iou_threshold) | (
+            jnp.arange(N) == best)
+        live_s = jnp.where(valid & suppress, -jnp.inf, live_s)
+        return live_s, keep
+
+    # output width is EXACTLY top_k (pad with -1) so per-image results stack
+    # into [B, top_k] regardless of proposal count
+    k = int(top_k)
+    _, keep = jax.lax.fori_loop(
+        0, min(k, N), body, (s.astype(jnp.float32),
+                             jnp.full((k,), -1, jnp.int64)))
+    return keep
+
+
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
         top_k=None):
-    """Host-side NMS (dynamic output size — eager only, like reference dygraph)."""
-    b = np.asarray(as_tensor_data(boxes))
+    """NMS (ref: vision/ops.py nms). Eager concrete inputs use the host
+    keep-list (dynamic output size, exact reference dygraph semantics);
+    traced inputs route to the fixed-size `nms_static` (requires top_k)."""
+    b_arr = as_tensor_data(boxes)
+    s_arr = as_tensor_data(scores) if scores is not None else None
+    if isinstance(b_arr, jax.core.Tracer) or isinstance(s_arr,
+                                                        jax.core.Tracer):
+        if top_k is None:
+            raise ValueError(
+                "nms under jit needs top_k (static output size); eager "
+                "calls may omit it")
+        if category_idxs is not None:
+            raise NotImplementedError(
+                "categorical nms under jit: call nms per category")
+        if s_arr is None:
+            s_arr = jnp.arange(b_arr.shape[0], 0, -1).astype(jnp.float32)
+        return Tensor(nms_static(b_arr, s_arr, iou_threshold, top_k))
+    b = np.asarray(b_arr)
     s = np.asarray(as_tensor_data(scores)) if scores is not None else \
         np.arange(len(b), 0, -1).astype(np.float32)
     order = np.argsort(-s)

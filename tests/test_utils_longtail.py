@@ -1,0 +1,141 @@
+"""utils long tail: dlpack interop, offline weight download, jit-able nms,
+pretrained weight loading (ref: utils/dlpack.py:27, utils/download.py,
+vision/ops.py nms, builders' pretrained=True)."""
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+class TestDlpack:
+    def test_roundtrip_via_protocol(self):
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        t2 = paddle.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+        np.testing.assert_array_equal(t2.numpy(), t.numpy())
+
+    def test_torch_interop(self):
+        torch = pytest.importorskip("torch")
+        th = torch.arange(8, dtype=torch.float32)
+        t = paddle.utils.dlpack.from_dlpack(th)
+        np.testing.assert_array_equal(t.numpy(), th.numpy())
+        back = torch.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+        np.testing.assert_array_equal(back.numpy(), th.numpy())
+
+    def test_numpy_interop(self):
+        a = np.arange(5, dtype=np.float32)
+        t = paddle.utils.dlpack.from_dlpack(a)
+        np.testing.assert_array_equal(t.numpy(), a)
+
+    def test_capsule_rejected_with_guidance(self):
+        with pytest.raises(TypeError, match="DLPack protocol"):
+            paddle.utils.dlpack.from_dlpack(object())
+
+
+class TestDownload:
+    def test_resolves_cached_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path))
+        wdir = tmp_path / "weights"
+        wdir.mkdir()
+        (wdir / "model.pdparams").write_bytes(b"x")
+        from paddle_tpu.utils.download import get_weights_path_from_url
+        p = get_weights_path_from_url("https://example.com/model.pdparams")
+        assert p == str(wdir / "model.pdparams")
+
+    def test_missing_file_raises_with_instructions(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path))
+        from paddle_tpu.utils.download import get_weights_path_from_url
+        with pytest.raises(FileNotFoundError, match="zero-egress"):
+            get_weights_path_from_url("https://example.com/nope.pdparams")
+
+    def test_absolute_path_passthrough(self, tmp_path):
+        f = tmp_path / "w.pdparams"
+        f.write_bytes(b"y")
+        from paddle_tpu.utils.download import get_path_from_url
+        assert get_path_from_url(str(f)) == str(f)
+
+
+class TestNmsStatic:
+    def _boxes(self):
+        return np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+                         [0, 0, 9, 9], [51, 51, 61, 61]], np.float32)
+
+    def test_matches_host_nms(self):
+        from paddle_tpu.vision.ops import nms
+        boxes = self._boxes()
+        scores = np.array([0.9, 0.8, 0.7, 0.6, 0.5], np.float32)
+        eager = np.asarray(nms(paddle.to_tensor(boxes), 0.5,
+                               paddle.to_tensor(scores)).numpy())
+
+        def f(b, s):
+            return nms(b, 0.5, s, top_k=5)._data
+
+        jitted = np.asarray(jax.jit(f)(boxes, scores))
+        valid = jitted[jitted >= 0]
+        np.testing.assert_array_equal(valid, eager)
+        assert (jitted[len(eager):] == -1).all()  # padded slots
+
+    def test_jit_without_topk_raises(self):
+        from paddle_tpu.vision.ops import nms
+
+        def f(b, s):
+            return nms(b, 0.5, s)._data
+
+        with pytest.raises(ValueError, match="top_k"):
+            jax.jit(f)(self._boxes(),
+                       np.array([0.9, 0.8, 0.7, 0.6, 0.5], np.float32))
+
+
+class TestPretrained:
+    def test_resnet_pretrained_loads_cached_weights(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path))
+        wdir = tmp_path / "weights"
+        wdir.mkdir()
+        paddle.seed(7)
+        donor = paddle.vision.models.resnet18(num_classes=10)
+        paddle.save(donor.state_dict(), str(wdir / "resnet18.pdparams"))
+        model = paddle.vision.models.resnet18(pretrained=True,
+                                              num_classes=10)
+        for (_, a), (_, b) in zip(sorted(donor.state_dict().items()),
+                                  sorted(model.state_dict().items())):
+            np.testing.assert_array_equal(np.asarray(a.numpy()),
+                                          np.asarray(b.numpy()))
+
+    def test_pretrained_missing_weights_is_loud(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path))
+        with pytest.raises(FileNotFoundError, match="resnet34"):
+            paddle.vision.models.resnet34(pretrained=True)
+
+
+def test_all_family_builders_honor_pretrained(tmp_path, monkeypatch):
+    """Every builder accepting pretrained=True must load or fail loudly —
+    never silently return random init (review r5 finding)."""
+    monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path))
+    from paddle_tpu.vision import models as M
+    builders = ["mobilenet_v1", "mobilenet_v2", "mobilenet_v3_large",
+                "mobilenet_v3_small", "alexnet", "squeezenet1_0",
+                "shufflenet_v2_x1_0", "densenet121", "googlenet",
+                "inception_v3", "vgg11", "resnet18"]
+    for name in builders:
+        with pytest.raises(FileNotFoundError):
+            getattr(M, name)(pretrained=True)
+
+
+def test_nms_static_pads_to_exact_topk():
+    from paddle_tpu.vision.ops import nms_static
+    boxes = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    out = np.asarray(nms_static(boxes, scores, 0.5, top_k=8))
+    assert out.shape == (8,)
+    assert (out[2:] == -1).all() and set(out[:2]) == {0, 1}
+
+
+def test_weights_home_is_a_live_path(monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path))
+    from paddle_tpu.utils import download
+    assert download.WEIGHTS_HOME == str(tmp_path / "weights")
