@@ -76,7 +76,16 @@ def pipeline_spmd(block_fn, stage_params, x_mb, *, axis_name="pp"):
     return lax.psum(masked, axis_name)
 
 
-def _stage_fn_of(block_fn):
+def _stage_fn_of(block_fn, remat_policy=None):
+    """remat_policy (jax.checkpoint policy or None=full recompute) controls
+    which per-layer residuals the stage vjp keeps during a backward tick —
+    the per-tick analog of the single-chip selective-save policies
+    (distributed/recompute.py POLICIES). Only meaningful on the hand-written
+    1f1b backward paths, where jax.vjp(stage_fn, ...) runs within one tick.
+    """
+    if remat_policy is not None:
+        block_fn = jax.checkpoint(block_fn, policy=remat_policy)
+
     def stage_fn(local_params, act):
         def scan_layer(h, layer_params):
             return block_fn(layer_params, h), None
@@ -123,7 +132,8 @@ def _gated_vjp(stage_fn, axis_name, active, pv, inp, gout):
     return lax.cond(active, run, zero, (inp, gout))
 
 
-def pipeline_spmd_1f1b(block_fn, stage_params, x_mb, *, axis_name="pp"):
+def pipeline_spmd_1f1b(block_fn, stage_params, x_mb, *, axis_name="pp",
+                       remat_policy=None):
     """1F1B-scheduled pipeline (ref: fleet/meta_parallel/pipeline_parallel.py:230
     `forward_backward_pipeline`, the "1f1b scheduling strategy").
 
@@ -148,7 +158,7 @@ def pipeline_spmd_1f1b(block_fn, stage_params, x_mb, *, axis_name="pp"):
     """
     S = lax.axis_size(axis_name)
     M = x_mb.shape[0]
-    stage_fn = _stage_fn_of(block_fn)
+    stage_fn = _stage_fn_of(block_fn, remat_policy)
 
     @jax.custom_vjp
     def pipe(sp, xm):
@@ -231,7 +241,8 @@ def pipeline_spmd_1f1b(block_fn, stage_params, x_mb, *, axis_name="pp"):
 
 
 def pipeline_spmd_interleaved_1f1b(block_fn, stage_params, x_mb, *,
-                                   num_virtual, axis_name="pp"):
+                                   num_virtual, axis_name="pp",
+                                   remat_policy=None):
     """Interleaved ("virtual pipeline") 1F1B (ref: fleet/meta_parallel/
     pipeline_parallel.py:613 interleaved schedule / VPP).
 
@@ -250,7 +261,7 @@ def pipeline_spmd_interleaved_1f1b(block_fn, stage_params, x_mb, *,
     V = num_virtual
     Sv = V * S
     M = x_mb.shape[0]
-    stage_fn = _stage_fn_of(block_fn)
+    stage_fn = _stage_fn_of(block_fn, remat_policy)
     mb_shape = x_mb.shape[1:]
     perm_down = [(i, (i + 1) % S) for i in range(S)]
     perm_up = [(i, (i - 1) % S) for i in range(S)]
@@ -406,7 +417,7 @@ def vpp_storage_perm(L, S, V):
 
 def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
                  axis_name="pp", data_spec=P(), schedule="gpipe",
-                 interleave=1, vpp_stage_major=False):
+                 interleave=1, vpp_stage_major=False, remat_policy=None):
     """Host-side wrapper: shard_map(manual over 'pp', auto elsewhere).
 
     stacked_params: pytree, leaves [S * local_L, ...] stacked layer params.
@@ -448,10 +459,15 @@ def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
     if V > 1:
         assert schedule == "1f1b", "interleaving requires the 1f1b schedule"
         spmd = functools.partial(pipeline_spmd_interleaved_1f1b,
-                                 num_virtual=V)
+                                 num_virtual=V, remat_policy=remat_policy)
     elif schedule == "1f1b":
-        spmd = pipeline_spmd_1f1b
+        spmd = functools.partial(pipeline_spmd_1f1b,
+                                 remat_policy=remat_policy)
     else:
+        if remat_policy is not None:
+            raise ValueError(
+                "remat_policy requires the 1f1b schedule (the gpipe autodiff "
+                "path derives its own recompute from the scan)")
         spmd = pipeline_spmd
     inner = functools.partial(spmd, block_fn, axis_name=axis_name)
     mapped = jax.shard_map(

@@ -141,24 +141,28 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
                 "pipeline parallelism with compute_dtype='bfloat16' "
                 "crashes the XLA CPU backend; use compute_dtype='float32' "
                 "for CPU runs (bf16 is for TPU)")
-        if pol_name != "full":
+        schedule = getattr(config, "pp_schedule", "1f1b")
+        pol = POLICIES[pol_name]
+        if pol is not None and schedule != "1f1b":
             import warnings
             warnings.warn(
-                f"remat_policy={pol_name!r} is not applied under pipeline "
-                "parallelism: the pp schedules recompute per-tick (1f1b "
-                "checkpoints stage inputs); only 'full' semantics apply")
-        # NOTE: no per-block remat inside the pipelined region — the GPipe scan
-        # already recomputes per-tick; remat's constant residuals break the
-        # shard_map vma typing of the reverse scan. The 1f1b schedule has its
-        # own hand-written backward with stage-input checkpointing.
+                f"remat_policy={pol_name!r} needs the 1f1b schedule; the "
+                "gpipe autodiff path derives recompute from the scan — "
+                "falling back to full recompute")
+            pol = None
+        # 1f1b/VPP: the selective-save policy applies to the per-tick stage
+        # vjp (stage-input checkpointing stays; the policy decides which
+        # per-layer residuals the tick keeps — e.g. 'dots' pins MXU
+        # outputs). The GPipe autodiff path keeps scan-derived recompute.
         # Under VPP the hybrid step stores blocks in vpp_storage_perm order
         # (see HybridTrainStep.__post_init__), so reshaping to chunks is
         # contiguous and needs no cross-device reshard.
         x = run_pipeline(block, params["blocks"], x, num_microbatches, mesh=mesh,
-                         schedule=getattr(config, "pp_schedule", "1f1b"),
+                         schedule=schedule,
                          interleave=getattr(config, "pp_interleave", 1),
                          vpp_stage_major=getattr(config, "vpp_stage_major",
-                                                 False))
+                                                 False),
+                         remat_policy=pol)
     else:
         ck_block = jax.checkpoint(block, policy=POLICIES[pol_name])
 
