@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""MFU sweep for ResNet-50 and BERT-base on the real chip (VERDICT r4 #4).
+
+Runs a matrix of configs and prints one line per result; append the winners
+to TPU_SMOKE.log. Designed for a flaky tunnel: every config is independent,
+results stream as they finish, and the script never kills a TPU claim.
+
+  python tools_mfu_sweep.py resnet   # layout x dtype x batch sweep
+  python tools_mfu_sweep.py bert     # seq/batch sweep with flash attn
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def _sync(x):
+    import jax
+    jax.device_get(jax.tree_util.tree_leaves(x)[0])
+
+
+def _peak():
+    import jax
+    from bench import peak_flops_bf16
+    return peak_flops_bf16(getattr(jax.devices()[0], "device_kind", ""))
+
+
+def resnet_case(batch, data_format, dtype, steps=20):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    model = paddle.vision.models.resnet50(num_classes=1000,
+                                          data_format=data_format)
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    if dtype == "bf16":
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+    step = paddle.jit.TrainStep(model, nn.CrossEntropyLoss(), opt)
+    import jax.numpy as jnp
+    shape = (batch, 3, 224, 224) if data_format == "NCHW" \
+        else (batch, 224, 224, 3)
+    x_np = np.random.RandomState(0).rand(*shape).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    if dtype == "bf16":
+        # activations must ENTER as bf16: conv casts weights UP to the
+        # activation dtype, so fp32 input would silently run fp32 convs
+        x = paddle.to_tensor(jnp.asarray(x_np, jnp.bfloat16))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(
+        0, 1000, (batch, 1)).astype(np.int64))
+    loss = step(x, y)          # compile
+    _sync(loss._data)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    _sync(loss._data)
+    dt = (time.perf_counter() - t0) / steps
+    img_s = batch / dt
+    # ResNet-50 fwd ~4.1 GFLOPs/img @224; x3 for training
+    mfu = img_s * 4.1e9 * 3 / _peak()
+    print(f"RESNET50 {data_format} {dtype} bs{batch}: {img_s:.0f} img/s, "
+          f"{dt * 1e3:.1f} ms/step, MFU {mfu * 100:.1f}%, "
+          f"loss {float(np.asarray(loss.numpy())):.3f}", flush=True)
+
+
+def bert_case(batch, seq, use_flash, steps=15, tiny=False):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import BertForPretraining, BertConfig
+
+    cfg = BertConfig() if not tiny else BertConfig(
+        vocab_size=512, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128)
+    if hasattr(cfg, "use_flash"):
+        cfg.use_flash = use_flash
+    paddle.seed(0)
+    net = BertForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(1e-4)
+    net, opt = paddle.amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    step = paddle.jit.TrainStep(
+        net, lambda out, lbl: net.loss(out, lbl), opt)
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    loss = step(ids, labels)
+    _sync(loss._data)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    _sync(loss._data)
+    dt = (time.perf_counter() - t0) / steps
+    tok_s = batch * seq / dt
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    mfu = tok_s * 6 * n_params / _peak()
+    print(f"BERT bs{batch} seq{seq} flash={use_flash}: "
+          f"{tok_s:.0f} tok/s, {dt * 1e3:.1f} ms/step, "
+          f"MFU {mfu * 100:.1f}%, loss "
+          f"{float(np.asarray(loss.numpy())):.3f}", flush=True)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "resnet"
+    if which == "resnet":
+        for df in ("NHWC", "NCHW"):
+            for dtype in ("bf16",):
+                for bs in (256, 128):
+                    try:
+                        resnet_case(bs, df, dtype)
+                    except Exception as e:  # noqa: BLE001
+                        print(f"RESNET50 {df} {dtype} bs{bs}: FAILED "
+                              f"{str(e)[:160]}", flush=True)
+    else:
+        for bs, seq in ((64, 512), (128, 256), (32, 512)):
+            for flash in (True, False):
+                try:
+                    bert_case(bs, seq, flash)
+                except Exception as e:  # noqa: BLE001
+                    print(f"BERT bs{bs} seq{seq} flash={flash}: FAILED "
+                          f"{str(e)[:160]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
