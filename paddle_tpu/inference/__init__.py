@@ -108,8 +108,28 @@ def save_inference_model(path_prefix, layer, input_spec, platforms=None):
             layer.train()
 
 
+class PrecisionType:
+    """ref: paddle.inference.PrecisionType."""
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
 class Config:
-    """Deploy config (parity shim for paddle.inference.Config)."""
+    """Deploy config (ref: paddle.inference.Config, paddle/fluid/inference/
+    api/paddle_analysis_config.h).
+
+    The reference's knobs are IR-pass and engine selection; the TPU-native
+    analogs are compile-time choices on the XLA executable:
+      precision           -> serve-time param/compute dtype cast (bf16 Half)
+      enable_memory_optim -> donate input buffers to the executable
+      pass control        -> raw XLA compiler options on the jit
+                             (set_compiler_option / delete_pass no-op list)
+      enable_profile      -> jax profiler trace around run()
+    GPU/TensorRT/MKLDNN toggles are accepted no-ops (recorded, with the
+    device owned by jax), so reference deploy scripts run unmodified.
+    """
 
     def __init__(self, prog_file=None, params_file=None):
         # accept either a path prefix or explicit file paths
@@ -118,19 +138,95 @@ class Config:
         else:
             self.path_prefix = prog_file
         self._device = None
+        self._precision = PrecisionType.Float32
+        self._memory_optim = False
+        self._profile = False
+        self._compiler_options = {}
+        self._deleted_passes = []
+        self._num_threads = None
 
-    def enable_use_gpu(self, *a, **k):  # reference API compat; device is jax's
+    # -- model location ----------------------------------------------------
+    def set_model(self, prog_file, params_file=None):
+        if prog_file.endswith(_HLO_SUFFIX):
+            prog_file = prog_file[: -len(_HLO_SUFFIX)]
+        self.path_prefix = prog_file
+
+    def model_dir(self):
+        return self.path_prefix
+
+    # -- device / precision -------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision_mode=None):
+        # reference API compat; the device is jax's. precision_mode is the
+        # real signal: Half/Bfloat16 serve the model in bf16 on the MXU.
         self._device = "gpu"
+        if precision_mode in (PrecisionType.Half, PrecisionType.Bfloat16):
+            self._precision = PrecisionType.Bfloat16
 
     def disable_gpu(self):
         self._device = "cpu"
 
+    def set_precision(self, precision):
+        if precision == PrecisionType.Half:
+            precision = PrecisionType.Bfloat16  # fp16 serves as bf16 on TPU
+        self._precision = precision
+
+    def precision(self):
+        return self._precision
+
+    # -- executable options --------------------------------------------------
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = bool(x)
+
+    def set_compiler_option(self, key, value):
+        """Pass-control analog: raw XLA compiler option on the compiled
+        executable (e.g. 'xla_tpu_enable_latency_hiding_scheduler')."""
+        self._compiler_options[key] = value
+
+    def delete_pass(self, name):
+        # the reference prunes IR passes by name; XLA's pipeline is not
+        # name-addressable — record for introspection, compilation is
+        # unaffected
+        self._deleted_passes.append(name)
+
+    def pass_builder(self):
+        return self._deleted_passes
+
+    def switch_ir_optim(self, x=True):
+        pass  # XLA always optimizes; kept for script compat
+
+    def enable_profile(self):
+        self._profile = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._num_threads = int(n)
+
+    def enable_mkldnn(self):
+        pass  # host library choice is jax's
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError(
+            "TensorRT does not exist on TPU; the XLA executable IS the "
+            "optimized engine (precision via set_precision)")
+
+    def summary(self):
+        return {"model": self.path_prefix, "precision": self._precision,
+                "memory_optim": self._memory_optim,
+                "compiler_options": dict(self._compiler_options),
+                "deleted_passes": list(self._deleted_passes)}
+
 
 class Predictor:
-    """Runs a saved inference artifact. No model source code required."""
+    """Runs a saved inference artifact. No model source code required.
+    A `Config` applies serve-time choices: precision cast, input-buffer
+    donation (memory optim), raw XLA compiler options, profiling."""
 
-    def __init__(self, path_prefix):
+    def __init__(self, path_prefix, config=None):
+        if isinstance(path_prefix, Config):
+            config = path_prefix
+            path_prefix = config.path_prefix
         self.path_prefix = path_prefix
+        self._config_obj = config
         with open(path_prefix + _HLO_SUFFIX, "rb") as f:
             self._exported = jexport.deserialize(f.read())
         blob = fio.load(path_prefix + _PARAMS_SUFFIX)
@@ -138,7 +234,44 @@ class Predictor:
         self._buffers = blob["buffers"]
         with open(path_prefix + _CONFIG_SUFFIX) as f:
             self.config = json.load(f)
-        self._call = jax.jit(self._exported.call)
+        jit_kwargs = {}
+        exported_call = self._exported.call
+        serve_fn = exported_call
+        if config is not None:
+            if config._precision == PrecisionType.Bfloat16:
+                # the exported HLO's avals are fixed, so precision here is a
+                # STORAGE choice: weights live bf16 in HBM (half footprint)
+                # and upcast at the jit boundary (XLA fuses the cast).
+                # For bf16 COMPUTE, export under amp.decorate(level='O2').
+                import jax.numpy as jnp
+                pd = jax.tree_util.tree_map(lambda a: a.dtype, self._params)
+                bd = jax.tree_util.tree_map(lambda a: a.dtype, self._buffers)
+                shrink = lambda a: a.astype(jnp.bfloat16) if hasattr(  # noqa: E731
+                    a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                    else a
+                self._params = jax.tree_util.tree_map(shrink, self._params)
+                self._buffers = jax.tree_util.tree_map(shrink, self._buffers)
+
+                def serve_fn(params, buffers, *arrs):
+                    p = jax.tree_util.tree_map(
+                        lambda a, d: a.astype(d), params, pd)
+                    b = jax.tree_util.tree_map(
+                        lambda a, d: a.astype(d), buffers, bd)
+                    return exported_call(p, b, *arrs)
+            if config._compiler_options:
+                jit_kwargs["compiler_options"] = dict(
+                    config._compiler_options)
+            if config._memory_optim:
+                # donate the activations' input slots (params/buffers are
+                # reused across calls and must survive)
+                jit_kwargs["donate_argnums"] = tuple(
+                    2 + i for i in range(len(self.config["inputs"])))
+        try:
+            self._call = jax.jit(serve_fn, **jit_kwargs)
+        except TypeError:
+            # older jax without compiler_options on jit
+            jit_kwargs.pop("compiler_options", None)
+            self._call = jax.jit(serve_fn, **jit_kwargs)
         self._inputs = [None] * len(self.config["inputs"])
 
     # -- simple API --------------------------------------------------------
@@ -147,7 +280,16 @@ class Predictor:
         if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
             inputs = tuple(inputs[0])
         arrs = [x._data if isinstance(x, Tensor) else np.asarray(x) for x in inputs]
-        outs = self._call(self._params, self._buffers, *arrs)
+        prof_ctx = None
+        if self._config_obj is not None and self._config_obj._profile:
+            from .. import profiler as _prof
+            prof_ctx = _prof.RecordEvent("inference.run")
+            prof_ctx.__enter__()
+        try:
+            outs = self._call(self._params, self._buffers, *arrs)
+        finally:
+            if prof_ctx is not None:
+                prof_ctx.__exit__(None, None, None)
         flat = jax.tree_util.tree_leaves(outs)
         return [np.asarray(jax.device_get(o)) for o in flat]
 
@@ -200,4 +342,4 @@ def load_inference_model(path_prefix):
 
 
 def create_predictor(config):
-    return Predictor(config.path_prefix)
+    return Predictor(config.path_prefix, config=config)

@@ -140,3 +140,64 @@ class TestGraftEntry:
         fn, args = g.entry()
         out = jax.jit(fn).lower(*args).compile()
         assert out is not None
+
+
+class TestInferenceConfig:
+    """Predictor Config surface (ref: paddle.inference.Config /
+    paddle_analysis_config.h): precision (bf16 storage), memory optim
+    (donation), compiler options (pass-control analog), profiling."""
+
+    def _artifact(self, tmp_path):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        prefix = str(tmp_path / "m")
+        paddle.inference.save_inference_model(
+            prefix, m, [paddle.static.InputSpec([2, 4], "float32")])
+        return prefix
+
+    def test_precision_bf16_storage(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu as paddle
+        prefix = self._artifact(tmp_path)
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+
+        base = paddle.inference.Predictor(prefix)
+        ref = base.run(x)[0]
+
+        cfg = paddle.inference.Config(prefix)
+        cfg.set_precision(paddle.inference.PrecisionType.Half)
+        pred = paddle.inference.create_predictor(cfg)
+        # weights resident in bf16 (half HBM), outputs close to fp32 serve
+        kinds = {l.dtype for l in jax.tree_util.tree_leaves(pred._params)
+                 if jnp.issubdtype(l.dtype, jnp.floating)}
+        assert kinds == {jnp.dtype(jnp.bfloat16)}
+        out = pred.run(x)[0]
+        np.testing.assert_allclose(out, ref, rtol=3e-2, atol=3e-2)
+
+    def test_memory_optim_and_summary(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        prefix = self._artifact(tmp_path)
+        cfg = paddle.inference.Config(prefix)
+        cfg.enable_memory_optim()
+        cfg.delete_pass("fc_fuse_pass")
+        cfg.set_cpu_math_library_num_threads(4)
+        cfg.switch_ir_optim(True)
+        pred = paddle.inference.create_predictor(cfg)
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        out1 = pred.run(x)[0]
+        out2 = pred.run(x)[0]  # donation must not break repeat calls
+        np.testing.assert_allclose(out1, out2)
+        s = cfg.summary()
+        assert s["memory_optim"] and "fc_fuse_pass" in s["deleted_passes"]
+
+    def test_tensorrt_points_to_xla(self, tmp_path):
+        import pytest as _pytest
+        import paddle_tpu as paddle
+        cfg = paddle.inference.Config(self._artifact(tmp_path))
+        with _pytest.raises(NotImplementedError, match="XLA"):
+            cfg.enable_tensorrt_engine()
