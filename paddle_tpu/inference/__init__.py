@@ -169,6 +169,11 @@ class Config:
     def set_precision(self, precision):
         if precision == PrecisionType.Half:
             precision = PrecisionType.Bfloat16  # fp16 serves as bf16 on TPU
+        if precision not in (PrecisionType.Float32, PrecisionType.Bfloat16):
+            raise NotImplementedError(
+                f"serving precision {precision!r} is not supported here; "
+                f"int8 inference goes through paddle_tpu.quantization "
+                f"(PTQ/QAT) before export")
         self._precision = precision
 
     def precision(self):
@@ -280,18 +285,26 @@ class Predictor:
         if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
             inputs = tuple(inputs[0])
         arrs = [x._data if isinstance(x, Tensor) else np.asarray(x) for x in inputs]
+        cfg = self._config_obj
+        if cfg is not None and cfg._memory_optim:
+            # donation deletes input buffers after the call — donate fresh
+            # copies, never the caller's live Tensor storage
+            arrs = [jax.numpy.array(a, copy=True)
+                    if isinstance(a, jax.Array) else a for a in arrs]
         prof_ctx = None
-        if self._config_obj is not None and self._config_obj._profile:
+        if cfg is not None and cfg._profile:
             from .. import profiler as _prof
             prof_ctx = _prof.RecordEvent("inference.run")
             prof_ctx.__enter__()
         try:
             outs = self._call(self._params, self._buffers, *arrs)
+            flat = jax.tree_util.tree_leaves(outs)
+            # fetch INSIDE the profiled region: execution is async and the
+            # trace must cover the device time, not just dispatch
+            return [np.asarray(jax.device_get(o)) for o in flat]
         finally:
             if prof_ctx is not None:
                 prof_ctx.__exit__(None, None, None)
-        flat = jax.tree_util.tree_leaves(outs)
-        return [np.asarray(jax.device_get(o)) for o in flat]
 
     # -- reference-style handle API ---------------------------------------
     def get_input_names(self):

@@ -17,8 +17,11 @@ from paddle_tpu.models.ernie import ErnieConfig, ErnieForMaskedLM, \
 
 @pytest.fixture(autouse=True)
 def restore_global_mesh():
+    """Start meshless (earlier test files leak a global mesh, which would
+    silently shard the 'single-device' parity baseline) and restore after."""
     from paddle_tpu.distributed import env
     prev = env.get_mesh()
+    env.set_mesh(None)
     yield
     env.set_mesh(prev)
 

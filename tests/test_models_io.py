@@ -201,3 +201,25 @@ class TestInferenceConfig:
         cfg = paddle.inference.Config(self._artifact(tmp_path))
         with _pytest.raises(NotImplementedError, match="XLA"):
             cfg.enable_tensorrt_engine()
+
+    def test_memory_optim_preserves_caller_tensors(self, tmp_path):
+        """Donation must copy, never delete the caller's Tensor buffers."""
+        import numpy as np
+        import paddle_tpu as paddle
+        prefix = self._artifact(tmp_path)
+        cfg = paddle.inference.Config(prefix)
+        cfg.enable_memory_optim()
+        pred = paddle.inference.create_predictor(cfg)
+        t = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                             .astype(np.float32))
+        out1 = pred.run(t)[0]
+        out2 = pred.run(t)[0]  # same live Tensor again
+        np.testing.assert_allclose(out1, out2)
+        assert np.isfinite(np.asarray(t.numpy())).all()  # buffer intact
+
+    def test_int8_precision_rejected(self, tmp_path):
+        import pytest as _pytest
+        import paddle_tpu as paddle
+        cfg = paddle.inference.Config(self._artifact(tmp_path))
+        with _pytest.raises(NotImplementedError, match="quantization"):
+            cfg.set_precision(paddle.inference.PrecisionType.Int8)
