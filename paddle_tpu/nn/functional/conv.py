@@ -108,8 +108,17 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
         k = w.shape[2:]
         if isinstance(pad, str):
             if pad == "SAME":
-                raise NotImplementedError("SAME padding for conv_transpose unsupported")
-            p_list = [(0, 0)] * ndims  # VALID
+                # SAME transpose (paddle/TF semantics): output spatial size =
+                # input * stride. The implied forward-conv SAME padding is
+                # pt = max(k_eff - stride, 0), split low/high — the exact
+                # adjoint of conv(..., padding="SAME", stride)
+                p_list = []
+                for i in range(ndims):
+                    ke = (k[i] - 1) * dilation[i] + 1
+                    pt = max(ke - stride[i], 0)
+                    p_list.append((pt // 2, pt - pt // 2))
+            else:
+                p_list = [(0, 0)] * ndims  # VALID
         else:
             p_list = pad
         tpad = []

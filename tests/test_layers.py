@@ -253,3 +253,29 @@ class TestInitClip:
         (m(x) ** 2).sum().backward()
         # applied by optimizer; check the object exists and is callable machinery
         assert clip.clip_norm == 1.0
+
+
+class TestConvTransposeSame:
+    def test_same_padding_shapes_and_adjoint(self):
+        """SAME conv_transpose (paddle/TF semantics: out = in * stride) is
+        the exact adjoint of SAME conv — <conv(x), g> == <x, convT(g)>."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(5, 3, 3, 3).astype(np.float32))
+        y = F.conv2d(x, w, stride=2, padding="SAME")
+        assert list(y.shape) == [2, 5, 4, 4]
+        g = paddle.to_tensor(rng.randn(2, 5, 4, 4).astype(np.float32))
+        z = F.conv2d_transpose(g, w, stride=2, padding="SAME")
+        assert list(z.shape) == [2, 3, 8, 8]  # in * stride
+        lhs = float((np.asarray(y.numpy()) * np.asarray(g.numpy())).sum())
+        rhs = float((np.asarray(x.numpy()) * np.asarray(z.numpy())).sum())
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-5)
+
+    def test_same_padding_1d(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(1)
+        g = paddle.to_tensor(rng.randn(2, 4, 5).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(4, 3, 3).astype(np.float32))
+        z = F.conv1d_transpose(g, w, stride=3, padding="SAME")
+        assert list(z.shape) == [2, 3, 15]
