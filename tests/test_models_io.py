@@ -223,3 +223,38 @@ class TestInferenceConfig:
         cfg = paddle.inference.Config(self._artifact(tmp_path))
         with _pytest.raises(NotImplementedError, match="quantization"):
             cfg.set_precision(paddle.inference.PrecisionType.Int8)
+
+
+class TestQuantizedExport:
+    """The int8 serving path the inference Config points to: PTQ -> convert
+    -> save_inference_model -> Predictor (ref: paddle.quantization PTQ +
+    paddle.inference deploy flow)."""
+
+    def test_ptq_model_exports_and_serves(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import PTQ
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        rng = np.random.RandomState(0)
+        calib = rng.randn(32, 8).astype(np.float32)
+        ref = np.asarray(model(paddle.to_tensor(calib)).numpy())
+
+        q = PTQ()  # default config: abs-max observers on linear layers
+        qm = q.quantize(model)
+        for i in range(0, 32, 8):  # calibration passes
+            qm(paddle.to_tensor(calib[i:i + 8]))
+        converted = q.convert(qm)
+        qout = np.asarray(converted(paddle.to_tensor(calib)).numpy())
+        # int8 weights: close but not equal to fp32
+        assert np.abs(qout - ref).max() < 0.35
+        assert not np.allclose(qout, ref)
+
+        prefix = str(tmp_path / "q")
+        paddle.inference.save_inference_model(
+            prefix, converted, [paddle.static.InputSpec([8, 8], "float32")])
+        pred = paddle.inference.Predictor(prefix)
+        served = pred.run(calib[:8])[0]
+        np.testing.assert_allclose(served, qout[:8], rtol=1e-4, atol=1e-5)
