@@ -51,6 +51,18 @@ class DistributedStrategy:
         self.dgc = False
         self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
                             "sparsity": [0.999]}
+        # localsgd / fp16_allreduce (ref: fleet/meta_optimizers/
+        # localsgd_optimizer.py, fp16_allreduce_optimizer.py): both exist to
+        # cut NCCL allreduce cost. Under GSPMD the gradient reduction is
+        # compiler-emitted from shardings, so the faithful mappings are:
+        #   fp16_allreduce -> amp O2 (bf16 grads => bf16 collective payload)
+        #   localsgd       -> gradient_merge (k-step local accumulation
+        #                     before the fused reduce+update)
+        # Setting these flags warns with that mapping instead of silently
+        # doing nothing.
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
+        self.fp16_allreduce = False
         self.find_unused_parameters = False
 
 
@@ -197,15 +209,32 @@ class Fleet:
                 sparsity=cfg.get("sparsity", [0.999]),
                 use_nesterov=optimizer._nesterov,
                 grad_clip=optimizer._grad_clip)
+        import warnings
+        if strategy is not None and getattr(strategy, "localsgd", False):
+            k = int(strategy.localsgd_configs.get("k_steps", 1))
+            warnings.warn(
+                "strategy.localsgd maps to gradient_merge on this backend "
+                "(GSPMD emits the reduction; k-step local accumulation is "
+                f"the compiled analog) — applying k_steps={k}; begin_step "
+                "is ignored (accumulation starts immediately)")
+            optimizer._gradient_merge_k = max(
+                k, int(getattr(optimizer, "_gradient_merge_k", 1)))
+        if strategy is not None and getattr(strategy, "fp16_allreduce", False):
+            warnings.warn(
+                "strategy.fp16_allreduce maps to amp O2 on this backend: "
+                "bf16 gradients make the compiler-emitted collective carry "
+                "16-bit payloads — use paddle.amp.decorate(level='O2')")
         optimizer._zero_stage = self._zero_stage
         optimizer._shard_opt_states_axis = (
             "sharding" if self._zero_stage >= 1 and
             (get_mesh() and get_mesh().shape.get("sharding", 1) > 1) else None)
         if strategy is not None and getattr(strategy, "gradient_merge", False):
             # ref: fleet/meta_optimizers/gradient_merge_optimizer.py —
-            # TrainStep fuses the k-step accumulation into the compiled step
-            optimizer._gradient_merge_k = int(
-                strategy.gradient_merge_configs.get("k_steps", 1))
+            # TrainStep fuses the k-step accumulation into the compiled
+            # step. max() so a larger localsgd k is not silently clobbered.
+            optimizer._gradient_merge_k = max(
+                int(strategy.gradient_merge_configs.get("k_steps", 1)),
+                int(getattr(optimizer, "_gradient_merge_k", 1)))
         return optimizer
 
 

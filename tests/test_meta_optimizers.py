@@ -216,3 +216,42 @@ def test_lars_swap_keeps_sharding_and_gradient_merge_attrs():
     assert opt._zero_stage == 2
     assert opt._shard_opt_states_axis == "sharding"
     assert opt._gradient_merge_k == 4
+
+
+def test_localsgd_maps_to_gradient_merge():
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.localsgd = True
+    strategy.localsgd_configs = {"k_steps": 4, "begin_step": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    with pytest.warns(UserWarning, match="gradient_merge"):
+        opt = fleet.distributed_optimizer(opt)
+    assert opt._gradient_merge_k == 4
+
+
+def test_fp16_allreduce_warns_amp_mapping():
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.fp16_allreduce = True
+    fleet.init(is_collective=True, strategy=strategy)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    with pytest.warns(UserWarning, match="amp O2"):
+        fleet.distributed_optimizer(opt)
+
+
+def test_localsgd_k_survives_gradient_merge_combination():
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.localsgd = True
+    strategy.localsgd_configs = {"k_steps": 8, "begin_step": 1}
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    with pytest.warns(UserWarning):
+        opt = fleet.distributed_optimizer(opt)
+    assert opt._gradient_merge_k == 8  # the larger k wins
