@@ -324,6 +324,36 @@ class Engine:
                 lambda a: np.asarray(jax.device_get(a)), out))
         return outputs
 
+    def dataloader(self, dataset, batch_size=1, shuffle=False,
+                   collate_fn=None, num_workers=0, sample_split=None,
+                   mode="train"):
+        """Build the loader the engine will consume (ref: engine.py:1234
+        dataloader). On this backend there is no distributed reader
+        transformation — batches enter the compiled step and GSPMD scatters
+        them per the data sharding."""
+        self._mode = mode
+        return self._make_loader(dataset, batch_size, collate_fn=collate_fn,
+                                 shuffle=shuffle and mode == "train")
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Pre-build the compiled step from InputSpecs (ref: engine.py:1320
+        prepare): trace/compile happens now instead of on the first batch."""
+        self._mode = mode
+        if mode != "train":
+            return self
+        if inputs_spec is None:
+            raise ValueError("prepare() needs inputs_spec")
+        to_list = lambda s: list(s) if isinstance(s, (list, tuple)) else [s]  # noqa: E731
+        zeros = [np.zeros([d or 1 for d in spec.shape],
+                          getattr(spec, "dtype", "float32"))
+                 for spec in to_list(inputs_spec)]
+        zlabels = [np.zeros([d or 1 for d in spec.shape],
+                            getattr(spec, "dtype", "float32"))
+                   for spec in to_list(labels_spec or [])]
+        self.run(zeros + zlabels, mode="train",
+                 sample_split=len(zeros))
+        return self
+
     # -- single-step execution (ref: engine.py:1376 run) ---------------------
 
     def run(self, data=None, feed=None, fetch_list=None, mode=None,

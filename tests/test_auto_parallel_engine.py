@@ -247,3 +247,20 @@ def test_gpt_engine_save_load_roundtrip(tmp_path):
     l2 = float(np.asarray(jax.device_get(
         engine2._train_step.loss_only(ids))))
     np.testing.assert_allclose(l2, l_ref, rtol=1e-5)
+
+
+def test_engine_prepare_and_dataloader():
+    """ref: engine.py:1320 prepare / :1234 dataloader."""
+    from paddle_tpu.static import InputSpec
+    model = _mlp()
+    engine = Engine(model, nn.MSELoss(),
+                    paddle.optimizer.Adam(0.05,
+                                          parameters=model.parameters()))
+    engine.prepare(inputs_spec=[InputSpec([8, 8], "float32")],
+                   labels_spec=[InputSpec([8, 1], "float32")])
+    assert engine._train_step is not None
+    assert engine._train_step._jitted is not None  # compiled eagerly
+    loader = engine.dataloader(_dataset(), batch_size=8, shuffle=True)
+    losses = [float(np.asarray(engine.run(b, mode="train").numpy()))
+              for b in loader]
+    assert len(losses) == 4 and all(np.isfinite(l) for l in losses)
