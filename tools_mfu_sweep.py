@@ -102,8 +102,61 @@ def bert_case(batch, seq, use_flash, steps=15, tiny=False):
           f"{float(np.asarray(loss.numpy())):.3f}", flush=True)
 
 
+def gpt_flash_tiles(model_name="gpt3-1.3B", batch=8, seq=2048, steps=8):
+    """Sweep pallas flash-attention tile sizes on the flagship config —
+    the single-chip GPT MFU autotune surface (flash_block_q/k)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT_CONFIGS
+    from paddle_tpu.models.gpt_hybrid import HybridTrainStep
+
+    for bq, bk in ((256, 256), (512, 256), (256, 512), (512, 512),
+                   (1024, 256), (128, 128)):
+        try:
+            cfg = GPT_CONFIGS[model_name]
+            cfg.max_seq_len = max(cfg.max_seq_len, seq)
+            cfg.use_flash = True
+            cfg.compute_dtype = "bfloat16"
+            cfg.remat = True
+            cfg.flash_block_q, cfg.flash_block_k = bq, bk
+            opt = paddle.optimizer.AdamW(
+                2e-4, grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0),
+                moment_dtype="bfloat16")
+            step = HybridTrainStep(cfg, opt, param_dtype=jnp.bfloat16)
+            ids = jax.random.randint(jax.random.key(0), (batch, seq), 0,
+                                     cfg.vocab_size, jnp.int32)
+            loss = step(ids)
+            _sync(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = step(ids)
+            _sync(loss)
+            dt = (time.perf_counter() - t0) / steps
+            tok_s = batch * seq / dt
+            from bench import model_flops_per_token
+            fpt, _ = model_flops_per_token(cfg, seq)
+            print(f"FLASH {model_name} bq{bq} bk{bk}: {tok_s:.0f} tok/s, "
+                  f"{dt:.3f} s/step, MFU {tok_s * fpt / _peak() * 100:.1f}%",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"FLASH bq{bq} bk{bk}: FAILED {str(e)[:140]}", flush=True)
+        finally:
+            import gc
+            gc.collect()
+            for a in jax.live_arrays():
+                try:
+                    a.delete()
+                except Exception:  # noqa: BLE001
+                    pass
+            jax.clear_caches()
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "resnet"
+    if which == "flash":
+        gpt_flash_tiles()
+        return
     if which == "resnet":
         for df in ("NHWC", "NCHW"):
             for dtype in ("bf16",):
