@@ -224,6 +224,30 @@ def _beam_search_jit(params, ids, *, cfg, max_new_tokens, num_beams,
     return jnp.concatenate([ids, best_seq], axis=1)
 
 
+def generate_from_params(params, input_ids, config, max_new_tokens=32,
+                         do_sample=False, temperature=1.0, top_k=None,
+                         top_p=None, eos_token_id=None, seed=0):
+    """Generate from a FUNCTIONAL param tree (models/gpt_hybrid.py
+    init_gpt_params layout) — the public decode entry for params produced
+    by HybridTrainStep / the Engine, no Layer required."""
+    from ..tensor_impl import Tensor
+    ids = jnp.asarray(input_ids._data if isinstance(input_ids, Tensor)
+                      else input_ids, jnp.int32)
+    assert ids.shape[1] + max_new_tokens <= config.max_seq_len, \
+        "prompt + max_new_tokens exceeds config.max_seq_len (wpe table)"
+    cfg_key = (config.num_heads, config.num_layers, config.hidden_size,
+               config.layer_norm_epsilon, config.compute_dtype)
+    out = _generate_jit(params, ids, jax.random.key(seed), cfg=cfg_key,
+                        max_new_tokens=int(max_new_tokens),
+                        do_sample=bool(do_sample),
+                        temperature=float(temperature),
+                        top_k=None if top_k in (None, 0)
+                        else min(int(top_k), config.vocab_size),
+                        top_p=None if top_p in (None, 1.0) else float(top_p),
+                        eos_token_id=eos_token_id)
+    return Tensor(out)
+
+
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
              seed=0, num_beams=1, length_penalty=1.0):
