@@ -314,11 +314,22 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
 
 
 def case(pred_fn_pairs, default=None, name=None):
-    for pred, fn in pred_fn_pairs:
-        p = as_tensor_data(pred)
-        if _is_tracer(p):
-            raise NotImplementedError(
-                "traced static.nn.case: express as nested cond()")
+    preds = [as_tensor_data(p) for p, _ in pred_fn_pairs]
+    if any(_is_tracer(p) for p in preds):
+        # first-true-wins cascade lowered to nested lax.cond (the reference
+        # emits a cascade of conditional blocks, control_flow.py case)
+        tail = default if default is not None else pred_fn_pairs[-1][1]
+
+        def build(i):
+            if i == len(pred_fn_pairs):
+                return tail
+            p, fn = preds[i], pred_fn_pairs[i][1]
+            rest = build(i + 1)
+            return lambda: jax.lax.cond(
+                jnp.reshape(jnp.asarray(p), ()).astype(bool),
+                lambda _: fn(), lambda _: rest(), None)
+        return build(0)()
+    for p, (pred, fn) in zip(preds, pred_fn_pairs):
         if bool(np.asarray(jax.device_get(p))):
             return fn()
     if default is not None:

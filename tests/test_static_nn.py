@@ -191,3 +191,23 @@ class TestSequenceOps:
     def test_staticrnn_raises_with_guidance(self):
         with pytest.raises(NotImplementedError):
             snn.StaticRNN()
+
+
+def test_case_traced_first_true_wins():
+    """Traced static.nn.case lowers to a nested lax.cond cascade."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.static import nn as snn
+
+    def f(x):
+        return snn.case(
+            [(x > 10.0, lambda: x * 100.0),
+             (x > 5.0, lambda: x * 10.0),
+             (x > 0.0, lambda: x)],
+            default=lambda: -x)
+
+    jf = jax.jit(f)
+    for v, expect in ((20.0, 2000.0), (7.0, 70.0), (2.0, 2.0), (-3.0, 3.0)):
+        got = float(jf(jnp.float32(v)))
+        assert got == expect, (v, got, expect)
+        assert float(f(jnp.float32(v))) == expect  # eager parity
