@@ -53,3 +53,99 @@ def fetch_stash(enabled, dev_tree, host_tree):
         return ident, ident
     return (lambda o: move_opt(o, dev_tree),
             lambda o: move_opt(o, host_tree))
+
+
+def streamed_apply_gradients(optimizer, params, grads, state, lr, wd_mask,
+                             stacked, to_dev=None, to_host=None):
+    """Offloaded optimizer update that streams stacked [L, ...] slot arrays
+    through device memory one leading-dim slice at a time (ref:
+    fleet/meta_parallel/sharding/group_sharded_stage3.py:84 cpu offload).
+
+    The bulk fetch/update/stash alternative puts the whole moment set back
+    in HBM for the update — exactly the residency offload exists to avoid
+    (for a 2.7B model m+v is ~10.8G bf16 against a 15.75G chip, an OOM even
+    before activations). Streaming caps peak HBM at params + grads + ONE
+    layer's slots.
+
+    params/grads: dict[name -> array]. state: {"step", "slots"} with slot
+    arrays host-resident. stacked: leaf names whose leading dim is the
+    layer axis. to_dev/to_host: per-array transfer closures (None =
+    identity — used by backends without in-jit transfers and by the CPU
+    math-parity tests; the loop structure is backend-agnostic).
+
+    Non-stacked leaves do one bulk fetch/update/stash (their slots are the
+    small embedding/norm tail). Stacked leaves run a lax.fori_loop whose
+    carry is (device param arrays, host slot arrays): each iteration DMAs
+    one layer's slots in, updates, and DMAs them back. The loop-carried
+    dependency is what serializes the copies — an unrolled chain lets XLA
+    hoist every copy-start and re-create the bulk residency.
+    """
+    import jax.lax as lax
+    ident = lambda a: a  # noqa: E731
+    to_dev = to_dev or ident
+    to_host = to_host or ident
+    slots = state["slots"]
+    if not getattr(optimizer, "_elementwise_update", False):
+        # norm/history-based updates (Lamb trust ratio, LARS local_lr,
+        # LBFGS) are not slice-equivariant: updating layer slices would
+        # silently change the math vs the bulk update. Bulk-transfer those.
+        stacked = ()
+    stk = [n for n in params if n in stacked and grads.get(n) is not None]
+    # frozen leaves (no grad) keep their slots host-resident untouched —
+    # routing them through the bulk fetch would transfer whole [L, ...]
+    # moment sets just to pass them through unchanged
+    frozen = [n for n in params if n not in stk and grads.get(n) is None]
+    small = [n for n in params if n not in stk and n not in frozen]
+
+    small_state = {"step": state["step"],
+                   "slots": {n: {k: to_dev(v) if jnp.ndim(v) else v
+                                 for k, v in slots[n].items()}
+                             for n in small}}
+    new_params, small_out = optimizer.apply_gradients(
+        {n: params[n] for n in small}, {n: grads[n] for n in small},
+        small_state, lr, wd_mask=wd_mask)
+    new_step = small_out["step"]  # apply_gradients returns step+1 even
+    # when the small dict is empty
+    new_slots = {n: {k: to_host(v) if jnp.ndim(v) else v
+                     for k, v in s.items()}
+                 for n, s in small_out["slots"].items()}
+    for n in frozen:
+        new_params[n] = params[n]
+        new_slots[n] = slots[n]
+
+    if stk:
+        num_layers = params[stk[0]].shape[0]
+        mismatched = [n for n in stk if params[n].shape[0] != num_layers]
+        if mismatched:
+            # dynamic_index_in_dim clamps out-of-range indices, so a
+            # leading-dim mismatch would silently corrupt the update
+            raise ValueError(
+                f"stacked leaves disagree on leading dim: {mismatched} "
+                f"vs {num_layers}")
+
+        def body(layer, carry):
+            pstk, hslots = carry
+            p_l = {n: lax.dynamic_index_in_dim(pstk[n], layer, 0, False)
+                   for n in stk}
+            g_l = {n: lax.dynamic_index_in_dim(grads[n], layer, 0, False)
+                   for n in stk}
+            s_l = {n: {k: to_dev(lax.dynamic_index_in_dim(v, layer, 0, False))
+                       for k, v in hslots[n].items()} for n in stk}
+            p_new, s_new = optimizer.apply_gradients(
+                p_l, g_l, {"step": state["step"], "slots": s_l}, lr,
+                wd_mask=wd_mask)
+            pstk = {n: lax.dynamic_update_index_in_dim(
+                        pstk[n], p_new[n].astype(pstk[n].dtype), layer, 0)
+                    for n in stk}
+            hslots = {n: {k: lax.dynamic_update_index_in_dim(
+                              v, to_host(s_new["slots"][n][k].astype(v.dtype)),
+                              layer, 0)
+                          for k, v in hslots[n].items()} for n in stk}
+            return pstk, hslots
+
+        pstk, hslots = lax.fori_loop(
+            0, num_layers, body,
+            ({n: params[n] for n in stk}, {n: dict(slots[n]) for n in stk}))
+        new_params.update(pstk)
+        new_slots.update(hslots)
+    return new_params, {"step": new_step, "slots": new_slots}

@@ -228,6 +228,13 @@ def decorate(optimizer):
     (ref asp.py decorate -> OptimizerWithSparsityGuarantee)."""
 
     class OptimizerWithSparsityGuarantee:
+        # NOT slice-equivariant even when the inner optimizer is: the mask
+        # re-application keys on whole-tensor names/shapes, so the streamed
+        # host-offload path (which updates [L, ...] leaves one layer slice
+        # at a time) would silently skip every mask. Forcing the bulk path
+        # keeps the sparsity guarantee.
+        _elementwise_update = False
+
         def __init__(self, inner):
             self._inner = inner
 

@@ -302,9 +302,24 @@ class HybridTrainStep:
         mp = mesh.shape.get("mp", 1) if mesh is not None else 1
         from ..framework import offload as _ol
         offload_in = self.offload and self._offload_in_jit
+        # single-chip offload streams stacked block slots one layer at a
+        # time (bulk fetch would put the whole moment set back in HBM —
+        # the 2.7B OOM); on a multi-device mesh the slots are ZeRO- or
+        # pp-sharded over the leading dim, which conflicts with layer
+        # slicing, so bulk fetch/stash remains that path. A trivial
+        # all-ones mesh shards nothing and streams like mesh=None.
+        stream = offload_in and (
+            mesh is None or all(s == 1 for s in mesh.shape.values()))
         fetch_opt, stash_opt = _ol.fetch_stash(
-            offload_in, self._opt_dev_shardings() if offload_in else None,
+            offload_in and not stream,
+            self._opt_dev_shardings() if offload_in else None,
             self._opt_host_shardings() if offload_in else None)
+        # stream only the 3D matrix leaves: a [1, H, X] slice DMAs whole
+        # sublane tiles, while [1, H] slices of the 2D bias/norm leaves trip
+        # the TPU dynamic-index emitter's sublane-multiple check (observed
+        # compiler crash) — and their moments are only ~5MB total anyway
+        stacked = {n for n, a in self._flat(self.params).items()
+                   if "blocks" in n and a.ndim >= 3}
 
         def step_fn(flat_params, opt_state, ids, lr):
             def loss_fn(fp):
@@ -325,8 +340,22 @@ class HybridTrainStep:
                 names = list(grads)
                 clipped = clip.apply_arrays([grads[n] for n in names])
                 grads = dict(zip(names, clipped))
-            wd_mask = {n: not (n.endswith("_b") or "ln" in n or n == "wpe")
+            # flat names are bracketed tree paths (e.g. "['blocks']/['up_b']")
+            # — match on the unwrapped leaf name, not the raw string
+            def _leaf(n):
+                return n.rsplit("/", 1)[-1].strip("[]'\"")
+            wd_mask = {n: not (_leaf(n).endswith("_b") or "ln" in _leaf(n)
+                               or _leaf(n) == "wpe")
                        for n in flat_params}
+            if stream:
+                new_params, new_opt = _ol.streamed_apply_gradients(
+                    optimizer, flat_params, grads, opt_state, lr, wd_mask,
+                    stacked,
+                    to_dev=lambda a: jax.device_put(
+                        a, _ol.with_memory_kind(None, "device")),
+                    to_host=lambda a: jax.device_put(
+                        a, _ol.with_memory_kind(None, "pinned_host")))
+                return loss, new_params, new_opt
             new_params, new_opt = optimizer.apply_gradients(
                 flat_params, grads, fetch_opt(opt_state), lr, wd_mask=wd_mask)
             return loss, new_params, stash_opt(new_opt)

@@ -234,6 +234,8 @@ class Adamax(Optimizer):
 class Lamb(Optimizer):
     """Layer-wise adaptive moments (ref: python/paddle/optimizer/lamb.py)."""
 
+    _elementwise_update = False  # trust ratio is a whole-tensor norm
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, multi_precision=False, name=None):

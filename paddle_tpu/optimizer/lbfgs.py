@@ -40,6 +40,8 @@ def _set_flat(ts, flat):
 
 
 class LBFGS(Optimizer):
+    _elementwise_update = False  # curvature history couples all elements
+
     def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
                  tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
                  line_search_fn=None, parameters=None, weight_decay=None,

@@ -40,6 +40,8 @@ class LarsMomentum(Optimizer):
                (falls back to lr when either norm is 0)
     """
 
+    _elementwise_update = False  # local_lr is a whole-tensor norm ratio
+
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  lars_coeff=0.001, lars_weight_decay=0.0005,
                  exclude_from_weight_decay=None, epsilon=0.0,
@@ -88,6 +90,8 @@ class DGCMomentum(Optimizer):
     rampup_begin_step + rampup_step; before rampup begins, steps are plain
     dense momentum (the reference runs the vanilla momentum op there).
     """
+
+    _elementwise_update = False  # sparsity mask is a whole-tensor quantile
 
     def __init__(self, learning_rate=0.001, momentum=0.9,
                  rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),

@@ -23,6 +23,12 @@ _LOW_PRECISION = (jnp.float16, jnp.bfloat16)
 
 
 class Optimizer:
+    # True when _update is purely elementwise over each parameter tensor, so
+    # applying it to a slice equals slicing the full-tensor update. Norm- or
+    # history-based optimizers (Lamb/LARS trust ratios, LBFGS) must override
+    # to False — the streamed host-offload path keys on this.
+    _elementwise_update = True
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
         self._learning_rate = learning_rate
