@@ -16,8 +16,53 @@ from ..tensor_impl import Tensor
 from ..nn.layer_base import Layer
 from ..framework.random import next_key
 from .functional import (
-    capture_params, capture_buffers, functional_call, functional_fn_call, _wrap,
+    capture_params, capture_buffers, functional_call, functional_fn_call,
+    functional_multi_call, _wrap,
 )
+
+
+def _closure_layers(fn):
+    """Layers reachable from a plain function's closure cells or __self__.
+    ``to_static(lambda x: model(x))`` must functionalize model's buffers:
+    a train-mode BN mutates running stats during tracing, and unswapped
+    buffers would keep the (dead) tracers after the trace ends."""
+    found, seen = [], set()
+
+    def add(v):
+        if isinstance(v, Layer) and id(v) not in seen:
+            seen.add(id(v))
+            found.append(v)
+
+    def add_container(v):
+        add(v)
+        if isinstance(v, (list, tuple)):
+            for u in v:
+                add(u)
+        elif isinstance(v, dict):
+            for u in v.values():
+                add(u)
+
+    add(getattr(fn, "__self__", None))
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            add_container(cell.cell_contents)
+        except ValueError:
+            continue
+    # module-level models are globals, not closure cells — scan the names
+    # referenced by the code object AND any nested code objects (a Layer
+    # used only inside an inner lambda/comprehension appears in the inner
+    # code's co_names, not the outer one's)
+    def scan_code(code):
+        for name in code.co_names:
+            add_container(getattr(fn, "__globals__", {}).get(name))
+        for const in code.co_consts:
+            if hasattr(const, "co_names"):
+                scan_code(const)
+
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        scan_code(code)
+    return found
 
 
 class StaticFunction:
@@ -33,8 +78,16 @@ class StaticFunction:
         from .dy2static import convert_to_static
         if self._is_layer:
             self._orig_forward = convert_to_static(target.forward)
+            self._fn_layers = []
         else:
             self._orig_forward = None
+            # closure-Layer discovery is DEFERRED to first call: a
+            # decorator-form to_static runs at module import, before
+            # late-bound globals like `model = Net()` exist. The original
+            # (pre-conversion) function is kept because the AST-recompiled
+            # one may not preserve the closure cells.
+            self._orig_target = target
+            self._fn_layers = None
             self._target = convert_to_static(target)
         self._cache = {}  # training-mode -> jitted fn
         self._last_lowered = None
@@ -56,6 +109,14 @@ class StaticFunction:
                                                   arg_arrays, kwarg_arrays, key,
                                                   forward_fn=fwd)
                 return out, new_buffers
+        elif self._fn_layers:
+            f = self._target
+            layers = self._fn_layers
+
+            def pure(params, buffers, key, arg_arrays, kwarg_arrays):
+                # params/buffers: one dict per closure layer
+                return functional_multi_call(layers, f, params, buffers,
+                                             arg_arrays, kwarg_arrays, key)
         else:
             f = self._target
 
@@ -65,6 +126,12 @@ class StaticFunction:
         fn = jax.jit(pure)
         self._cache[training] = fn
         return fn
+
+
+    def _resolved_fn_layers(self):
+        if self._fn_layers is None:
+            self._fn_layers = _closure_layers(self._orig_target)
+        return self._fn_layers
 
     def __call__(self, *args, **kwargs):
         arg_arrays = jax.tree_util.tree_map(
@@ -77,6 +144,10 @@ class StaticFunction:
             params = capture_params(self._target)
             buffers = capture_buffers(self._target)
             training = self._target.training
+        elif self._resolved_fn_layers():
+            params = [capture_params(l) for l in self._fn_layers]
+            buffers = [capture_buffers(l) for l in self._fn_layers]
+            training = tuple(l.training for l in self._fn_layers)
         else:
             params, buffers, training = {}, {}, False
         jitted = self._get_jitted(training)
@@ -99,6 +170,12 @@ class StaticFunction:
             for n, arr in new_buffers.items():
                 if n in named_b:
                     named_b[n]._data = arr
+        elif self._fn_layers and new_buffers:
+            for layer, nb in zip(self._fn_layers, new_buffers):
+                named_b = dict(layer.named_buffers())
+                for n, arr in nb.items():
+                    if n in named_b:
+                        named_b[n]._data = arr
         return _wrap(out)
 
     # introspection: the XLA program replaces the reference's Program
@@ -106,9 +183,17 @@ class StaticFunction:
         arg_arrays = jax.tree_util.tree_map(
             lambda x: x._data if isinstance(x, Tensor) else x, args,
             is_leaf=lambda x: isinstance(x, Tensor))
-        params = capture_params(self._target) if self._is_layer else {}
-        buffers = capture_buffers(self._target) if self._is_layer else {}
-        jitted = self._get_jitted(self._target.training if self._is_layer else False)
+        if self._is_layer:
+            params = capture_params(self._target)
+            buffers = capture_buffers(self._target)
+            training = self._target.training
+        elif self._resolved_fn_layers():
+            params = [capture_params(l) for l in self._fn_layers]
+            buffers = [capture_buffers(l) for l in self._fn_layers]
+            training = tuple(l.training for l in self._fn_layers)
+        else:
+            params, buffers, training = {}, {}, False
+        jitted = self._get_jitted(training)
         lowered = jitted.lower(params, buffers, next_key(), arg_arrays, {})
         self._last_lowered = lowered
         return lowered

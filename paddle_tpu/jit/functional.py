@@ -74,16 +74,10 @@ def functional_call(layer, params, buffers, args, kwargs=None, rng_key=None,
     """Pure call: (params, buffers, inputs) -> (outputs, new_buffers).
     All arrays (possibly tracers); outputs are arrays. `forward_fn` overrides
     the callable (used by to_static to bypass its own compiled forward)."""
-    kwargs = kwargs or {}
     call = forward_fn if forward_fn is not None else layer
-    wrapped_args = jax.tree_util.tree_map(
-        lambda x: Tensor(x) if not isinstance(x, Tensor) and hasattr(x, "dtype") else x,
-        args)
-    ctx = fork_rng(rng_key) if rng_key is not None else contextlib.nullcontext()
-    with _st.functional_trace(), ctx, _swapped(layer, params, buffers) as named_b:
-        out = call(*wrapped_args, **kwargs)
-        new_buffers = {n: t._data for n, t in named_b.items()}
-    return _unwrap(out), new_buffers
+    out, new_buffers = functional_multi_call(
+        [layer], call, [params], [buffers], args, kwargs, rng_key)
+    return out, new_buffers[0]
 
 
 def functional_fn_call(fn, args, kwargs=None, rng_key=None):
@@ -96,3 +90,24 @@ def functional_fn_call(fn, args, kwargs=None, rng_key=None):
     with _st.functional_trace(), ctx:
         out = fn(*wrapped_args, **kwargs)
     return _unwrap(out)
+
+
+def functional_multi_call(layers, fn, params_list, buffers_list, args,
+                          kwargs=None, rng_key=None):
+    """Pure call of a free function whose closure reaches `layers` (e.g.
+    ``to_static(lambda x: model(x))``). Like functional_call, but swaps
+    traced params/buffers into EVERY reachable layer — a train-mode BN
+    inside the closure writes its running stats during tracing, and
+    without this those tracer writes leak into the live buffers (the
+    eager model is then poisoned and the next call crashes)."""
+    kwargs = kwargs or {}
+    wrapped_args = jax.tree_util.tree_map(
+        lambda x: Tensor(x) if not isinstance(x, Tensor) and hasattr(x, "dtype") else x,
+        args)
+    ctx = fork_rng(rng_key) if rng_key is not None else contextlib.nullcontext()
+    with _st.functional_trace(), ctx, contextlib.ExitStack() as stack:
+        named_bs = [stack.enter_context(_swapped(l, p, b))
+                    for l, p, b in zip(layers, params_list, buffers_list)]
+        out = fn(*wrapped_args, **kwargs)
+        new_buffers = [{n: t._data for n, t in nb.items()} for nb in named_bs]
+    return _unwrap(out), new_buffers

@@ -112,6 +112,36 @@ class BertForPretraining(Layer):
             loss = loss + F.cross_entropy(nsp, nsp_labels)
         return loss
 
+    def pretraining_loss(self, input_ids, mlm_labels, token_type_ids=None,
+                         attention_mask=None, nsp_labels=None,
+                         ignore_index=-100):
+        """MLM(+NSP) loss with the LM head fused into the cross-entropy —
+        the ``[B, S, V]`` logits buffer never exists (a bf16 30k-vocab
+        logits tensor alone is ~2 GB at bs64 seq512 and OOMs a 16 GB chip
+        with its backward; capability target: the reference's fused
+        softmax_with_cross_entropy, python/paddle/nn/functional/loss.py).
+        Same value as ``loss(forward(...), ...)`` up to fp32 rounding."""
+        from ..dispatch import apply as _apply
+        from ..ops.fused_ce import fused_linear_cross_entropy
+
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+
+        def f(hd, w, b, labels):
+            N = hd.shape[0] * hd.shape[1]
+            losses = fused_linear_cross_entropy(
+                hd.reshape(N, hd.shape[-1]), w.astype(hd.dtype),
+                labels.reshape(-1).astype(jnp.int32), head_b=b.astype(hd.dtype))
+            keep = labels.reshape(-1) != ignore_index
+            n = jnp.maximum(jnp.sum(keep), 1)
+            return jnp.sum(jnp.where(keep, losses, 0.0)) / n
+
+        loss = _apply(f, h, self.mlm_head.weight, self.mlm_head.bias,
+                      mlm_labels, op_name="fused_mlm_loss")
+        if nsp_labels is not None:
+            loss = loss + F.cross_entropy(self.nsp_head(pooled), nsp_labels)
+        return loss
+
 
 class BertForSequenceClassification(Layer):
     def __init__(self, cfg: BertConfig, num_classes=2):

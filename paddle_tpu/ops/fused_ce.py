@@ -33,10 +33,11 @@ def _chunking(V: int, num_chunks: int):
     return c, n
 
 
-def _fwd_stats(hidden, head_w, labels, num_chunks):
+def _fwd_stats(hidden, head_w, labels, num_chunks, head_b=None):
     """Online logsumexp + gold-logit gather over vocab chunks.
 
-    hidden: [N, H] (any float dtype), head_w: [H, V], labels: [N] int.
+    hidden: [N, H] (any float dtype), head_w: [H, V], labels: [N] int,
+    head_b: optional [V] bias (BERT's mlm_head has one; GPT heads don't).
     Returns (logz [N] fp32, gold [N] fp32).
     """
     N, H = hidden.shape
@@ -44,6 +45,9 @@ def _fwd_stats(hidden, head_w, labels, num_chunks):
     C, n = _chunking(V, num_chunks)
     pad = C * n - V
     wpad = jnp.pad(head_w, ((0, 0), (0, pad))) if pad else head_w
+    bpad = None
+    if head_b is not None:
+        bpad = jnp.pad(head_b, (0, pad)) if pad else head_b
     f32 = jnp.float32
 
     def body(carry, c):
@@ -51,6 +55,9 @@ def _fwd_stats(hidden, head_w, labels, num_chunks):
         start = c * C
         w_c = jax.lax.dynamic_slice(wpad, (0, start), (H, C))
         logits = jnp.dot(hidden, w_c, preferred_element_type=f32)
+        if bpad is not None:
+            logits = logits + jax.lax.dynamic_slice(
+                bpad, (start,), (C,)).astype(f32)[None, :]
         col = start + jax.lax.iota(jnp.int32, C)[None, :]
         logits = jnp.where(col < V, logits, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
@@ -68,12 +75,20 @@ def _fwd_stats(hidden, head_w, labels, num_chunks):
     return m + jnp.log(s), gold
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_linear_cross_entropy(hidden, head_w, labels, num_chunks=8):
-    """Per-token CE of ``softmax(hidden @ head_w)`` vs ``labels`` without
-    materializing the logits. Returns losses ``[N]`` (fp32); callers apply
-    their own mask/reduction (so ignore_index is a caller-side ``where``).
+def fused_linear_cross_entropy(hidden, head_w, labels, num_chunks=8,
+                               head_b=None):
+    """Per-token CE of ``softmax(hidden @ head_w [+ head_b])`` vs ``labels``
+    without materializing the logits. Returns losses ``[N]`` (fp32); callers
+    apply their own mask/reduction (so ignore_index is a caller-side
+    ``where``).
     """
+    if head_b is None:
+        return _fce(hidden, head_w, labels, num_chunks)
+    return _fce_bias(hidden, head_w, head_b, labels, num_chunks)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fce(hidden, head_w, labels, num_chunks=8):
     logz, gold = _fwd_stats(hidden, head_w, labels, num_chunks)
     return logz - gold
 
@@ -114,7 +129,57 @@ def _fce_bwd(num_chunks, res, g):
     return dh.astype(hidden.dtype), dW.astype(head_w.dtype), None
 
 
-fused_linear_cross_entropy.defvjp(_fce_fwd, _fce_bwd)
+_fce.defvjp(_fce_fwd, _fce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fce_bias(hidden, head_w, head_b, labels, num_chunks=8):
+    logz, gold = _fwd_stats(hidden, head_w, labels, num_chunks, head_b)
+    return logz - gold
+
+
+def _fceb_fwd(hidden, head_w, head_b, labels, num_chunks):
+    logz, gold = _fwd_stats(hidden, head_w, labels, num_chunks, head_b)
+    return logz - gold, (hidden, head_w, head_b, labels, logz)
+
+
+def _fceb_bwd(num_chunks, res, g):
+    hidden, head_w, head_b, labels, logz = res
+    N, H = hidden.shape
+    V = head_w.shape[1]
+    C, n = _chunking(V, num_chunks)
+    pad = C * n - V
+    wpad = jnp.pad(head_w, ((0, 0), (0, pad))) if pad else head_w
+    bpad = jnp.pad(head_b, (0, pad)) if pad else head_b
+    f32 = jnp.float32
+
+    def body(carry, c):
+        dh, dW, dB = carry
+        start = c * C
+        w_c = jax.lax.dynamic_slice(wpad, (0, start), (H, C))
+        b_c = jax.lax.dynamic_slice(bpad, (start,), (C,)).astype(f32)
+        logits = jnp.dot(hidden, w_c, preferred_element_type=f32) + b_c[None, :]
+        col = start + jax.lax.iota(jnp.int32, C)[None, :]
+        p = jnp.where(col < V, jnp.exp(logits - logz[:, None]), 0.0)
+        delta = (p - (col == labels[:, None]).astype(f32)) * g[:, None]
+        dc = delta.astype(hidden.dtype)
+        dh = dh + jnp.dot(dc, w_c.T, preferred_element_type=f32)
+        dw_c = jnp.dot(hidden.T, dc, preferred_element_type=f32)
+        dW = jax.lax.dynamic_update_slice(dW, dw_c, (0, start))
+        dB = jax.lax.dynamic_update_slice(dB, jnp.sum(delta, axis=0), (start,))
+        return (dh, dW, dB), None
+
+    init = (jnp.zeros((N, H), f32), jnp.zeros((H, C * n), f32),
+            jnp.zeros((C * n,), f32))
+    (dh, dW, dB), _ = jax.lax.scan(body, init, jnp.arange(n))
+    if pad:
+        dW = dW[:, :V]
+        dB = dB[:V]
+    return (dh.astype(hidden.dtype), dW.astype(head_w.dtype),
+            dB.astype(head_b.dtype), None)
+
+
+_fce_bias.defvjp(_fceb_fwd, _fceb_bwd)
 
 
 def fused_lm_loss(hidden, head_w, ids, num_chunks=8, shift=True):

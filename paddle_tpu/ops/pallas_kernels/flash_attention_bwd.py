@@ -39,8 +39,15 @@ SUBLANES = 8
 
 def dropout_keep(seed, b, qi, ki, shape, dropout_p):
     """Regenerable per-block keep mask: seed the TPU PRNG with the grid
-    coordinates so forward and both backward kernels draw identical bits."""
-    pltpu.prng_seed(seed, b, qi, ki)
+    coordinates so forward and both backward kernels draw identical bits.
+
+    Mosaic accepts at most TWO prng_seed operands on current runtimes
+    ("Setting seed with more than 2 values is not supported"), so the
+    three grid coordinates are packed into one word: q/k block indices
+    stay < 2^10 for every supported seq/block combination, and the
+    batch*heads index wrapping at 2^11 only makes distant blocks reuse a
+    mask stream — deterministic, and identical in fwd and bwd."""
+    pltpu.prng_seed(seed, (b << 20) + (qi << 10) + ki)
     bits = pltpu.prng_random_bits(shape)  # int32
     threshold = jnp.int32(
         jnp.iinfo(jnp.int32).min + dropout_p * 2.0 ** 32)
