@@ -168,9 +168,11 @@ def main():
         gpt_flash_tiles()
         return
     if which == "resnet":
+        # big batches first: ~10-15 ms/step of the 62 ms bs128 step is RPC
+        # arg marshaling (TPU_SMOKE round-5 breakdown), so bs512 amortizes
         for df in ("NHWC", "NCHW"):
             for dtype in ("bf16",):
-                for bs in (256, 128):
+                for bs in (512, 256, 128):
                     try:
                         resnet_case(bs, df, dtype)
                     except Exception as e:  # noqa: BLE001
