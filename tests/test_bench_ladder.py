@@ -136,6 +136,7 @@ def test_sigterm_mid_run_prints_best_so_far(tmp_path):
     and require the captured result on stdout."""
     stub = tmp_path / "stub_bench.py"
     repo_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sentinel = tmp_path / "child_wrote.flag"
     stub.write_text(f"""
 import json, sys, time
 if len(sys.argv) >= 2 and sys.argv[1] == "--child":
@@ -143,6 +144,7 @@ if len(sys.argv) >= 2 and sys.argv[1] == "--child":
         f.write(json.dumps({{"metric": "stub", "value": 42.0, "mfu": 0.5,
                              "unit": "tokens/s/chip", "vs_baseline": 1.1,
                              "backend": "tpu"}}) + "\\n")
+    open({str(sentinel)!r}, "w").write("ok")
     time.sleep(600)  # hang like a wedged bigger-config attempt
     sys.exit(0)
 sys.path.insert(0, {repo_dir!r})
@@ -150,13 +152,23 @@ import bench
 bench.__file__ = __file__  # parent must relaunch THIS stub as the child
 bench.main()
 """)
-    env = dict(os.environ, BENCH_TOTAL_BUDGET_S="120")
+    # budget must exceed the sentinel-poll window below, or a slow child
+    # lets the parent hit its own deadline and emit without the note
+    env = dict(os.environ, BENCH_TOTAL_BUDGET_S="300")
     proc = subprocess.Popen([sys.executable, str(stub)],
                             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
                             env=env, text=True)
-    # interpreter startup is ~4s in this sandbox; give the parent time to
-    # install its handler and the stub child time to write its line
-    time.sleep(20.0)
+    # wait until the child has actually written its result line (fixed
+    # sleeps flake when the sandbox is under load), then a little more for
+    # the parent's signal handler installation
+    for _ in range(120):
+        if sentinel.exists():
+            break
+        time.sleep(1.0)
+    else:
+        proc.kill()
+        raise AssertionError("stub child never wrote its result line")
+    time.sleep(3.0)
     proc.send_signal(signal.SIGTERM)
     out, _ = proc.communicate(timeout=30)
     line = json.loads(out.strip().splitlines()[-1])
