@@ -169,12 +169,18 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
         def scan_body(h, layer_params):
             return ck_block(layer_params, h), None
         x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    return final_ln_fp32(x, params["lnf_g"], params["lnf_b"],
+                         config.layer_norm_epsilon).astype(compute)
+
+
+def final_ln_fp32(x, g, b, eps):
+    """Final layernorm in fp32 (shared by the hybrid and stage-3 steps);
+    returns fp32 — callers cast back to their compute dtype."""
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, -1, keepdims=True)
     var = jnp.var(xf, -1, keepdims=True)
-    xn = (xf - mu) * jax.lax.rsqrt(var + config.layer_norm_epsilon)
-    xn = xn * params["lnf_g"].astype(jnp.float32) + params["lnf_b"].astype(jnp.float32)
-    return xn.astype(compute)
+    xn = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return xn * g.astype(jnp.float32) + b.astype(jnp.float32)
 
 
 def gpt_forward(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
