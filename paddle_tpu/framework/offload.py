@@ -56,7 +56,8 @@ def fetch_stash(enabled, dev_tree, host_tree):
 
 
 def streamed_apply_gradients(optimizer, params, grads, state, lr, wd_mask,
-                             stacked, to_dev=None, to_host=None):
+                             stacked, to_dev=None, to_host=None,
+                             transfer_params=False):
     """Offloaded optimizer update that streams stacked [L, ...] slot arrays
     through device memory one leading-dim slice at a time (ref:
     fleet/meta_parallel/sharding/group_sharded_stage3.py:84 cpu offload).
@@ -79,6 +80,10 @@ def streamed_apply_gradients(optimizer, params, grads, state, lr, wd_mask,
     one layer's slots in, updates, and DMAs them back. The loop-carried
     dependency is what serializes the copies — an unrolled chain lets XLA
     hoist every copy-start and re-create the bulk residency.
+
+    transfer_params=True: params AND grads are host-resident too (stage-3
+    full offload) — they get the same per-slice fetch, and updated params
+    stash back to host, so peak HBM holds one layer's p/g/m/v.
     """
     import jax.lax as lax
     ident = lambda a: a  # noqa: E731
@@ -97,13 +102,17 @@ def streamed_apply_gradients(optimizer, params, grads, state, lr, wd_mask,
     frozen = [n for n in params if n not in stk and grads.get(n) is None]
     small = [n for n in params if n not in stk and n not in frozen]
 
+    fetch_p = to_dev if transfer_params else (lambda a: a)
+    stash_p = to_host if transfer_params else (lambda a: a)
     small_state = {"step": state["step"],
                    "slots": {n: {k: to_dev(v) if jnp.ndim(v) else v
                                  for k, v in slots[n].items()}
                              for n in small}}
     new_params, small_out = optimizer.apply_gradients(
-        {n: params[n] for n in small}, {n: grads[n] for n in small},
+        {n: fetch_p(params[n]) for n in small},
+        {n: fetch_p(grads[n]) for n in small},
         small_state, lr, wd_mask=wd_mask)
+    new_params = {n: stash_p(v) for n, v in new_params.items()}
     new_step = small_out["step"]  # apply_gradients returns step+1 even
     # when the small dict is empty
     new_slots = {n: {k: to_host(v) if jnp.ndim(v) else v
@@ -125,9 +134,11 @@ def streamed_apply_gradients(optimizer, params, grads, state, lr, wd_mask,
 
         def body(layer, carry):
             pstk, hslots = carry
-            p_l = {n: lax.dynamic_index_in_dim(pstk[n], layer, 0, False)
+            p_l = {n: fetch_p(lax.dynamic_index_in_dim(pstk[n], layer,
+                                                       0, False))
                    for n in stk}
-            g_l = {n: lax.dynamic_index_in_dim(grads[n], layer, 0, False)
+            g_l = {n: fetch_p(lax.dynamic_index_in_dim(grads[n], layer,
+                                                       0, False))
                    for n in stk}
             s_l = {n: {k: to_dev(lax.dynamic_index_in_dim(v, layer, 0, False))
                        for k, v in hslots[n].items()} for n in stk}
@@ -135,7 +146,8 @@ def streamed_apply_gradients(optimizer, params, grads, state, lr, wd_mask,
                 p_l, g_l, {"step": state["step"], "slots": s_l}, lr,
                 wd_mask=wd_mask)
             pstk = {n: lax.dynamic_update_index_in_dim(
-                        pstk[n], p_new[n].astype(pstk[n].dtype), layer, 0)
+                        pstk[n], stash_p(p_new[n].astype(pstk[n].dtype)),
+                        layer, 0)
                     for n in stk}
             hslots = {n: {k: lax.dynamic_update_index_in_dim(
                               v, to_host(s_new["slots"][n][k].astype(v.dtype)),

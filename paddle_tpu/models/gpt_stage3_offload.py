@@ -81,92 +81,83 @@ class Stage3OffloadTrainStep:
             raise ValueError(
                 "update='host' needs TPU in-jit memory transfers "
                 "(compute_on host offload); use update='stream' here")
-        key = jax.random.key(self.seed)
-        params = init_gpt_params(self.config, key, self.param_dtype)
-        self.blocks = params.pop("blocks")   # {name: [L, ...]}
-        self.small = params                  # wte/wpe/lnf_g/lnf_b/head_w
-        self.opt_small = self.optimizer.init_state(self.small)
-        self.opt_blocks = self.optimizer.init_state(self.blocks)
+        if self.offload_enabled and not _ol.in_jit_transfers_supported():
+            # silently training device-resident would defeat the class's
+            # purpose (and OOM outright at the 6.7B scale it exists for)
+            raise ValueError(
+                "this backend has no in-jit memory-kind transfers, so "
+                "stage-3 offload cannot run; pass offload_enabled=False "
+                "for a device-resident (test) instance")
         self._real = bool(self.offload_enabled
                           and _ol.in_jit_transfers_supported())
         if self._real:
+            # init the block weights HOST-side: init_gpt_params would
+            # materialize all [L, ...] leaves in HBM first (13.4G at 6.7B
+            # — an OOM before training starts). numpy generates straight
+            # into host memory; only the small leaves touch the device.
+            self.small, self.blocks = self._init_host(self.config,
+                                                      self.seed,
+                                                      self.param_dtype)
+        else:
+            key = jax.random.key(self.seed)
+            params = init_gpt_params(self.config, key, self.param_dtype)
+            self.blocks = params.pop("blocks")   # {name: [L, ...]}
+            self.small = params                  # wte/wpe/lnf/head_w
+        self.opt_small = self.optimizer.init_state(self.small)
+        self.opt_blocks = self.optimizer.init_state(self.blocks)
+        if self._real:
             host = _ol.with_memory_kind(None, "pinned_host")
-            self.blocks = {k: jax.device_put(v, host)
-                           for k, v in self.blocks.items()}
             self.opt_blocks = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, host) if jnp.ndim(a) else a,
                 self.opt_blocks)
         self._jitted = None
 
-    # -- update helpers ------------------------------------------------------
-    def _stream_update(self, blocks, g_blocks, opt_blocks, lr, mask,
-                       to_dev, to_host):
-        """Per-layer device update of host-resident p/g/m/v. Everything is
-        explicitly fetched (mixed-memory-space elementwise math does not
-        lower), updated on device, and stashed back with sliced DMA."""
-        import jax.lax as lax
-        optimizer = self.optimizer
-        step0 = opt_blocks["step"]
-        big = [n for n, a in blocks.items() if a.ndim >= 3]
-        small2d = [n for n in blocks if n not in big]
+    @staticmethod
+    def _init_host(config, seed, param_dtype):
+        """Same shapes/distributions as init_gpt_params, but block leaves
+        are generated with numpy and placed directly in pinned host
+        memory — device transient is one SMALL leaf at most."""
+        H, L, V = config.hidden_size, config.num_layers, config.vocab_size
+        Ienv = config.ffn_mult * H
+        std = config.initializer_range
+        rng = np.random.default_rng(seed)
+        # ml_dtypes gives numpy a native bfloat16, so the cast happens in
+        # host memory — jnp casts would round-trip the (huge) f32 array
+        # through the device
+        import ml_dtypes  # noqa: F401  (registers 'bfloat16' with numpy)
+        np_dtype = np.dtype(jnp.dtype(param_dtype).name)
 
-        # tiny 2D leaves: one bulk round-trip (~0.4% of params)
-        p2 = {n: to_dev(blocks[n]) for n in small2d}
-        g2 = {n: to_dev(g_blocks[n]) for n in small2d}
-        s2 = {n: {k: to_dev(v) if jnp.ndim(v) else v
-                  for k, v in opt_blocks["slots"][n].items()}
-              for n in small2d}
-        np2, ns2 = optimizer.apply_gradients(
-            p2, g2, {"step": step0, "slots": s2}, lr, wd_mask=mask)
-        new_blocks = {n: to_host(np2[n]) for n in small2d}
-        new_slots = {n: {k: to_host(v) if jnp.ndim(v) else v
-                         for k, v in ns2["slots"][n].items()}
-                     for n in small2d}
-        new_step = ns2["step"]
+        def norm_np(shape):
+            return (rng.standard_normal(shape, dtype=np.float32)
+                    * std).astype(np_dtype)
 
-        if big:
-            L = blocks[big[0]].shape[0]
-            bad = [n for n in big if blocks[n].shape[0] != L]
-            if bad:
-                # dynamic_index clamps out-of-range indices, so a mismatch
-                # would silently corrupt the update (same guard as
-                # framework/offload.streamed_apply_gradients — this loop
-                # stays separate from that helper only because params and
-                # grads are ALSO host-resident here and need fetching)
-                raise ValueError(f"leading-dim mismatch: {bad} vs {L}")
+        host = _ol.with_memory_kind(None, "pinned_host")
 
-            def body(layer, carry):
-                pstk, hslots = carry
-                p_l = {n: to_dev(lax.dynamic_index_in_dim(pstk[n], layer,
-                                                          0, False))
-                       for n in big}
-                g_l = {n: to_dev(lax.dynamic_index_in_dim(g_blocks[n], layer,
-                                                          0, False))
-                       for n in big}
-                s_l = {n: {k: to_dev(lax.dynamic_index_in_dim(v, layer,
-                                                              0, False))
-                           for k, v in hslots[n].items()} for n in big}
-                p_new, s_new = optimizer.apply_gradients(
-                    p_l, g_l, {"step": step0, "slots": s_l}, lr,
-                    wd_mask=mask)
-                pstk = {n: lax.dynamic_update_index_in_dim(
-                            pstk[n],
-                            to_host(p_new[n].astype(pstk[n].dtype)),
-                            layer, 0)
-                        for n in big}
-                hslots = {n: {k: lax.dynamic_update_index_in_dim(
-                                  v, to_host(s_new["slots"][n][k]
-                                             .astype(v.dtype)), layer, 0)
-                              for k, v in hslots[n].items()} for n in big}
-                return pstk, hslots
+        def to_h(a):
+            return jax.device_put(np.asarray(a, np_dtype), host)
 
-            pstk, hslots = lax.fori_loop(
-                0, L, body,
-                ({n: blocks[n] for n in big},
-                 {n: dict(opt_blocks["slots"][n]) for n in big}))
-            new_blocks.update(pstk)
-            new_slots.update(hslots)
-        return new_blocks, {"step": new_step, "slots": new_slots}
+        blocks = {
+            "ln1_g": to_h(np.ones((L, H), np.float32)),
+            "ln1_b": to_h(np.zeros((L, H), np.float32)),
+            "qkv_w": to_h(norm_np((L, H, 3 * H))),
+            "qkv_b": to_h(np.zeros((L, 3 * H), np.float32)),
+            "out_w": to_h(norm_np((L, H, H))),
+            "out_b": to_h(np.zeros((L, H), np.float32)),
+            "ln2_g": to_h(np.ones((L, H), np.float32)),
+            "ln2_b": to_h(np.zeros((L, H), np.float32)),
+            "up_w": to_h(norm_np((L, H, Ienv))),
+            "up_b": to_h(np.zeros((L, Ienv), np.float32)),
+            "down_w": to_h(norm_np((L, Ienv, H))),
+            "down_b": to_h(np.zeros((L, H), np.float32)),
+        }
+        small = {
+            "wte": jnp.asarray(norm_np((V, H))),
+            "wpe": jnp.asarray(norm_np((config.max_seq_len, H))),
+            "lnf_g": jnp.ones((H,), param_dtype),
+            "lnf_b": jnp.zeros((H,), param_dtype),
+            "head_w": jnp.asarray(norm_np((H, V))),
+        }
+        return small, blocks
 
     # -- compiled step -------------------------------------------------------
     def _build(self):
@@ -235,9 +226,16 @@ class Stage3OffloadTrainStep:
                     new_blocks, new_opt_blocks = host_update(
                         blocks, g_blocks, opt_blocks, lr)
             else:
-                new_blocks, new_opt_blocks = self._stream_update(
-                    blocks, g_blocks, opt_blocks, lr, block_mask,
-                    to_dev, to_host)
+                # shared streamed loop; transfer_params routes the
+                # host-resident p/g through the same per-slice fetch the
+                # moments use (2D leaves bulk-transfer via its small path)
+                new_blocks, new_opt_blocks = _ol.streamed_apply_gradients(
+                    self.optimizer, blocks, g_blocks, opt_blocks, lr,
+                    block_mask,
+                    stacked={n for n, a in blocks.items() if a.ndim >= 3},
+                    to_dev=to_dev if real else None,
+                    to_host=to_host if real else None,
+                    transfer_params=real)
             return loss, new_small, new_blocks, new_opt_small, new_opt_blocks
 
         kwargs = {"donate_argnums": (0, 1, 2, 3)}

@@ -65,3 +65,36 @@ class TestStage3Offload:
                                     offload_enabled=False)
         # 2 layers x (12 H^2 block) + embeddings/head
         assert s3.num_params() > 100_000
+
+    def test_init_host_matches_init_gpt_params_structure(self):
+        """_init_host (the only init used on real hardware) must agree
+        with init_gpt_params on tree structure, shapes and dtypes."""
+        import jax.numpy as jnp
+        import paddle_tpu.framework.offload as ol
+        from paddle_tpu.models.gpt_hybrid import init_gpt_params
+        from paddle_tpu.models.gpt_stage3_offload import (
+            Stage3OffloadTrainStep)
+        cfg = _cfg()
+        ref = init_gpt_params(cfg, jax.random.key(0), jnp.bfloat16)
+        ref_blocks = ref.pop("blocks")
+        orig = ol.with_memory_kind
+        ol.with_memory_kind = lambda s, k: None  # no pinned_host on CPU
+        try:
+            small, blocks = Stage3OffloadTrainStep._init_host(
+                cfg, 0, jnp.bfloat16)
+        finally:
+            ol.with_memory_kind = orig
+        assert set(blocks) == set(ref_blocks)
+        assert set(small) == set(ref)
+        for k in ref_blocks:
+            assert blocks[k].shape == ref_blocks[k].shape, k
+            assert blocks[k].dtype == ref_blocks[k].dtype, k
+        for k in ref:
+            assert small[k].shape == ref[k].shape, k
+            assert small[k].dtype == ref[k].dtype, k
+
+    def test_offload_rejected_without_transfers(self):
+        from paddle_tpu.models.gpt_stage3_offload import (
+            Stage3OffloadTrainStep)
+        with pytest.raises(ValueError, match="offload_enabled=False"):
+            Stage3OffloadTrainStep(_cfg(), paddle.optimizer.AdamW(1e-3))
