@@ -74,8 +74,9 @@ def bert_case(batch, seq, use_flash, steps=15, tiny=False):
     cfg = BertConfig() if not tiny else BertConfig(
         vocab_size=512, hidden_size=64, num_hidden_layers=2,
         num_attention_heads=4, intermediate_size=128)
-    if hasattr(cfg, "use_flash"):
-        cfg.use_flash = use_flash
+    # BertConfig has no use_flash field; the SDPA routing honors the
+    # global flag (nn/functional/attention.py:105)
+    paddle.set_flags({"FLAGS_use_flash_attention": use_flash})
     paddle.seed(0)
     net = BertForPretraining(cfg)
     opt = paddle.optimizer.AdamW(1e-4)
