@@ -24,9 +24,17 @@ def main():
     from bench import model_flops_per_token, peak_flops_bf16
 
     assert jax.default_backend() == "tpu", jax.devices()
+    if model == "tiny":
+        # cheap probe for the offload machinery (esp. the compute_on
+        # host-update branch) before burning time on a 6.7B attempt
+        from paddle_tpu.models.gpt import GPTConfig
+        GPT_CONFIGS["gpt3-tiny"] = GPTConfig(
+            vocab_size=1024, hidden_size=256, num_layers=4, num_heads=4,
+            max_seq_len=256)
     name = f"gpt3-{model}"
     cfg = GPT_CONFIGS[name]
-    batch, seq = (1, 2048) if model == "13B" else (2, 2048)
+    batch, seq = (1, 2048) if model == "13B" else \
+        (2, 256) if model == "tiny" else (2, 2048)
     cfg.max_seq_len = max(cfg.max_seq_len, seq)
     cfg.use_flash = True
     cfg.compute_dtype = "bfloat16"
