@@ -422,7 +422,8 @@ def main():
         parent.emit_best(
             note="cpu fallback (TPU tunnel unavailable at capture time); "
                  "measured-on-TPU evidence for this round is committed in "
-                 "TPU_SMOKE.log")
+                 "TPU_SMOKE.log and BENCH_SELFRUN_r05.json (this same "
+                 "ladder, run on-chip earlier in the round)")
     else:
         parent.emit_best()
 
