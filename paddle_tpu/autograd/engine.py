@@ -44,25 +44,40 @@ def register_tensor_hook(t: Tensor, hook):
 
 
 def _is_float0(x):
+    """Canonical float0 check (dispatch.py imports this one — keep single)."""
     return isinstance(x, np.ndarray) and x.dtype == jax.dtypes.float0
 
 
 def _zeros_cot(aval):
+    """Materialized zero cotangent — higher-order path only; the first-order
+    path uses dispatch.SymbolicZero markers resolved inside the compiled
+    backward instead of allocating real buffers."""
     if jnp.issubdtype(aval.dtype, jnp.floating) or jnp.issubdtype(aval.dtype, jnp.complexfloating):
         return jnp.zeros(aval.shape, aval.dtype)
     return np.zeros(aval.shape, jax.dtypes.float0)
 
 
-def _acc(a, b):
-    """Accumulate cotangent Tensors; dispatched add when either carries a tape."""
-    if a is None:
-        return b
-    if b is None:
-        return a
-    if a._node is not None or b._node is not None:
+def _acc_many(terms):
+    """Fuse all pending cotangent contributions for one tape slot.
+
+    Tape-free terms (the create_graph=False common case) sum in ONE jitted
+    n-ary add — a single compiled program and output buffer per slot instead
+    of a chain of pairwise eager adds. Terms carrying a tape (create_graph
+    or hook-produced) keep pairwise dispatched adds so the accumulation
+    itself stays differentiable."""
+    terms = [t for t in terms if t is not None]
+    if not terms:
+        return None
+    if len(terms) == 1:
+        return terms[0]
+    if any(t._node is not None for t in terms):
         from ..dispatch import apply
-        return apply(jnp.add, a, b, op_name="grad_acc")
-    return Tensor(a._data + b._data)
+        out = terms[0]
+        for t in terms[1:]:
+            out = apply(jnp.add, out, t, op_name="grad_acc")
+        return out
+    from ..dispatch import fused_accumulate
+    return Tensor(fused_accumulate([t._data for t in terms]))
 
 
 def _topo_order(root_nodes):
@@ -89,23 +104,47 @@ def _topo_order(root_nodes):
 
 def _call_vjp(node, cots, create_graph):
     """cots: {out_idx: Tensor}. Returns list of Tensor|None aligned with parents."""
+    if not create_graph:
+        # Missing cotangents stay SYMBOLIC: markers carry (shape, dtype) in
+        # the pytree structure and the zeros materialize inside the jitted
+        # backward (XLA folds them) — or eagerly, for uncached pullbacks.
+        # Cotangents arriving in a different float dtype than the recorded
+        # output aval (AMP white->black boundaries: an fp32 softmax grad
+        # meeting a bf16 matmul output) are cast to the output's dtype,
+        # matching the reference's grad-dtype-follows-output semantics.
+        from ..dispatch import run_pullback, symbolic_zero_for
+        leaves = []
+        for i, av in enumerate(node.out_avals):
+            c = cots.get(i)
+            if c is None:
+                leaves.append(symbolic_zero_for(av))
+            else:
+                d = c._data
+                if d.dtype != av.dtype and jnp.issubdtype(
+                        av.dtype, jnp.inexact):
+                    d = d.astype(av.dtype)
+                leaves.append(d)
+        struct = jax.tree_util.tree_unflatten(node.out_treedef, leaves)
+        with _st.no_grad():
+            raw = run_pullback(node, struct)
+        out = []
+        for g in raw:
+            out.append(None if g is None or _is_float0(g) else Tensor(g))
+        return out
+
     full = []
     for i, av in enumerate(node.out_avals):
         c = cots.get(i)
         if c is None:
             full.append(_zeros_cot(av))
         else:
+            if c._data.dtype != av.dtype and jnp.issubdtype(
+                    av.dtype, jnp.inexact):
+                from ..dispatch import apply as _dispatch_apply
+                dt = jnp.dtype(av.dtype).name
+                c = _dispatch_apply(lambda a: a.astype(dt), c,
+                                    op_name="grad_cast")
             full.append(c)
-
-    if not create_graph:
-        leaves = [c._data if isinstance(c, Tensor) else c for c in full]
-        struct = jax.tree_util.tree_unflatten(node.out_treedef, leaves)
-        with _st.no_grad():
-            raw = node.vjp_fn(struct)
-        out = []
-        for g in raw:
-            out.append(None if g is None or _is_float0(g) else Tensor(g))
-        return out
 
     # Higher-order path: re-derive pullback over (primals, cotangents).
     if node.fwd_fn is None:
@@ -158,7 +197,7 @@ def run_backward(roots, seeds, retain_graph=False, create_graph=False):
 
 def _walk(roots, seeds, retain_graph, create_graph, inputs, accumulate):
     targets = {}
-    results = [None] * (len(inputs) if inputs else 0)
+    results = [[] for _ in range(len(inputs) if inputs else 0)]
     leaf_inputs = {}
     if inputs:
         for i, t in enumerate(inputs):
@@ -167,43 +206,47 @@ def _walk(roots, seeds, retain_graph, create_graph, inputs, accumulate):
             else:
                 leaf_inputs.setdefault(id(t), []).append(i)
 
-    store = {}  # id(node) -> {out_idx: Tensor}
+    # Pending contributions accumulate as LISTS and fuse once, when the node
+    # (or leaf) is consumed — one compiled multi-accumulate per slot instead
+    # of a chain of pairwise adds.
+    store = {}  # id(node) -> {out_idx: [Tensor, ...]}
     node_by_id = {}
-    leaf_grads = {}  # id(tensor) -> (tensor, Tensor grad)
+    leaf_grads = {}  # id(tensor) -> (tensor, [Tensor, ...])
 
     def add_leaf(t, g):
         if g is None:
             return
-        key = id(t)
-        if key in leaf_grads:
-            leaf_grads[key] = (t, _acc(leaf_grads[key][1], g))
-        else:
-            leaf_grads[key] = (t, g)
+        leaf_grads.setdefault(id(t), (t, []))[1].append(g)
 
     root_nodes = []
     for t, seed in zip(roots, seeds):
         if t._node is None:
             if inputs and id(t) in leaf_inputs:
                 for i in leaf_inputs[id(t)]:
-                    results[i] = _acc(results[i], seed)
+                    results[i].append(seed)
             if accumulate and not t.stop_gradient:
                 add_leaf(t, seed)
             continue
         node_by_id[id(t._node)] = t._node
-        slot = store.setdefault(id(t._node), {})
-        slot[t._out_idx] = _acc(slot.get(t._out_idx), seed)
+        store.setdefault(id(t._node), {}).setdefault(
+            t._out_idx, []).append(seed)
         root_nodes.append(t._node)
 
     order = _topo_order(root_nodes)
 
     for node in order:
-        cots = store.pop(id(node), None)
-        if cots is None:
+        slots = store.pop(id(node), None)
+        if slots is None:
             continue
         if node.vjp_fn is None and node.fwd_fn is None:
             raise RuntimeError(
                 "Trying to backward through the graph a second time; the saved "
                 "intermediate results were freed. Pass retain_graph=True.")
+        cots = {}
+        for idx, terms in slots.items():
+            fused = _acc_many(terms)
+            if fused is not None:
+                cots[idx] = fused
         if node.hooks:
             for idx, hooks in node.hooks.items():
                 if idx in cots and cots[idx] is not None:
@@ -216,7 +259,7 @@ def _walk(roots, seeds, retain_graph, create_graph, inputs, accumulate):
             key = (id(node), idx)
             if key in targets and cot is not None:
                 for i in targets[key]:
-                    results[i] = _acc(results[i], cot)
+                    results[i].append(cot)
         in_cots = _call_vjp(node, cots, create_graph)
         if not retain_graph and not create_graph:
             node.vjp_fn = None
@@ -228,14 +271,17 @@ def _walk(roots, seeds, retain_graph, create_graph, inputs, accumulate):
             if parent._node is None:
                 if inputs and id(parent) in leaf_inputs:
                     for i in leaf_inputs[id(parent)]:
-                        results[i] = _acc(results[i], g)
+                        results[i].append(g)
                 if accumulate and not parent.stop_gradient:
                     add_leaf(parent, g)
             else:
-                slot = store.setdefault(id(parent._node), {})
-                slot[parent._out_idx] = _acc(slot.get(parent._out_idx), g)
+                store.setdefault(id(parent._node), {}).setdefault(
+                    parent._out_idx, []).append(g)
 
-    for t, g in leaf_grads.values():
+    for t, terms in leaf_grads.values():
+        g = _acc_many(terms)
+        if g is None:
+            continue
         for h in getattr(t, "_leaf_hooks", []):
             out = h(g)
             if out is not None:
@@ -243,10 +289,10 @@ def _walk(roots, seeds, retain_graph, create_graph, inputs, accumulate):
         if t._grad is None:
             t._grad = g
         else:
-            t._grad = _acc(t._grad, g)
+            t._grad = _acc_many([t._grad, g])
         if not create_graph:
             t._grad.stop_gradient = True
-    return results
+    return [_acc_many(r) for r in results]
 
 
 def backward(tensor, grad_tensor=None, retain_graph=False):

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 class GradNode:
     __slots__ = ("vjp_fn", "parents", "out_treedef", "out_avals", "op_name", "hooks",
-                 "fwd_fn", "primals", "saved_unpack")
+                 "fwd_fn", "primals", "saved_unpack", "vjp_cached")
 
     def __init__(self, vjp_fn, parents, out_treedef, out_avals, op_name=None,
                  fwd_fn=None, primals=None):
@@ -25,6 +25,9 @@ class GradNode:
         self.fwd_fn = fwd_fn
         self.primals = primals
         self.saved_unpack = None      # saved_tensors_hooks unpack fn
+        # True when vjp_fn is a jit-returned tree_util.Partial from the
+        # dispatch cache (stable treedef -> jit-cacheable backward).
+        self.vjp_cached = False
 
     def get_primals(self):
         """Retained primal inputs, routed through the saved_tensors_hooks

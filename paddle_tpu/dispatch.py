@@ -16,6 +16,9 @@ Rules for op implementations: tensor-valued arguments are passed positionally
 from __future__ import annotations
 
 import functools
+import threading
+import types
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +27,7 @@ import numpy as np
 from .framework import state as _st
 from .tensor_impl import Tensor, as_tensor_data
 from .autograd.node import GradNode
+from .autograd.engine import _is_float0
 
 # ---------------------------------------------------------------------------
 # AMP op lists (ref: python/paddle/amp/amp_lists.py). White -> compute in
@@ -68,27 +72,484 @@ def _amp_cast(op_name, arrays):
     return [cast(a) for a in arrays]
 
 
+# ---------------------------------------------------------------------------
+# Jit-cached dispatch.
+#
+# Eagerly re-tracing every op on every call (and re-deriving every pullback
+# via jax.vjp) leaves the dygraph path bound by Python/trace overhead. The
+# cache routes both the no-grad and vjp paths through jit-wrapped callables
+# held in an LRU, so repeat dispatches of the same op signature execute a
+# compiled XLA program directly.
+#
+# Two-level key:
+#   * the LRU key identifies the *computation*: the op callable (code object
+#     + hashable closure/default values + static_kw) and the ambient AMP
+#     policy. Per-call lambdas created at the same source location share a
+#     code object, so they hit the same entry.
+#   * jax.jit's own signature cache handles input avals + shardings below
+#     that, compiling one executable per (shape, dtype, sharding) signature.
+#
+# Closure cells holding bare jax/numpy arrays (dropout keys, lerp weights...)
+# are LIFTED into traced arguments: the entry rebuilds the function with the
+# per-call cell values via types.FunctionType, so a fresh PRNG key per call
+# stays a fresh key instead of being baked into the trace. Anything else
+# unhashable in the closure/static_kw makes the op fall back to uncached
+# eager dispatch (correctness first — e.g. double-backward closures that
+# capture primal lists).
+
+_CACHE_LOCK = threading.Lock()
+_JIT_CACHE: OrderedDict = OrderedDict()   # key -> _Entry
+_JIT_CACHE_MAXSIZE = 1024
+# keys that failed under trace -> the callable they named (pinned so the
+# id()-bearing key can never alias a later, unrelated allocation)
+_UNCACHEABLE_KEYS = {}
+# Per call-SITE entry/hit counts: a site whose closure config varies every
+# call (an annealed gumbel temperature, a loop-index shift) would compile a
+# fresh executable per dispatch — worse than no cache. Sites that keep
+# creating entries that never see a repeat get demoted to eager dispatch.
+_SITE_STATS = {}        # site token -> [entries_created, hits]
+_SITE_BLACKLIST = set()
+_SITE_DEMOTE_ENTRIES = 32
+
+
+class CacheStats:
+    """Dispatch-cache counters (read via paddle_tpu.profiler)."""
+    __slots__ = ("dispatches", "cached_calls", "hits", "misses", "traces",
+                 "fallbacks", "bwd_calls", "bwd_traces")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.dispatches = 0     # total apply() calls
+        self.cached_calls = 0   # dispatches served by a cache entry
+        self.hits = 0           # LRU lookups that found an entry
+        self.misses = 0         # LRU lookups that built a new entry
+        self.traces = 0         # times jax actually (re)traced an entry
+        self.fallbacks = 0      # dispatches that fell back to uncached eager
+        self.bwd_calls = 0      # pullbacks run through the jitted backward
+        self.bwd_traces = 0     # backward (re)traces
+
+    def as_dict(self):
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def hit_rate(self):
+        """Steady-state rate: cached dispatches that re-used compiled code."""
+        if not self.cached_calls:
+            return 0.0
+        return 1.0 - self.traces / self.cached_calls
+
+
+_stats = CacheStats()
+
+
+def cache_stats():
+    return _stats
+
+
+def reset_cache_stats():
+    _stats.reset()
+
+
+def cache_enabled() -> bool:
+    from . import flags as _flags
+    return bool(_flags._FLAGS.get("FLAGS_eager_jit_cache", True))
+
+
+def clear_cache():
+    """Drop every cached executable (debugging / tests)."""
+    with _CACHE_LOCK:
+        _JIT_CACHE.clear()
+        _UNCACHEABLE_KEYS.clear()
+        _SITE_STATS.clear()
+        _SITE_BLACKLIST.clear()
+
+
+def cache_size():
+    return len(_JIT_CACHE)
+
+
+class _Unkeyable(Exception):
+    pass
+
+
+_PURE_CALLABLE_TYPES = tuple(t for t in (
+    getattr(jax, "custom_jvp", None),
+    getattr(jax, "custom_vjp", None),
+    getattr(jnp, "ufunc", None),
+    np.ufunc,
+    types.BuiltinFunctionType,
+    type(jax.jit(lambda x: x)),  # PjitFunction: jnp's pre-jitted ufuncs
+) if isinstance(t, type))
+
+_NEXT_KEY = None
+
+
+def _next_key_fn():
+    global _NEXT_KEY
+    if _NEXT_KEY is None:
+        from .framework.random import next_key
+        _NEXT_KEY = next_key
+    return _NEXT_KEY
+
+
+_ARRAY_TYPES = (jax.Array, np.ndarray)
+
+
+def _hashable(v, depth=0):
+    """Hashable proxy for a static value, or raise _Unkeyable."""
+    if depth > 4:
+        raise _Unkeyable
+    if v is None or isinstance(v, (bool, int, float, complex, str, bytes)):
+        return v
+    if isinstance(v, _ARRAY_TYPES):
+        raise _Unkeyable
+    if isinstance(v, (list, tuple)):
+        return (type(v).__name__,) + tuple(_hashable(u, depth + 1) for u in v)
+    if isinstance(v, dict):
+        return ("d",) + tuple(sorted(
+            (k, _hashable(u, depth + 1)) for k, u in v.items()))
+    if isinstance(v, slice):
+        return ("slice", v.start, v.stop, v.step)
+    if callable(v):
+        return _callable_key(v, depth + 1)
+    try:
+        hash(v)
+    except TypeError:
+        raise _Unkeyable from None
+    return v
+
+
+def _callable_key(fn, depth=0):
+    """Key identifying a callable's computation. Cache entries retain the
+    first fn seen for a key, so id()-based components stay valid while the
+    entry lives."""
+    if depth > 4:
+        raise _Unkeyable
+    if isinstance(fn, functools.partial):
+        return ("partial", _callable_key(fn.func, depth + 1),
+                tuple(_hashable(a, depth + 1) for a in fn.args),
+                tuple(sorted((k, _hashable(v, depth + 1))
+                             for k, v in fn.keywords.items())))
+    if getattr(fn, "__self__", None) is not None:
+        # bound method: self may mutate without showing up in any key
+        raise _Unkeyable
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # Identity-keying is only sound for callables with no mutable state
+        # the trace could bake in: jax custom-derivative wrappers, builtins,
+        # ufuncs. An arbitrary callable OBJECT (e.g. a Layer read inside the
+        # dispatched fn) could mutate between calls with an unchanged id —
+        # refuse, so those ops stay on uncached eager dispatch.
+        if isinstance(fn, _PURE_CALLABLE_TYPES):
+            return ("id", id(fn))
+        raise _Unkeyable
+    cells = getattr(fn, "__closure__", None) or ()
+    cell_key = []
+    for i, c in enumerate(cells):
+        try:
+            val = c.cell_contents
+        except ValueError:  # empty cell
+            cell_key.append(("empty",))
+            continue
+        if val is _next_key_fn():
+            # fn draws PRNG keys INSIDE its body: caching would bake the
+            # trace-time key and freeze the op's randomness — never cache
+            raise _Unkeyable
+        if isinstance(val, jax.Array):
+            if depth:
+                # only the TOP-LEVEL fn's cells are lifted to traced args
+                # (_Entry.dyn_values); an array one closure level down
+                # would be keyed position-only yet baked as a constant
+                raise _Unkeyable
+            # lifted to a traced argument (see _Entry); key only the slot
+            cell_key.append(("dyn", i))
+        else:
+            cell_key.append(_hashable(val, depth + 1))
+    defaults = tuple(_hashable(d, depth + 1)
+                     for d in (fn.__defaults__ or ()))
+    kwdefaults = tuple(sorted(
+        (k, _hashable(v, depth + 1))
+        for k, v in (fn.__kwdefaults__ or {}).items()))
+    return ("c", id(code), tuple(cell_key), defaults, kwdefaults)
+
+
+def _dyn_cell_positions(fn):
+    """Closure cell indices whose contents are jax arrays (lifted inputs)."""
+    out = []
+    for i, c in enumerate(getattr(fn, "__closure__", None) or ()):
+        try:
+            if isinstance(c.cell_contents, jax.Array):
+                out.append(i)
+        except ValueError:
+            pass
+    return out
+
+
+def _rebind(fn, dyn_ix, dyn_vals):
+    """fn with closure cells at dyn_ix replaced by dyn_vals (traced)."""
+    if not dyn_ix:
+        return fn
+    cells = list(fn.__closure__)
+    for pos, val in zip(dyn_ix, dyn_vals):
+        cells[pos] = types.CellType(val)
+    f2 = types.FunctionType(fn.__code__, fn.__globals__, fn.__name__,
+                            fn.__defaults__, tuple(cells))
+    f2.__kwdefaults__ = fn.__kwdefaults__
+    return f2
+
+
+class _Entry:
+    """One cached op signature: jitted forward and jitted vjp-forward."""
+    __slots__ = ("fn", "static_kw", "dyn_ix", "fwd", "vjp")
+
+    def __init__(self, fn, static_kw):
+        self.fn = fn                      # retains id()-keyed objects
+        self.static_kw = static_kw
+        self.dyn_ix = _dyn_cell_positions(fn)
+
+        def run(dyn_vals, arrays):
+            _stats.traces += 1
+            f = _rebind(self.fn, self.dyn_ix, dyn_vals)
+            call = functools.partial(f, **self.static_kw) \
+                if self.static_kw else f
+            return call(*arrays)
+
+        # self.fwd / self.vjp are created once per entry; jax.jit caches one
+        # executable per input signature underneath them.
+        self.fwd = jax.jit(run)
+        self.vjp = jax.jit(lambda dyn_vals, arrays: jax.vjp(
+            lambda *a: run(dyn_vals, a), *arrays))
+
+    def dyn_values(self, fn):
+        """Current values of the lifted closure cells from the *caller's* fn
+        (same code/site as self.fn, possibly a different instance)."""
+        if not self.dyn_ix:
+            return []
+        cells = getattr(fn, "__closure__", None) or ()
+        return [cells[i].cell_contents for i in self.dyn_ix]
+
+
+def _site_of(callable_key):
+    """Collapse a callable key to its call-SITE token (the code object /
+    function identity, ignoring closure/default values)."""
+    tag = callable_key[0]
+    if tag == "partial":
+        return _site_of(callable_key[1])
+    return callable_key[:2]  # ("c", id(code)) or ("id", id(fn))
+
+
+def _lookup_entry(fn, static_kw):
+    """(entry, key) for this dispatch, or (None, None) when uncacheable."""
+    try:
+        kw_key = tuple(sorted(
+            (k, _hashable(v)) for k, v in static_kw.items())) \
+            if static_kw else ()
+        ckey = _callable_key(fn)
+        key = (ckey, kw_key,
+               _st._state.amp_level, str(_st._state.amp_dtype))
+    except (_Unkeyable, TypeError):
+        # TypeError: sorted() over mixed-type dict keys, or an exotic
+        # __hash__ raising — either way the op is simply uncacheable
+        return None, None
+    site = _site_of(ckey)
+    with _CACHE_LOCK:
+        if key in _UNCACHEABLE_KEYS or site in _SITE_BLACKLIST:
+            return None, None
+        entry = _JIT_CACHE.get(key)
+        if entry is not None:
+            _JIT_CACHE.move_to_end(key)
+            _stats.hits += 1
+            _SITE_STATS.setdefault(site, [0, 0])[1] += 1
+            return entry, key
+        # A site whose per-call config never repeats (e.g. an annealed
+        # temperature in a closure) would compile per dispatch; once it has
+        # created many entries without accumulating an equal number of
+        # hits, demote the whole site to uncached eager dispatch.
+        st = _SITE_STATS.setdefault(site, [0, 0])
+        if st[0] >= _SITE_DEMOTE_ENTRIES and st[1] < st[0]:
+            _SITE_BLACKLIST.add(site)
+            return None, None
+        st[0] += 1
+        _stats.misses += 1
+        from .framework.compilation_cache import ensure_persistent_cache
+        ensure_persistent_cache()
+        entry = _Entry(fn, dict(static_kw))
+        _JIT_CACHE[key] = entry
+        while len(_JIT_CACHE) > _JIT_CACHE_MAXSIZE:
+            _JIT_CACHE.popitem(last=False)
+    return entry, key
+
+
+def _blacklist(key, fn=None):
+    with _CACHE_LOCK:
+        # pin the callable so the id()-bearing key can't alias a future
+        # allocation after the entry (which retained fn) is dropped
+        _UNCACHEABLE_KEYS[key] = fn
+        _JIT_CACHE.pop(key, None)
+    _stats.fallbacks += 1
+
+
+def _cacheable_inputs(arrays):
+    """Tracers must not cross a fresh jit boundary from a dispatch cache
+    (compiled-path tracing re-enters apply via functional_trace)."""
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+# -- fused cotangent accumulation -------------------------------------------
+# One compiled n-ary add per (arity, aval) signature replaces the engine's
+# pairwise eager adds: k contributions to the same tape slot fuse into a
+# single XLA program (and a single output buffer).
+
+_FUSED_ACC = None
+
+
+def fused_accumulate(arrays):
+    global _FUSED_ACC
+    if len(arrays) == 1:
+        return arrays[0]
+    if not cache_enabled() or not _cacheable_inputs(arrays):
+        return functools.reduce(lambda a, b: a + b, arrays)
+    if _FUSED_ACC is None:
+        _FUSED_ACC = jax.jit(
+            lambda *xs: functools.reduce(lambda a, b: a + b, xs))
+    return _FUSED_ACC(*arrays)
+
+
+# -- symbolic zero cotangents ------------------------------------------------
+class SymbolicZero:
+    """Placeholder for a missing output cotangent. Registered as a pytree
+    node with NO leaves, so its (shape, dtype) ride in the treedef: the
+    jitted backward materializes the zeros inside the compiled program
+    (where XLA folds them) instead of allocating real buffers eagerly."""
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def materialize(self):
+        if self.dtype == "float0":
+            return np.zeros(self.shape, jax.dtypes.float0)
+        return jnp.zeros(self.shape, self.dtype)
+
+    def __repr__(self):
+        return f"SymbolicZero({self.shape}, {self.dtype})"
+
+
+jax.tree_util.register_pytree_node(
+    SymbolicZero,
+    lambda z: ((), (z.shape, z.dtype)),
+    lambda aux, _: SymbolicZero(*aux))
+
+
+def symbolic_zero_for(aval):
+    if jnp.issubdtype(aval.dtype, jnp.floating) or \
+            jnp.issubdtype(aval.dtype, jnp.complexfloating):
+        return SymbolicZero(aval.shape, jnp.dtype(aval.dtype).name)
+    return SymbolicZero(aval.shape, "float0")
+
+
+def _is_symzero(x):
+    return isinstance(x, SymbolicZero)
+
+
+def _materialize_cots(struct):
+    leaves, treedef = jax.tree_util.tree_flatten(struct, is_leaf=_is_symzero)
+    leaves = [l.materialize() if _is_symzero(l) else l for l in leaves]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+_BWD_JIT = None
+
+
+def _get_bwd_jit():
+    global _BWD_JIT
+    if _BWD_JIT is None:
+        def bwd(vjp_fn, cot_struct):
+            _stats.bwd_traces += 1
+            raw = vjp_fn(_materialize_cots(cot_struct))
+            # float0 (non-differentiable input) -> None: an empty pytree is a
+            # legal jit output, a float0 np array is not
+            return tuple(None if _is_float0(g) else g for g in raw)
+        _BWD_JIT = jax.jit(bwd)
+    return _BWD_JIT
+
+
+def run_pullback(node, cot_struct):
+    """Execute a tape node's pullback on a cotangent structure whose missing
+    entries are SymbolicZero markers. Cached (jit-returned) pullbacks run
+    through one shared jitted applier — the vjp_fn is a tree_util.Partial
+    whose treedef is stable per signature, so the backward compiles once and
+    replays; uncached pullbacks run eagerly on materialized zeros."""
+    if getattr(node, "vjp_cached", False) and cache_enabled():
+        leaves = jax.tree_util.tree_leaves(cot_struct, is_leaf=_is_symzero)
+        if not any(isinstance(l, jax.core.Tracer) for l in leaves):
+            _stats.bwd_calls += 1
+            try:
+                return _get_bwd_jit()(node.vjp_fn, cot_struct)
+            except Exception:
+                # eager path below; demote the node so later backward calls
+                # (retain_graph) don't pay a failed trace attempt each time
+                node.vjp_cached = False
+    return node.vjp_fn(_materialize_cots(cot_struct))
+
+
 def apply(fn, *inputs, op_name=None, **static_kw):
     """Dispatch `fn(*arrays, **static_kw)` eagerly with tape recording."""
+    _stats.dispatches += 1
     arrays = [as_tensor_data(x) for x in inputs]
     arrays = _amp_cast(op_name, arrays)
 
     needs_grad = _st.grad_enabled() and any(
         isinstance(x, Tensor) and not x.stop_gradient for x in inputs
     )
-    if static_kw:
-        call = functools.partial(fn, **static_kw)
-    else:
-        call = fn
+
+    entry = key = None
+    if cache_enabled() and _cacheable_inputs(arrays):
+        entry, key = _lookup_entry(fn, static_kw)
+        if entry is None:
+            # unkeyable op (or previously blacklisted): uncached dispatch
+            _stats.fallbacks += 1
 
     if not needs_grad:
+        if entry is not None:
+            try:
+                out = entry.fwd(entry.dyn_values(fn), arrays)
+                _stats.cached_calls += 1
+                return _wrap_outputs(out, node=None, op_name=op_name)
+            except Exception:
+                # Re-run eagerly. Blacklist ONLY if that succeeds (a
+                # jit-specific incompatibility); a genuine user error
+                # re-raises below without poisoning the key.
+                call = functools.partial(fn, **static_kw) if static_kw else fn
+                out = call(*arrays)
+                _blacklist(key, fn)
+                return _wrap_outputs(out, node=None, op_name=op_name)
+        call = functools.partial(fn, **static_kw) if static_kw else fn
         out = call(*arrays)
         return _wrap_outputs(out, node=None, op_name=op_name)
 
-    out, vjp_fn = jax.vjp(call, *arrays)
+    vjp_cached = False
+    out = None
+    call = functools.partial(fn, **static_kw) if static_kw else fn
+    if entry is not None:
+        try:
+            out, vjp_fn = entry.vjp(entry.dyn_values(fn), arrays)
+            _stats.cached_calls += 1
+            vjp_cached = True
+        except Exception:
+            # as above: eager first, blacklist only on eager success
+            out, vjp_fn = jax.vjp(call, *arrays)
+            _blacklist(key, fn)
+    if out is None and not vjp_cached:
+        out, vjp_fn = jax.vjp(call, *arrays)
     parents = [x if isinstance(x, Tensor) else None for x in inputs]
     leaves, treedef = jax.tree_util.tree_flatten(out)
-    avals = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+    # arrays/tracers carry their aval (shape+dtype view) — constructing a
+    # fresh ShapeDtypeStruct per leaf is pure dispatch overhead
+    avals = [getattr(l, "aval", None) or jax.ShapeDtypeStruct(l.shape, l.dtype)
+             for l in leaves]
     # saved_tensors_hooks: pack the retained primals at record time; the
     # node unpacks them lazily in backward (autograd.saved_tensors_hooks)
     hooks = getattr(_st._state, "saved_tensor_hooks", None)
@@ -98,6 +559,7 @@ def apply(fn, *inputs, op_name=None, **static_kw):
         primals_store = [pack(a) for a in arrays]
     node = GradNode(vjp_fn, parents, treedef, avals, op_name=op_name,
                     fwd_fn=call, primals=primals_store)
+    node.vjp_cached = vjp_cached
     if hooks is not None:
         node.saved_unpack = hooks[1]
     return _wrap_outputs(out, node=node, op_name=op_name)

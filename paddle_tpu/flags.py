@@ -12,6 +12,13 @@ _FLAGS = {
     "FLAGS_use_remat": False,
     "FLAGS_matmul_precision": "default",  # default|highest (f32 on MXU)
     "FLAGS_donate_buffers": True,
+    # Eager dispatch cache: route repeat op dispatches through cached
+    # jax.jit executables (dispatch.py). Disable to force op-by-op eager
+    # execution when debugging numerics or tracing issues.
+    "FLAGS_eager_jit_cache": True,
+    # Persist XLA executables across processes (JAX_COMPILATION_CACHE_DIR,
+    # default <cwd>/.jax_cache — see framework/compilation_cache.py).
+    "FLAGS_persistent_compilation_cache": True,
 }
 
 

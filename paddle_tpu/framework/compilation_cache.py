@@ -1,0 +1,66 @@
+"""Persistent XLA compilation cache wiring.
+
+jax can serialize compiled executables to disk and reload them in later
+processes (the TPU analog of the reference's cached CUDA kernel binaries +
+cudnn autotune cache). We point it at `JAX_COMPILATION_CACHE_DIR` when set,
+else `<cwd>/.jax_cache/`, the first time any paddle_tpu path creates a jitted
+executable — so a fresh process re-running the same training script skips
+XLA recompilation entirely.
+
+Lazy by design: importing paddle_tpu must not create directories or mutate
+jax config; the first dispatch-cache entry / TrainStep / to_static build
+triggers it. `FLAGS_persistent_compilation_cache=False` (or an explicit
+user-set jax_compilation_cache_dir) leaves the config untouched.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_initialized = False
+
+
+def ensure_persistent_cache():
+    """Idempotent: enable jax's on-disk compilation cache once per process."""
+    global _initialized
+    if _initialized:
+        return
+    with _lock:
+        from .. import flags as _flags
+        enabled = _flags._FLAGS.get("FLAGS_persistent_compilation_cache", True)
+        if _initialized:
+            if not enabled:
+                # flag turned off after we enabled the cache: undo it at the
+                # next build point so the knob stays live both ways
+                try:
+                    jax.config.update("jax_compilation_cache_dir", None)
+                except Exception:
+                    pass
+                _initialized = False
+            return
+        if not enabled:
+            return  # latch NOT set: enabling the flag later still works
+        _initialized = True
+        try:
+            current = jax.config.jax_compilation_cache_dir
+        except AttributeError:
+            return  # jax without the compilation-cache config
+        if current:  # user (or autotune) already chose a directory
+            return
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+            os.path.join(os.getcwd(), ".jax_cache")
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        except Exception:
+            pass  # persistent cache is an optimization, never a hard dep
+
+
+def cache_dir():
+    """The active persistent-cache directory, or None when disabled."""
+    try:
+        return jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        return None

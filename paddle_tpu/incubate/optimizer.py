@@ -36,7 +36,10 @@ class LookAhead(Optimizer):
                 slow = p._data
             slow = slow + self.alpha * (p._data - slow)
             self._slow[key] = slow
-            p._data = slow
+            # distinct buffer for the live weights: the inner optimizer's
+            # fused update DONATES p._data, which must not invalidate the
+            # retained slow copy
+            p._data = jnp.copy(slow)
 
     def clear_grad(self):
         self.inner_optimizer.clear_grad()

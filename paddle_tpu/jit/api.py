@@ -123,14 +123,27 @@ class StaticFunction:
             def pure(params, buffers, key, arg_arrays, kwarg_arrays):
                 return functional_fn_call(f, arg_arrays, kwarg_arrays, key), {}
 
+        from ..framework.compilation_cache import ensure_persistent_cache
+        ensure_persistent_cache()
         fn = jax.jit(pure)
         self._cache[training] = fn
         return fn
 
 
     def _resolved_fn_layers(self):
+        """Layers reachable from the wrapped function, re-scanned EVERY call:
+        a decorator-form to_static can see `model = Net()` rebound to a new
+        instance after the first call, and a stale layer list would leave the
+        new model un-functionalized (train-mode buffer writes leaking dead
+        tracers — the exact crash closure discovery exists to prevent). An
+        identity change invalidates the jitted cache so the next trace swaps
+        the right instances' params/buffers."""
+        found = _closure_layers(self._orig_target)
         if self._fn_layers is None:
-            self._fn_layers = _closure_layers(self._orig_target)
+            self._fn_layers = found
+        elif [id(l) for l in found] != [id(l) for l in self._fn_layers]:
+            self._fn_layers = found
+            self._cache.clear()
         return self._fn_layers
 
     def __call__(self, *args, **kwargs):

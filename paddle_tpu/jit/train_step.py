@@ -135,7 +135,15 @@ class TrainStep:
                                 for n, a in self._grad_accum.items()}
 
     # -- compiled step -------------------------------------------------------
+    def _effective_donate(self):
+        """Constructor `donate` AND the global FLAGS_donate_buffers knob."""
+        from .. import flags as _flags
+        return bool(self.donate and
+                    _flags._FLAGS.get("FLAGS_donate_buffers", True))
+
     def _build(self, batch_treedef, n_inputs):
+        from ..framework.compilation_cache import ensure_persistent_cache
+        ensure_persistent_cache()
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
         grad_clip = getattr(optimizer, "_grad_clip", None)
         mesh = self.mesh
@@ -206,7 +214,10 @@ class TrainStep:
                     new_gacc, micro + 1)
 
         if k > 1:
-            donate = (0, 1, 3) if self.donate else ()
+            # params, opt state, buffers and the grad accumulator are all
+            # same-shape in->out: donating them makes the whole step update
+            # in place in HBM (no transient second copy of the model state)
+            donate = (0, 1, 2, 3) if self._effective_donate() else ()
             if mesh is not None:
                 p_sh = self._param_shardings()
                 o_sh = o_host_tree if offload_in else self._opt_shardings()
@@ -225,7 +236,7 @@ class TrainStep:
                                in_shardings=in_sh, out_shardings=out_sh)
             return jax.jit(accum_step_fn, donate_argnums=donate)
 
-        donate = (0, 1) if self.donate else ()
+        donate = (0, 1, 2) if self._effective_donate() else ()
         if mesh is not None:
             p_sh = self._param_shardings()
             o_sh = o_host_tree if offload_in else self._opt_shardings()

@@ -20,7 +20,17 @@ TPU-native design (no CUDA-style manual prefetch hooks):
   accumulates the per-layer cotangents into a host-resident ``[L, ...]``
   gradient via per-iteration dynamic-update-slices (the same sliced-DMA
   pattern framework/offload.py streams optimizer moments with).
-  ``out_shardings`` pins the block-grad outputs to ``pinned_host``.
+  NOTE the block gradients are INTERNAL values of the jitted step — only
+  params/opt-state appear in ``out_shardings`` — so their host residency is
+  not pinned by any output annotation: it relies on XLA propagating the
+  memory space of the ``device_put`` transpose into the scan-transpose
+  accumulator. That implicit placement is exactly what the on-chip smoke
+  (``tools_stage3_smoke.py``) validates: at 6.7B the ``[L, ...]`` gradient
+  alone exceeds HBM, so a refactor that lets XLA hoist the accumulator
+  chip-side fails immediately with an OOM instead of silently regressing
+  (the 2.7B streamed-offload run in TPU_SMOKE.log is the same guard at the
+  scale already captured on hardware). Keep that in mind before touching
+  the ``device_put`` placement in ``hidden``'s scan body.
 - The optimizer update for block params runs over host-resident p/g/m/v in
   one of two modes:
     * ``update="stream"`` — a per-layer loop round-trips each layer's

@@ -63,9 +63,13 @@ def prelu(x, weight, data_format="NCHW", name=None):
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
     from ...framework.random import next_key
     import jax.random as jr
+    # key drawn OUTSIDE the dispatched fn: the dispatch cache lifts the
+    # closure-cell key into a traced argument, so cached replays draw fresh
+    # noise (a next_key() inside f would be baked into the compiled trace)
+    key = next_key() if training else None
     def f(a):
         if training:
-            slope = jr.uniform(next_key(), a.shape, a.dtype, lower, upper)
+            slope = jr.uniform(key, a.shape, a.dtype, lower, upper)
         else:
             slope = (lower + upper) / 2.0
         return jnp.where(a >= 0, a, slope * a)
@@ -120,8 +124,9 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ...framework.random import next_key
     import jax.random as jr
+    key = next_key()  # outside f: lifted by the dispatch cache (see rrelu)
     def f(a):
-        g = jr.gumbel(next_key(), a.shape, a.dtype)
+        g = jr.gumbel(key, a.shape, a.dtype)
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:
             onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), a.shape[axis],

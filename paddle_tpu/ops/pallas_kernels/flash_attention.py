@@ -197,6 +197,13 @@ def _pallas_forward(q, k, v, causal, block_q=256, block_k=256,
         f"seq lens ({Sq}, {Sk}) not divisible by blocks "
         f"({block_q}, {block_k}); gate callers with flash_supported()")
     nq, nk = Sq // block_q, Sk // block_k
+    if dropout_p:
+        # same packed-seed envelope as the backward: dropout_keep packs the
+        # q/k block indices into 10 bits each of one prng_seed word
+        assert nq < 1024 and nk < 1024, (
+            f"flash-attention dropout PRNG seed packs q/k block indices into "
+            f"10 bits each; got num_q_blocks={nq}, num_k_blocks={nk} — raise "
+            f"block_q/block_k so both stay below 1024")
     scale = D0 ** -0.5 if scale is None else scale
 
     def to_bh(x):

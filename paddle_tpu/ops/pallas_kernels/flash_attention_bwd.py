@@ -207,6 +207,16 @@ def flash_attention_backward(q, k, v, o, lse, do, scale, causal,
     has_bias = bias is not None
     has_seg = segment_ids is not None
     dropout_p = float(dropout_p)
+    if dropout_p > 0.0:
+        # dropout_keep packs (b, qi, ki) into ONE prng_seed word as
+        # (b<<20)+(qi<<10)+ki: block indices at or above 2^10 would silently
+        # alias seed bits and correlate keep masks across blocks. Grid dims
+        # are static at trace time, so enforce the packing envelope here.
+        assert nq < 1024 and nk < 1024, (
+            f"flash-attention dropout PRNG seed packs q/k block indices into "
+            f"10 bits each; got num_q_blocks={nq}, num_k_blocks={nk} "
+            f"(seq_len/block size too large) — raise block_q/block_k so both "
+            f"stay below 1024")
 
     # delta[b, i] = rowsum(dO ∘ O): one fused elementwise+reduce in XLA
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)

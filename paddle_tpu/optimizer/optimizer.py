@@ -57,6 +57,60 @@ class Optimizer:
         self._learning_rate = scheduler
 
     # -- eager path ----------------------------------------------------------
+    def _fused_step_fn(self, config):
+        """Jit-cached FUSED eager update: every parameter's rule (moment
+        updates + master-weight path + param write) compiles into ONE XLA
+        executable per (rule, per-param static config, signature) — a single
+        dispatch per `step()` instead of one per parameter — with the old
+        param and slot buffers DONATED so the in-place update stops doubling
+        HBM. `config` is the static per-position (has_master, decay_on)
+        tuple; shapes/dtypes are handled by jax.jit's signature cache.
+
+        Donation follows FLAGS_donate_buffers: with it on, arrays that
+        aliased the pre-step param/slot buffers (e.g. ``p.detach()`` taken
+        before ``step()``, a live ``state_dict()`` snapshot, or a tape
+        retained across the step — ``backward(retain_graph=True)`` then
+        ``step()`` then ``backward()`` reads primals the step donated) are
+        freed by the update. That matches the reference's in-place param
+        write, which equally invalidates a retained graph; set the flag
+        False when holding such references."""
+        from .. import flags as _flags
+        donate = bool(_flags._FLAGS.get("FLAGS_donate_buffers", True))
+        jits = self.__dict__.setdefault("_fused_step_jits", {})
+        key = (config, donate)
+        fn = jits.get(key)
+        if fn is None:
+            import jax
+            from ..framework.compilation_cache import ensure_persistent_cache
+            ensure_persistent_cache()
+
+            def upd_all(ps, gs, ss, plrs, step):
+                new_ps, new_ss = [], []
+                for (has_master, decay_on, wd), p, g, slots, plr in zip(
+                        config, ps, gs, ss, plrs):
+                    if wd:
+                        # coupled (L2-into-grad) decay, fused into the same
+                        # program (_apply_decay_eager semantics)
+                        g = g + wd * p.astype(g.dtype)
+                    if has_master:
+                        slots = dict(slots)
+                        master = slots.pop("master")
+                        new_master, out = self._update(
+                            master, g.astype(jnp.float32), slots, plr, step,
+                            decay_on=decay_on)
+                        out["master"] = new_master
+                        new_ps.append(new_master.astype(p.dtype))
+                    else:
+                        new_p, out = self._update(p, g, slots, plr, step,
+                                                  decay_on=decay_on)
+                        new_ps.append(new_p)
+                    new_ss.append(out)
+                return new_ps, new_ss
+
+            fn = jax.jit(upd_all, donate_argnums=(0, 2) if donate else ())
+            jits[key] = fn
+        return fn
+
     @no_grad()
     def step(self):
         params = self._parameter_list
@@ -70,11 +124,20 @@ class Optimizer:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
         self._step_count += 1
+        # Coupled decay is a per-param STATIC float, so the base rule fuses
+        # into the jitted update (one dispatch total); subclasses overriding
+        # _apply_decay_eager (AdamW: decoupled no-op) keep their hook.
+        base_decay = type(self)._apply_decay_eager is Optimizer._apply_decay_eager
+        entries = []
         for p, g in params_grads:
             if g is None:
                 continue
             garr = g._data
-            garr = self._apply_decay_eager(p, garr)
+            if base_decay:
+                wd = float(self._effective_wd(p) or 0.0)
+            else:
+                wd = 0.0
+                garr = self._apply_decay_eager(p, garr)
             slots = self._accumulators.get(id(p))
             if slots is None:
                 slots = self._create_slots(p._data)
@@ -82,19 +145,20 @@ class Optimizer:
                     slots["master"] = p._data.astype(jnp.float32)
                 self._accumulators[id(p)] = slots
             plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
-            decay_on = self._decay_for(p)
-            if "master" in slots:
-                master = slots.pop("master")
-                new_master, slots = self._update(master, garr.astype(jnp.float32),
-                                                 slots, plr, self._step_count,
-                                                 decay_on=decay_on)
-                slots["master"] = new_master
-                p._data = new_master.astype(p._data.dtype)
-            else:
-                new_p, slots = self._update(p._data, garr, slots, plr,
-                                            self._step_count, decay_on=decay_on)
-                p._data = new_p
-            self._accumulators[id(p)] = slots
+            entries.append((p, garr, slots, plr, self._decay_for(p), wd))
+        if not entries:
+            return
+        config = tuple(("master" in slots, decay_on, wd)
+                       for _, _, slots, _, decay_on, wd in entries)
+        fused = self._fused_step_fn(config)
+        new_ps, new_ss = fused([e[0]._data for e in entries],
+                               [e[1] for e in entries],
+                               [e[2] for e in entries],
+                               [e[3] for e in entries],
+                               self._step_count)
+        for (p, *_), new_p, new_s in zip(entries, new_ps, new_ss):
+            p._data = new_p
+            self._accumulators[id(p)] = new_s
 
     def _decay_for(self, p):
         """Whether weight decay applies to this param (AdamW's filter fn)."""

@@ -181,6 +181,37 @@ class Profiler:
                 f"{np.percentile(ts, 50):.2f}ms  p99: {np.percentile(ts, 99):.2f}ms")
 
 
+# -- eager dispatch-cache counters -------------------------------------------
+# The jit-cached eager dispatch (dispatch.py) counts every apply() call,
+# LRU hit/miss, actual XLA (re)trace, and uncacheable fallback. hit_rate()
+# is the steady-state fraction of cached dispatches that re-used compiled
+# code — the first metric to look at when the dygraph path is slow.
+
+def dispatch_counters():
+    """Snapshot of the eager dispatch-cache counters as a dict, plus the
+    derived steady-state `hit_rate` and current `cache_entries`."""
+    from ..dispatch import cache_stats, cache_size
+    stats = cache_stats()
+    out = stats.as_dict()
+    out["hit_rate"] = stats.hit_rate()
+    out["cache_entries"] = cache_size()
+    return out
+
+
+def reset_dispatch_counters():
+    from ..dispatch import reset_cache_stats
+    reset_cache_stats()
+
+
+def dispatch_cache_summary():
+    """One-line human-readable dispatch-cache report."""
+    c = dispatch_counters()
+    return (f"dispatches: {c['dispatches']}  cached: {c['cached_calls']}  "
+            f"traces: {c['traces']}  fallbacks: {c['fallbacks']}  "
+            f"hit-rate: {c['hit_rate'] * 100:.1f}%  "
+            f"entries: {c['cache_entries']}")
+
+
 def benchmark():
     """Step-timer handle (ref profiler.utils.benchmark)."""
     return _Benchmark()

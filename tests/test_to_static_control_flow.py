@@ -569,3 +569,46 @@ class TestClosureLayerFunctionalization:
         v1 = float(np.asarray(fwd(x).numpy()))
         v2 = float(np.asarray(fwd(x).numpy()))
         assert np.isfinite(v1) and v1 == v2
+
+
+class TestLateRebinding:
+    def test_global_layer_rebound_after_first_call(self):
+        """A decorated function's module-global Layer rebound to a NEW
+        instance after the first call must be re-functionalized: the stale
+        closure-layer list would leave the new model's train-mode buffer
+        writes holding dead tracers (round-5 advisor finding)."""
+        import jax
+
+        def make_net(scale):
+            net = paddle.nn.Sequential(
+                paddle.nn.Linear(4, 4),
+                paddle.nn.BatchNorm1D(4),
+            )
+            with paddle.no_grad():
+                for p in net.parameters():
+                    p.set_value(paddle.full(p.shape, scale, p.dtype))
+            net.train()
+            return net
+
+        # exec gives fn a PRIVATE module-globals dict we can rebind in
+        ns = {}
+        exec("def fn(x):\n    return model(x).mean()\n", ns)
+        fn = ns["fn"]
+        ns["model"] = make_net(0.5)
+        fwd = paddle.jit.to_static(fn)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(8, 4).astype(np.float32))
+        v1 = float(np.asarray(fwd(x).numpy()))
+        assert np.isfinite(v1)
+        assert isinstance(ns["model"][1]._mean._data, jax.Array)
+
+        # rebind the global to a FRESH instance: must be picked up
+        ns["model"] = make_net(1.5)
+        v2 = float(np.asarray(fwd(x).numpy()))
+        assert np.isfinite(v2)
+        new_bn = ns["model"][1]
+        # the NEW layer's running stats were updated by the call (train
+        # mode) and hold concrete arrays, not leaked tracers
+        assert isinstance(new_bn._mean._data, jax.Array)
+        assert not np.allclose(np.asarray(new_bn._mean._data), 0.0)
+        assert v1 != v2

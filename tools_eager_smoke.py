@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Eager-dispatch microbench: LeNet MNIST dygraph train loop, CPU-runnable.
+
+Measures what the jit-cached eager dispatch buys on the BASELINE.json PR-1
+reference config (MNIST LeNet dygraph): full eager forward + backward +
+AdamW step per iteration, no to_static, no TrainStep — every op goes through
+`dispatch.apply` exactly like user dygraph code.
+
+  JAX_PLATFORMS=cpu python tools_eager_smoke.py [--iters N] [--batch B] \
+      [--warmup W] [--no-baseline]
+
+Prints, machine-greppable for the BENCH trajectory:
+
+  EAGER_SMOKE cached:   <ops/s> ops/s  <it/s> it/s  hit-rate <pct>
+  EAGER_SMOKE uncached: <ops/s> ops/s  <it/s> it/s
+  EAGER_SMOKE speedup:  <x>
+
+"ops/s" counts dispatch.apply calls per second (the dygraph dispatch rate —
+the paper's analog of Paddle's C++ eager op dispatch throughput).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.framework.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    return model, opt, loss_fn, rng
+
+
+def _make_batch(rng, batch):
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(rng.rand(batch, 1, 28, 28).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype("int64"))
+    return x, y
+
+
+def _train_iters(model, opt, loss_fn, batches, n):
+    losses = []
+    for i in range(n):
+        x, y = batches[i % len(batches)]
+        out = model(x)
+        loss = loss_fn(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def run_bench(iters=30, batch=1, warmup=5, baseline=True, n_batches=2):
+    """Returns a dict with cached/uncached ops-per-sec, iters-per-sec, the
+    steady-state cache hit rate, and the speedup. CPU-runnable (~seconds)."""
+    from paddle_tpu import flags
+    import paddle_tpu.profiler as prof
+    from paddle_tpu.dispatch import cache_stats, clear_cache
+
+    model, opt, loss_fn, rng = _build()
+    batches = [_make_batch(rng, batch) for _ in range(n_batches)]
+
+    result = {"iters": iters, "batch": batch}
+
+    prev = flags.get_flags(["FLAGS_eager_jit_cache"])["FLAGS_eager_jit_cache"]
+    try:
+        if baseline:
+            flags.set_flags({"FLAGS_eager_jit_cache": False})
+            _train_iters(model, opt, loss_fn, batches, max(2, warmup // 2))
+            prof.reset_dispatch_counters()
+            t0 = time.perf_counter()
+            losses_off = _train_iters(model, opt, loss_fn, batches, iters)
+            dt_off = time.perf_counter() - t0
+            n_off = cache_stats().dispatches
+            result["uncached_ops_per_s"] = n_off / dt_off
+            result["uncached_iters_per_s"] = iters / dt_off
+            result["losses_uncached"] = losses_off[-3:]
+
+        flags.set_flags({"FLAGS_eager_jit_cache": True})
+        clear_cache()
+        _train_iters(model, opt, loss_fn, batches, warmup)  # compile/fill
+        prof.reset_dispatch_counters()
+        t0 = time.perf_counter()
+        losses_on = _train_iters(model, opt, loss_fn, batches, iters)
+        dt_on = time.perf_counter() - t0
+        stats = cache_stats()
+        result["cached_ops_per_s"] = stats.dispatches / dt_on
+        result["cached_iters_per_s"] = iters / dt_on
+        result["hit_rate"] = stats.hit_rate()
+        result["fallbacks"] = stats.fallbacks
+        result["dispatches_per_iter"] = stats.dispatches / iters
+        result["losses_cached"] = losses_on[-3:]
+        if baseline:
+            result["speedup"] = (result["cached_iters_per_s"] /
+                                 result["uncached_iters_per_s"])
+    finally:
+        flags.set_flags({"FLAGS_eager_jit_cache": prev})
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=30)
+    # a small batch keeps the CPU run DISPATCH-bound (the regime the cache
+    # targets, and the CPU proxy for TPU where per-op compute is tiny);
+    # large batches turn this into a conv-FLOPs benchmark instead
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the uncached reference run")
+    args = ap.parse_args(argv)
+
+    r = run_bench(iters=args.iters, batch=args.batch, warmup=args.warmup,
+                  baseline=not args.no_baseline)
+    print(f"EAGER_SMOKE cached:   {r['cached_ops_per_s']:.1f} ops/s  "
+          f"{r['cached_iters_per_s']:.2f} it/s  "
+          f"hit-rate {r['hit_rate'] * 100:.1f}%  "
+          f"({r['dispatches_per_iter']:.0f} ops/iter, "
+          f"{r['fallbacks']} fallbacks)")
+    if "uncached_ops_per_s" in r:
+        print(f"EAGER_SMOKE uncached: {r['uncached_ops_per_s']:.1f} ops/s  "
+              f"{r['uncached_iters_per_s']:.2f} it/s")
+        print(f"EAGER_SMOKE speedup:  {r['speedup']:.2f}x")
+    return r
+
+
+if __name__ == "__main__":
+    main()
