@@ -84,7 +84,24 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
               ReduceOp.AVG: lax.pmean}.get(op)
         if op == ReduceOp.PROD:
             def fn(x, a):
-                return jnp.exp(lax.psum(jnp.log(x), a))
+                # sign-and-magnitude lowering: exp(psum(log|x|)) for the
+                # magnitude with zeros masked to 1, sign from the parity of
+                # the negative count, exact 0 when any member holds a 0 —
+                # the naive exp(psum(log(x))) NaNs on zero/negative inputs
+                # float64 magnitude when x64 is enabled (silently float32
+                # otherwise): int32+ products overflow fp32's 24-bit mantissa
+                xf = x.astype(jnp.float64)
+                zeros = lax.psum((xf == 0).astype(jnp.int32), a)
+                negs = lax.psum((xf < 0).astype(jnp.int32), a)
+                mag = jnp.exp(lax.psum(
+                    jnp.log(jnp.where(xf == 0, 1.0, jnp.abs(xf))), a))
+                sign = jnp.where(negs % 2 == 0, 1.0, -1.0)
+                res = jnp.where(zeros > 0, 0.0, sign * mag)
+                if jnp.issubdtype(x.dtype, jnp.integer):
+                    # exp/log round-trip is inexact; truncation toward zero
+                    # would turn prod([2, 3]) = 5.9999995 into 5
+                    res = jnp.round(res)
+                return res.astype(x.dtype)
         out = _apply(lambda x: fn(x, axis), tensor, op_name="all_reduce")
         if isinstance(tensor, Tensor):
             tensor._data = out._data
@@ -203,7 +220,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     """Point-to-point on a ring: implemented as ppermute inside SPMD regions."""
     ax = _axis(group)
     if _in_spmd(ax):
-        n = lax.axis_size(ax)
+        n = env.axis_size(ax)
         perm = [(i, dst) for i in range(n)]
         return _apply(lambda x: lax.ppermute(x, ax, perm), tensor, op_name="send")
     return tensor
@@ -212,7 +229,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 def recv(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if _in_spmd(ax):
-        n = lax.axis_size(ax)
+        n = env.axis_size(ax)
         perm = [(src, i) for i in range(n)]
         out = _apply(lambda x: lax.ppermute(x, ax, perm), tensor, op_name="recv")
         if isinstance(tensor, Tensor):
@@ -227,7 +244,7 @@ def p2p_shift(tensor, group=None, shift=1):
     `shift` steps around the axis. Used by pipeline & ring attention."""
     ax = _axis(group)
     def f(x):
-        n = lax.axis_size(ax)
+        n = env.axis_size(ax)
         perm = [(i, (i + shift) % n) for i in range(n)]
         return lax.ppermute(x, ax, perm)
     return _apply(f, tensor, op_name="p2p_shift")

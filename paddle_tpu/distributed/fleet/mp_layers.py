@@ -29,11 +29,27 @@ def _constrain(t, spec=None, last_axis=None):
     mesh = env.get_mesh()
     if mesh is None:
         return t
+    from ..collective import _in_spmd
 
     def f(a):
         s = spec if last_axis is None else P(*([None] * (a.ndim - 1)), last_axis)
+        s = s if s is not None else P()
+        # a constraint whose axes are bound manually (shard_map — e.g.
+        # grad_comm's explicit dp step, or the pipeline's 'pp') is invalid
+        # and meaningless: the array is already a per-device shard there.
+        # Axes still in GSPMD-auto mode (partial-manual regions) keep their
+        # constraints. A replicated P() constraint only survives when some
+        # axis is still auto.
+        named = {ax for part in s for grp in
+                 (part if isinstance(part, tuple) else (part,),)
+                 for ax in grp if ax is not None}
+        if named:
+            if any(_in_spmd(ax) for ax in named):
+                return a
+        elif all(_in_spmd(ax) for ax in mesh.axis_names):
+            return a
         return jax.lax.with_sharding_constraint(
-            a, jax.sharding.NamedSharding(mesh, s if s is not None else P()))
+            a, jax.sharding.NamedSharding(mesh, s))
     try:
         return _apply(f, t, op_name="shard_constraint")
     except Exception:

@@ -41,7 +41,7 @@ def pipeline_spmd(block_fn, stage_params, x_mb, *, axis_name="pp"):
     other stages return garbage that the caller discards (out_specs selects
     from the last stage).
     """
-    S = lax.axis_size(axis_name)
+    S = env.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     M = x_mb.shape[0]
     T = M + S - 1
@@ -156,7 +156,7 @@ def pipeline_spmd_1f1b(block_fn, stage_params, x_mb, *, axis_name="pp",
     (cast) stage params. ~1 extra forward vs GPipe+autodiff, in exchange for
     O(S) instead of O(M) activation memory.
     """
-    S = lax.axis_size(axis_name)
+    S = env.axis_size(axis_name)
     M = x_mb.shape[0]
     stage_fn = _stage_fn_of(block_fn, remat_policy)
 
@@ -257,7 +257,7 @@ def pipeline_spmd_interleaved_1f1b(block_fn, stage_params, x_mb, *,
     stage_params leaves: [1, V, L_chunk, ...] — this device's V chunks.
     x_mb: [M, mb...]; returns [M, mb...] like pipeline_spmd.
     """
-    S = lax.axis_size(axis_name)
+    S = env.axis_size(axis_name)
     V = num_virtual
     Sv = V * S
     M = x_mb.shape[0]
@@ -470,7 +470,7 @@ def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
                 "path derives its own recompute from the scan)")
         spmd = pipeline_spmd
     inner = functools.partial(spmd, block_fn, axis_name=axis_name)
-    mapped = jax.shard_map(
+    mapped = env.shard_map_compat(
         lambda p, xm: inner(p, xm),
         mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
         axis_names=frozenset({axis_name}))

@@ -12,9 +12,13 @@ Stage semantics on TPU:
 """
 from __future__ import annotations
 
+import logging
+
 from jax.sharding import PartitionSpec as P
 
 from . import env
+
+logger = logging.getLogger(__name__)
 
 
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
@@ -41,17 +45,30 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
         return model, optimizer, scaler
     n = mesh.shape[axis]
     if stage >= 3:
-        for _, p in model.named_parameters():
+        skipped = []
+        for name, p in model.named_parameters():
             if getattr(p, "dist_spec", None) is not None:
                 continue
             shape = tuple(p.shape)
             if not shape:
                 continue
-            dim = max(range(len(shape)), key=lambda i: shape[i])
-            if shape[dim] % n == 0:
-                spec = [None] * len(shape)
-                spec[dim] = axis
-                p.dist_spec = P(*spec)
+            # shard the largest dim divisible by the axis size — falling
+            # back through smaller dims instead of silently keeping the
+            # param replicated when only the largest dim is indivisible
+            dims = sorted(range(len(shape)), key=lambda i: shape[i],
+                          reverse=True)
+            dim = next((i for i in dims if shape[i] % n == 0), None)
+            if dim is None:
+                skipped.append(f"{name}{list(shape)}")
+                continue
+            spec = [None] * len(shape)
+            spec[dim] = axis
+            p.dist_spec = P(*spec)
+        if skipped:
+            logger.warning(
+                "group_sharded_parallel stage-%d: %d param(s) stay "
+                "replicated (no dim divisible by %s=%d): %s",
+                stage, len(skipped), axis, n, ", ".join(skipped))
     optimizer._zero_stage = stage
     optimizer._shard_opt_states_axis = axis
     return model, optimizer, scaler

@@ -19,6 +19,24 @@ _FLAGS = {
     # Persist XLA executables across processes (JAX_COMPILATION_CACHE_DIR,
     # default <cwd>/.jax_cache — see framework/compilation_cache.py).
     "FLAGS_persistent_compilation_cache": True,
+    # -- explicit gradient communication (distributed/grad_comm.py) ---------
+    # Master switch: "auto" activates the explicit schedule only when one of
+    # the two knobs below asks for a non-default schedule; True/"on" forces
+    # it (gives the allreduce-fp32 baseline its own comm counters); False
+    # disables it entirely. Default path is byte-identical to the seed.
+    "FLAGS_grad_comm": "auto",
+    # Weight-update sharding (ZeRO-1 per arXiv:2004.13336): reduce-scatter
+    # grads, fused optimizer update on each replica's 1/n flat shard (slots
+    # stored sharded), all-gather updated params — halves grad-reduce wire
+    # bytes vs all-reduce and divides update FLOPs/slot HBM by the dp size.
+    "FLAGS_weight_update_sharding": False,
+    # Wire dtype for the gradient reduction: float32 | bfloat16 | int8.
+    # Compressed dtypes move over an all_to_all exchange and accumulate in
+    # fp32 on the receiver (EQuARX-style per-2048-chunk scales for int8);
+    # master/update math stays fp32.
+    "FLAGS_allreduce_dtype": "float32",
+    # Flat-buffer bucket size for grad collectives: few, large transfers.
+    "FLAGS_grad_bucket_bytes": 16 * 2 ** 20,
 }
 
 

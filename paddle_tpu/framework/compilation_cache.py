@@ -23,11 +23,16 @@ _lock = threading.Lock()
 _initialized = False
 
 
+def _flags_enabled():
+    from .. import flags as _flags
+    return bool(_flags._FLAGS.get("FLAGS_persistent_compilation_cache", True))
+
+
 def ensure_persistent_cache():
     """Idempotent: enable jax's on-disk compilation cache once per process."""
     global _initialized
-    if _initialized:
-        return
+    if _initialized and _flags_enabled():
+        return  # fast path only while the flag still agrees with the latch
     with _lock:
         from .. import flags as _flags
         enabled = _flags._FLAGS.get("FLAGS_persistent_compilation_cache", True)

@@ -200,7 +200,8 @@ def expert_parallel_moe(x, gate_w, gate_b, w1, b1, w2, b2, *, mesh=None,
 
     tok = P(axis, None)
     exp = P(axis, *([None] * (w1.ndim - 1)))
-    mapped = jax.shard_map(
+    from .....distributed import env as _dist_env
+    mapped = _dist_env.shard_map_compat(
         local_fn, mesh=mesh,
         in_specs=(tok, P(), P(), exp, P(axis, None), exp, P(axis, None)),
         out_specs=(tok, P()),

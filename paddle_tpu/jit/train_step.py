@@ -12,12 +12,27 @@ from each Parameter's `dist_spec` (set by fleet/parallel layers). XLA inserts
 all collectives (dp grad allreduce, tp activation collectives, ZeRO
 gather/scatter) from the sharding annotations — the ProcessGroupNCCL layer of
 the reference has no analog here because the compiler emits it.
+
+When the explicit gradient-communication layer is enabled
+(distributed/grad_comm.py; FLAGS_weight_update_sharding /
+FLAGS_allreduce_dtype / FLAGS_grad_comm), the data-parallel step instead
+compiles under shard_map over the dp axis so the grad-reduce schedule is
+ours, not GSPMD's: bucketed reduce-scatter of local grads, the fused
+optimizer update on each replica's 1/n flat shard (optimizer slots stored
+packed+sharded, zero slot communication), then a bucketed all-gather of the
+updated params — the weight-update-sharding schedule of arXiv:2004.13336,
+with optional bf16/int8 wire compression (arXiv:2506.17615). With
+accumulate_steps>1 the reduce-scatter of micro-step t is issued inside
+micro-step t's program while micro-step t+1's host dispatch proceeds
+asynchronously, so per-bucket communication overlaps the next micro-batch's
+compute instead of bunching at the update barrier.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..tensor_impl import Tensor
@@ -64,8 +79,13 @@ class TrainStep:
             {n: jnp.zeros_like(a) for n, a in self._params.items()}
             if self.accumulate_steps > 1 else None)
         self._micro = jnp.zeros((), jnp.int32)
+        self._micro_py = 0
         self._jitted = None
         self._step = 0
+        # explicit gradient-communication schedule (grad_comm.py); resolved
+        # from flags at first call, None = default GSPMD schedule
+        self._gc_cfg = None
+        self._comm_records = None
 
     # -- sharding helpers ----------------------------------------------------
     def _sharding_for(self, spec):
@@ -94,6 +114,16 @@ class TrainStep:
         return {n: self._sharding_for(self._specs.get(n)) for n in self._params}
 
     def _opt_shardings(self):
+        # weight-update sharding (grad_comm): slots live in the packed
+        # (n, cols) layout with the leading axis sharded over the dp axis —
+        # each replica persistently holds the 1/n flat shard its update
+        # touches, and the compiled step moves zero slot bytes.
+        if self._gc_cfg is not None and self._gc_cfg.weight_update_sharding:
+            ax = self._gc_cfg.axis
+            packed = self._sharding_for(P(ax, None))
+            return {"step": self._sharding_for(P()),
+                    "slots": {n: {k: packed for k in s}
+                              for n, s in self._opt_state["slots"].items()}}
         # slots mirror param shapes -> same sharding; scalars replicated.
         # ZeRO stage>=1 (fleet sharding): slots of replicated params shard
         # over the 'sharding' axis (ref: fleet sharding stage1/2 optimizer
@@ -131,8 +161,13 @@ class TrainStep:
             lambda a, s: jax.device_put(a, s), self._opt_state, o_sh,
             is_leaf=lambda x: isinstance(x, jax.Array))
         if self._grad_accum is not None:
-            self._grad_accum = {n: jax.device_put(a, p_sh[n])
-                                for n, a in self._grad_accum.items()}
+            if self._gc_cfg is not None and self._gc_cfg.weight_update_sharding:
+                acc_sh = self._sharding_for(P(self._gc_cfg.axis, None))
+                self._grad_accum = {n: jax.device_put(a, acc_sh)
+                                    for n, a in self._grad_accum.items()}
+            else:
+                self._grad_accum = {n: jax.device_put(a, p_sh[n])
+                                    for n, a in self._grad_accum.items()}
 
     # -- compiled step -------------------------------------------------------
     def _effective_donate(self):
@@ -182,6 +217,9 @@ class TrainStep:
                 clipped = grad_clip.apply_arrays([grads[n] for n in names])
                 grads = dict(zip(names, clipped))
             return optimizer.apply_gradients(params, grads, opt_state, lr)
+
+        if self._gc_cfg is not None:
+            return self._build_grad_comm(loss_from, apply_update)
 
         def step_fn(params, opt_state, buffers, lr, key, inputs, labels):
             (loss, new_buffers), grads = jax.value_and_grad(
@@ -255,6 +293,181 @@ class TrainStep:
                            in_shardings=in_shardings, out_shardings=out_shardings)
         return jax.jit(step_fn, donate_argnums=donate)
 
+    # -- explicit gradient-communication step (grad_comm.py) ----------------
+    def _build_grad_comm(self, loss_from, apply_update):
+        """Compile the step under shard_map over the dp axis with the
+        explicit bucketed reduce-scatter / sharded-update / all-gather
+        schedule (or the explicit all-reduce baseline when weight-update
+        sharding is off). Returns one jitted fn, or for accumulate_steps>1
+        a {"micro", "fire"} pair — micro steps issue only the per-bucket
+        reduce-scatter into the sharded accumulator, so their collectives
+        overlap the (asynchronously dispatched) next micro-batch compute."""
+        from ..distributed import grad_comm as _gc
+        from ..distributed.env import shard_map_compat as shard_map
+        cfg = self._gc_cfg
+        mesh, axis, n = self.mesh, cfg.axis, cfg.n
+        optimizer = self.optimizer
+        grad_clip = getattr(optimizer, "_grad_clip", None)
+        plan = _gc.BucketPlan.build(self._params, n, cfg.bucket_bytes)
+        cfg.plan = plan
+        wus = cfg.weight_update_sharding
+        wire = cfg.wire_dtype
+        k = self.accumulate_steps
+        names = list(self._params)
+
+        self._comm_records = {
+            "step": _gc.make_step_record(plan, wire, wus),
+            "micro": _gc.make_step_record(plan, wire, wus, with_update=False),
+            "fire": _gc.make_step_record(plan, wire, wus),
+        }
+
+        def local_loss_grads(params, buffers, key, inputs, labels):
+            # decorrelate per-replica dropout: the replicas see different
+            # batch shards, so their masks must differ too
+            key = jax.random.fold_in(key, lax.axis_index(axis))
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_from, has_aux=True)(params, buffers, key, inputs, labels)
+            return loss, new_buffers, grads
+
+        def sync_buffers(bufs):
+            # replicas update running stats (BN etc.) from their local shard;
+            # pmean restores the replicated invariant
+            return {nm: (lax.pmean(v, axis)
+                         if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for nm, v in bufs.items()}
+
+        def sharded_update(params, opt_state, gshards, lr):
+            """Fused optimizer update on each replica's 1/n flat shard, then
+            bucketed all-gather of the new params. Elementwise rules make
+            shard-of-update == update-of-shard bitwise."""
+            idx = lax.axis_index(axis)
+            pshards = {nm: _gc.shard_of(plan, nm, params[nm], idx)
+                       for nm in names}
+            slots_sh = {nm: {kk: v.reshape(-1) for kk, v in sl.items()}
+                        for nm, sl in opt_state["slots"].items()}
+            new_psh, new_state = optimizer.apply_gradients(
+                pshards, gshards, {"step": opt_state["step"],
+                                   "slots": slots_sh}, lr)
+            new_params = _gc.all_gather_shards(plan, new_psh, axis)
+            new_opt = {"step": new_state["step"],
+                       "slots": {nm: {kk: v.reshape(1, -1)
+                                      for kk, v in sl.items()}
+                                 for nm, sl in new_state["slots"].items()}}
+            return new_params, new_opt
+
+        def reduce_mean_shards(grads):
+            return _gc.reduce_scatter_grads(plan, grads, axis, wire, denom=n)
+
+        # -- specs/shardings ------------------------------------------------
+        P_rep, P_packed, P_data = P(), P(axis, None), P(axis)
+        p_spec = {nm: P_rep for nm in self._params}
+        b_spec = {nm: P_rep for nm in self._buffers}
+        if wus:
+            o_spec = {"step": P_rep,
+                      "slots": {nm: {kk: P_packed for kk in sl}
+                                for nm, sl in self._opt_state["slots"].items()}}
+        else:
+            o_spec = jax.tree_util.tree_map(lambda _: P_rep, self._opt_state)
+        data_spec = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda _: P_data, t)
+        to_sh = lambda spec_tree: jax.tree_util.tree_map(  # noqa: E731
+            lambda s: NamedSharding(mesh, s), spec_tree,
+            is_leaf=lambda x: isinstance(x, P))
+        in_data = data_spec(self._sample_inputs)
+        in_lab = data_spec(self._sample_labels)
+
+        if k == 1:
+            def body(params, opt_state, buffers, lr, key, inputs, labels):
+                loss, new_buffers, grads = local_loss_grads(
+                    params, buffers, key, inputs, labels)
+                gshards = reduce_mean_shards(grads)
+                if grad_clip is not None:
+                    gshards = _gc.clip_shards(grad_clip, gshards, axis)
+                if wus:
+                    new_params, new_opt = sharded_update(
+                        params, opt_state, gshards, lr)
+                else:
+                    # explicit all-reduce baseline: finish the reduce with a
+                    # grad all-gather (ring AR = RS+AG), replicated update
+                    grads_full = _gc.all_gather_shards(plan, gshards, axis)
+                    new_params, new_opt = optimizer.apply_gradients(
+                        params, grads_full, opt_state, lr)
+                return (lax.pmean(loss, axis), new_params, new_opt,
+                        sync_buffers(new_buffers))
+
+            smap = shard_map(
+                body, mesh=mesh,
+                in_specs=(p_spec, o_spec, b_spec, P_rep, P_rep, in_data,
+                          in_lab),
+                out_specs=(P_rep, p_spec, o_spec, b_spec))
+            donate = (0, 1, 2) if self._effective_donate() else ()
+            return jax.jit(
+                smap, donate_argnums=donate,
+                in_shardings=to_sh((p_spec, o_spec, b_spec, P_rep, P_rep,
+                                    in_data, in_lab)),
+                out_shardings=to_sh((P_rep, p_spec, o_spec, b_spec)))
+
+        # accumulate_steps > 1: separate micro/fire programs selected by the
+        # host-side micro counter (deterministic), instead of lax.cond —
+        # micro programs contain ONLY the reduce-scatter collectives
+        acc_spec = ({nm: P_packed for nm in self._params} if wus
+                    else {nm: P_rep for nm in self._params})
+
+        def micro_body(params, opt_state, buffers, gacc, micro, lr, key,
+                       inputs, labels):
+            loss, new_buffers, grads = local_loss_grads(
+                params, buffers, key, inputs, labels)
+            gshards = reduce_mean_shards(grads)
+            if wus:
+                new_gacc = {nm: gacc[nm] +
+                            (gshards[nm] / k).astype(gacc[nm].dtype
+                                                     ).reshape(1, -1)
+                            for nm in names}
+            else:
+                grads_full = _gc.all_gather_shards(plan, gshards, axis)
+                new_gacc = {nm: gacc[nm] +
+                            (grads_full[nm] / k).astype(gacc[nm].dtype)
+                            for nm in names}
+            return (lax.pmean(loss, axis), params, opt_state,
+                    sync_buffers(new_buffers), new_gacc, micro + 1)
+
+        def fire_body(params, opt_state, buffers, gacc, micro, lr, key,
+                      inputs, labels):
+            loss, new_buffers, grads = local_loss_grads(
+                params, buffers, key, inputs, labels)
+            gshards = reduce_mean_shards(grads)
+            if wus:
+                acc = {nm: gacc[nm].reshape(-1) +
+                       (gshards[nm] / k).astype(gacc[nm].dtype)
+                       for nm in names}
+                if grad_clip is not None:
+                    acc = _gc.clip_shards(grad_clip, acc, axis)
+                new_params, new_opt = sharded_update(params, opt_state, acc,
+                                                     lr)
+                zeroed = {nm: jnp.zeros_like(gacc[nm]) for nm in names}
+            else:
+                grads_full = _gc.all_gather_shards(plan, gshards, axis)
+                acc = {nm: gacc[nm] + (grads_full[nm] / k
+                                       ).astype(gacc[nm].dtype)
+                       for nm in names}
+                new_params, new_opt = apply_update(params, acc, opt_state, lr)
+                zeroed = {nm: jnp.zeros_like(gacc[nm]) for nm in names}
+            return (lax.pmean(loss, axis), new_params, new_opt,
+                    sync_buffers(new_buffers), zeroed, micro + 1)
+
+        in_specs = (p_spec, o_spec, b_spec, acc_spec, P_rep, P_rep, P_rep,
+                    in_data, in_lab)
+        out_specs = (P_rep, p_spec, o_spec, b_spec, acc_spec, P_rep)
+        donate = (0, 1, 2, 3) if self._effective_donate() else ()
+        jits = {}
+        for tag, body in (("micro", micro_body), ("fire", fire_body)):
+            smap = shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+            jits[tag] = jax.jit(smap, donate_argnums=donate,
+                                in_shardings=to_sh(in_specs),
+                                out_shardings=to_sh(out_specs))
+        return jits
+
     def build_eval(self):
         """Jitted (params, buffers, inputs, labels) -> (loss, outputs) over
         the SAME forward+loss tracing and data shardings as the train step
@@ -299,6 +512,26 @@ class TrainStep:
         if self._jitted is None:
             self._sample_inputs = in_arrays
             self._sample_labels = lab_arrays
+            from ..distributed import grad_comm as _gc
+            self._gc_cfg = _gc.resolve(
+                self.mesh, self.optimizer, opt_state=self._opt_state,
+                params=self._params, offload=self._offload,
+                param_specs=self._specs)
+            if self._gc_cfg is not None and self._gc_cfg.weight_update_sharding:
+                self._opt_state = _gc.pack_opt_state(
+                    self._opt_state, self._params, self._gc_cfg.n)
+                if self._grad_accum is not None:
+                    self._grad_accum = _gc.pack_accum(
+                        self._grad_accum, self._params, self._gc_cfg.n)
+            else:
+                # a checkpoint saved under weight-update sharding restores
+                # packed (n, cols) slots; normalize back to param-shaped
+                # when this step runs a replicated-update schedule
+                self._opt_state = _gc.unpack_opt_state(self._opt_state,
+                                                       self._params)
+                if self._grad_accum is not None:
+                    self._grad_accum = _gc.unpack_accum(self._grad_accum,
+                                                        self._params)
             if self.mesh is not None:
                 self.shard_params()
             elif self._offload:
@@ -313,15 +546,29 @@ class TrainStep:
                                              self._opt_dev_shardings())
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         if self.accumulate_steps > 1:
+            if isinstance(self._jitted, dict):
+                # grad_comm pair: the boundary is host-deterministic, so the
+                # micro program (reduce-scatter only) and the fire program
+                # (update + param all-gather) are separate executables
+                fire = (self._micro_py + 1) % self.accumulate_steps == 0
+                fn = self._jitted["fire" if fire else "micro"]
+                rec = self._comm_records["fire" if fire else "micro"]
+            else:
+                fn, rec = self._jitted, None
             (loss, self._params, self._opt_state, self._buffers,
-             self._grad_accum, self._micro) = self._jitted(
+             self._grad_accum, self._micro) = fn(
                 self._params, self._opt_state, self._buffers,
                 self._grad_accum, self._micro, lr, next_key(),
                 in_arrays, lab_arrays)
+            self._micro_py += 1
         else:
+            rec = self._comm_records["step"] if self._comm_records else None
             loss, self._params, self._opt_state, self._buffers = self._jitted(
                 self._params, self._opt_state, self._buffers, lr, next_key(),
                 in_arrays, lab_arrays)
+        if rec is not None:
+            from ..distributed import grad_comm as _gc
+            _gc.record_step(rec)
         if offload_out:
             self._opt_state = self._move_opt(self._opt_state,
                                              self._opt_host_shardings())
@@ -334,6 +581,8 @@ class TrainStep:
         of the current step — the evidence hook for ZeRO sharding tests."""
         if self._jitted is None:
             raise RuntimeError("call the step once to compile first")
+        jitted = (self._jitted["fire"] if isinstance(self._jitted, dict)
+                  else self._jitted)
         if self.accumulate_steps > 1:
             args = (self._params, self._opt_state, self._buffers,
                     self._grad_accum, self._micro,
@@ -343,7 +592,7 @@ class TrainStep:
             args = (self._params, self._opt_state, self._buffers,
                     jnp.zeros((), jnp.float32), next_key(),
                     self._sample_inputs, self._sample_labels)
-        return self._jitted.lower(*args).compile().memory_analysis()
+        return jitted.lower(*args).compile().memory_analysis()
 
     def sync_to_model(self):
         """Write the device-resident params/buffers back into the Layer tensors."""
@@ -386,6 +635,23 @@ class TrainStep:
         if "grad_accum" in state:
             self._grad_accum = put(state["grad_accum"])
             self._micro = jnp.asarray(state["micro"], jnp.int32)
+            self._micro_py = int(state["micro"])
+        if self._jitted is not None:
+            # the compiled step fixed a slot layout at build time; normalize
+            # a checkpoint from the other schedule (packed <-> param-shaped)
+            from ..distributed import grad_comm as _gc
+            if self._gc_cfg is not None and self._gc_cfg.weight_update_sharding:
+                self._opt_state = _gc.pack_opt_state(
+                    self._opt_state, self._params, self._gc_cfg.n)
+                if self._grad_accum is not None:
+                    self._grad_accum = _gc.pack_accum(
+                        self._grad_accum, self._params, self._gc_cfg.n)
+            else:
+                self._opt_state = _gc.unpack_opt_state(self._opt_state,
+                                                       self._params)
+                if self._grad_accum is not None:
+                    self._grad_accum = _gc.unpack_accum(self._grad_accum,
+                                                        self._params)
         if self.mesh is not None:
             self.shard_params()
         self.sync_to_model()

@@ -230,6 +230,14 @@ class Optimizer:
             new_slots[name] = slots
         return new_params, {"step": step, "slots": new_slots}
 
+    def supports_sharded_update(self):
+        """True when `apply_gradients` may run on per-replica flat shards of
+        params/grads/slots (weight-update sharding, distributed/
+        grad_comm.py): the rule must be elementwise — slicing a flat view
+        then updating must equal updating then slicing. Slot-layout checks
+        (param-shaped vs packed) live in grad_comm.resolve."""
+        return self._elementwise_update
+
     def _apply_decay_functional(self, p, g, decay_on):
         wd = self._coupled_wd
         if not isinstance(wd, (int, float)):

@@ -212,6 +212,37 @@ def dispatch_cache_summary():
             f"entries: {c['cache_entries']}")
 
 
+# -- gradient-communication counters ----------------------------------------
+# The explicit grad-comm layer (distributed/grad_comm.py) has a static
+# collective schedule per compiled TrainStep; every executed step records its
+# wire bytes (reduce vs gather, by dtype), collective count, bucket count and
+# bucket fill here. The first thing to look at when a DP step is
+# communication-bound — and the evidence hook for the reduce-scatter and
+# quantized-reduce wins.
+
+def comm_counters():
+    """Snapshot of the gradient-communication counters: reduce_bytes (+ by
+    dtype), gather_bytes, collectives, buckets, bucket_fill, steps."""
+    from ..distributed import grad_comm
+    return grad_comm.comm_counters()
+
+
+def reset_comm_counters():
+    from ..distributed import grad_comm
+    grad_comm.reset_comm_counters()
+
+
+def comm_summary():
+    """One-line human-readable gradient-communication report."""
+    c = comm_counters()
+    by = " ".join(f"{k}:{v / 1e6:.2f}MB"
+                  for k, v in sorted(c["reduce_bytes_by_dtype"].items()))
+    return (f"steps: {c['steps']}  collectives: {c['collectives']}  "
+            f"reduce: {c['reduce_bytes'] / 1e6:.2f}MB ({by})  "
+            f"gather: {c['gather_bytes'] / 1e6:.2f}MB  "
+            f"buckets: {c['buckets']}  fill: {c['bucket_fill'] * 100:.1f}%")
+
+
 def benchmark():
     """Step-timer handle (ref profiler.utils.benchmark)."""
     return _Benchmark()

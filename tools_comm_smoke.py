@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Gradient-communication microbench: GPT-mini data-parallel step on the
+8-virtual-device CPU mesh, one line per schedule.
+
+Compares the default GSPMD schedule's explicit replacement
+(distributed/grad_comm.py) across {allreduce-fp32, rs/ag-fp32, rs/ag-bf16,
+rs/ag-int8}: step time, per-step wire bytes (reduce vs gather, from
+profiler.comm_counters()), collective and bucket counts.
+
+  python tools_comm_smoke.py [--iters N] [--warmup W] [--layers L] \
+      [--hidden H] [--batch B] [--seq S] [--bucket-kb KB]
+
+Prints, machine-greppable for the BENCH trajectory:
+
+  COMM_SMOKE <name>: <ms>/step  reduce <MB>MB  gather <MB>MB  \
+      collectives <n>  buckets <n>  fill <pct>%  loss <x>
+  COMM_SMOKE ratio: rs/ag reduce bytes = <x> of allreduce
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+CONFIGS = [
+    ("allreduce-fp32", {"FLAGS_grad_comm": "on",
+                        "FLAGS_weight_update_sharding": False,
+                        "FLAGS_allreduce_dtype": "float32"}),
+    ("rs/ag-fp32", {"FLAGS_grad_comm": "on",
+                    "FLAGS_weight_update_sharding": True,
+                    "FLAGS_allreduce_dtype": "float32"}),
+    ("rs/ag-bf16", {"FLAGS_grad_comm": "on",
+                    "FLAGS_weight_update_sharding": True,
+                    "FLAGS_allreduce_dtype": "bfloat16"}),
+    ("rs/ag-int8", {"FLAGS_grad_comm": "on",
+                    "FLAGS_weight_update_sharding": True,
+                    "FLAGS_allreduce_dtype": "int8"}),
+]
+
+
+def run_config(name, flags, args):
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt_loss_fn
+
+    paddle.set_flags({"FLAGS_grad_comm": "auto",
+                      "FLAGS_weight_update_sharding": False,
+                      "FLAGS_allreduce_dtype": "float32",
+                      "FLAGS_grad_bucket_bytes": args.bucket_kb * 1024})
+    paddle.set_flags(flags)
+    mesh = dist_env.create_hybrid_mesh(dp=8)
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=4,
+                    max_seq_len=args.seq, compute_dtype="float32",
+                    use_flash=False, remat=False, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, gpt_loss_fn, opt, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+
+    def batch():
+        ids = rng.randint(0, cfg.vocab_size,
+                          (args.batch, args.seq)).astype(np.int64)
+        return paddle.to_tensor(ids)
+
+    for _ in range(args.warmup):
+        b = batch()
+        loss = step(b, b)
+    jax.block_until_ready(loss._data)
+
+    profiler.reset_comm_counters()
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        b = batch()
+        loss = step(b, b)
+    jax.block_until_ready(loss._data)
+    dt = (time.perf_counter() - t0) / args.iters
+    c = profiler.comm_counters()
+    per = lambda k: c[k] / max(c["steps"], 1)  # noqa: E731
+    print(f"COMM_SMOKE {name}: {dt * 1e3:.1f}ms/step  "
+          f"reduce {per('reduce_bytes') / 1e6:.2f}MB  "
+          f"gather {per('gather_bytes') / 1e6:.2f}MB  "
+          f"collectives {per('collectives'):.0f}  "
+          f"buckets {per('buckets'):.0f}  "
+          f"fill {c['bucket_fill'] * 100:.1f}%  "
+          f"loss {float(loss.numpy()):.4f}")
+    dist_env.set_mesh(None)
+    return {"name": name, "ms": dt * 1e3,
+            "reduce_bytes": per("reduce_bytes"),
+            "gather_bytes": per("gather_bytes")}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--bucket-kb", type=int, default=16 * 1024)
+    args = ap.parse_args()
+
+    results = [run_config(name, flags, args) for name, flags in CONFIGS]
+    by = {r["name"]: r for r in results}
+    ratio = by["rs/ag-fp32"]["reduce_bytes"] / by["allreduce-fp32"]["reduce_bytes"]
+    print(f"COMM_SMOKE ratio: rs/ag reduce bytes = {ratio:.2f} of allreduce")
+
+
+if __name__ == "__main__":
+    main()
