@@ -23,6 +23,7 @@ from .recompute import recompute  # noqa: F401
 from . import elastic  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import grad_comm  # noqa: F401
+from . import tp_overlap  # noqa: F401
 from .fleet.mp_layers import split  # noqa: F401
 
 

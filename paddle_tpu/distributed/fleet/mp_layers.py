@@ -23,30 +23,40 @@ from ...dispatch import apply as _apply
 from .. import env
 
 
-def _constrain(t, spec=None, last_axis=None):
+def _constrain(t, spec=None, last_axis=None, seq_axis=None):
     """Sharding constraint inside jit when a mesh is active; no-op eagerly.
-    `last_axis='mp'` builds a rank-adaptive spec sharding the last dim."""
+    `last_axis='mp'` builds a rank-adaptive spec sharding the last dim;
+    `seq_axis='mp'` shards the second-to-last (sequence) dim — the
+    sequence-parallel activation layout."""
     mesh = env.get_mesh()
     if mesh is None:
         return t
     from ..collective import _in_spmd
 
     def f(a):
-        s = spec if last_axis is None else P(*([None] * (a.ndim - 1)), last_axis)
+        if last_axis is not None:
+            s = P(*([None] * (a.ndim - 1)), last_axis)
+        elif seq_axis is not None and a.ndim >= 2:
+            s = P(*([None] * (a.ndim - 2)), seq_axis, None)
+        else:
+            s = spec
         s = s if s is not None else P()
         # a constraint whose axes are bound manually (shard_map — e.g.
         # grad_comm's explicit dp step, or the pipeline's 'pp') is invalid
         # and meaningless: the array is already a per-device shard there.
         # Axes still in GSPMD-auto mode (partial-manual regions) keep their
-        # constraints. A replicated P() constraint only survives when some
-        # axis is still auto.
+        # constraints. A replicated P() constraint names the WHOLE mesh —
+        # including any manually-bound axis — so it only survives when no
+        # axis is manual (the jax 0.4.x partitioner aborts on a replicated
+        # constraint inside a partial-manual region: hlo_sharding_util
+        # IsManualSubgroup check).
         named = {ax for part in s for grp in
                  (part if isinstance(part, tuple) else (part,),)
                  for ax in grp if ax is not None}
         if named:
             if any(_in_spmd(ax) for ax in named):
                 return a
-        elif all(_in_spmd(ax) for ax in mesh.axis_names):
+        elif any(_in_spmd(ax) for ax in mesh.axis_names):
             return a
         return jax.lax.with_sharding_constraint(
             a, jax.sharding.NamedSharding(mesh, s))
@@ -81,6 +91,21 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        from .. import tp_overlap as _tp
+        mesh = env.get_mesh()
+        if (_tp.layer_schedule(mesh) == "explicit"
+                and _tp.layer_shapes_ok(x, self.weight, mesh, column=True)):
+            # ring-decomposed all-gather+GEMM (seq-sharded input arrives
+            # from the previous RowParallel's reduce-scatter)
+            gather = self.gather_output
+            if self.bias is not None:
+                return _apply(
+                    lambda xd, wd, bd: _tp.column_linear(xd, wd, bd, mesh,
+                                                         gather),
+                    x, self.weight, self.bias, op_name="column_mp_overlap")
+            return _apply(
+                lambda xd, wd: _tp.column_linear(xd, wd, None, mesh, gather),
+                x, self.weight, op_name="column_mp_overlap")
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
             out = _constrain(out, P())  # logically replicated output
@@ -109,9 +134,27 @@ class RowParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        from .. import tp_overlap as _tp
+        mesh = env.get_mesh()
+        mode = _tp.layer_schedule(mesh)
+        if (mode == "explicit"
+                and _tp.layer_shapes_ok(x, self.weight, mesh, column=False)):
+            # GEMM streaming partial products into a pipelined ring
+            # reduce-scatter; output lands seq-sharded for the next block
+            if self.bias is not None:
+                return _apply(
+                    lambda xd, wd, bd: _tp.row_linear(xd, wd, bd, mesh),
+                    x, self.weight, self.bias, op_name="row_mp_overlap")
+            return _apply(lambda xd, wd: _tp.row_linear(xd, wd, None, mesh),
+                          x, self.weight, op_name="row_mp_overlap")
         if self.input_is_parallel:
             x = _constrain(x, last_axis="mp")
         out = F.linear(x, self.weight, self.bias)
+        if mode == "seq" and getattr(out, "ndim", 0) >= 3:
+            # sequence parallelism under GSPMD: constraining the reduced
+            # output seq-sharded turns the partitioner's all-reduce into a
+            # reduce-scatter and keeps downstream norms/residuals at 1/mp
+            return _constrain(out, seq_axis="mp")
         return _constrain(out, P())
 
 
@@ -128,28 +171,95 @@ class VocabParallelEmbedding(Layer):
         self.weight.is_distributed = True
 
     def forward(self, x):
+        from .. import tp_overlap as _tp
         out = F.embedding(x, self.weight)
+        if (_tp.layer_schedule(env.get_mesh()) != "gspmd"
+                and getattr(out, "ndim", 0) >= 3):
+            # sequence-parallel entry: the vocab-sharded lookup's psum lands
+            # seq-sharded (a reduce-scatter) instead of replicating [B,S,H]
+            return _constrain(out, seq_axis="mp")
         return _constrain(out, P())
 
 
 class ParallelCrossEntropy(Layer):
     """Softmax-CE over a vocab-sharded logits tensor (ref mp_layers
     ParallelCrossEntropy / c_softmax_with_cross_entropy). GSPMD partitions the
-    logsumexp reduction; code is the plain formula on logical shapes."""
+    logsumexp reduction; code is the plain formula on logical shapes. The
+    `mp_group` names the mesh axis the vocab dim is sharded over (default
+    'mp') — the constraint pins the logits layout so the reduction is
+    actually partitioned instead of silently replicated."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
+        self.mp_group = mp_group
 
     def forward(self, input, label):
+        axis = _group_axis(self.mp_group)
+        mesh = env.get_mesh()
+        # only pin the layout when the mesh actually has a >1 axis of that
+        # name — a constraint naming a missing axis fails at trace time,
+        # and dp-only meshes are a supported configuration here
+        if mesh is not None and mesh.shape.get(axis, 0) > 1:
+            input = _constrain(input, last_axis=axis)
         return F.cross_entropy(input, label, reduction="none",
                                ignore_index=self.ignore_index)
 
 
+def _group_axis(group, default="mp"):
+    if group is None:
+        return default
+    if isinstance(group, str):
+        return group
+    return getattr(group, "axis_name", None) or default
+
+
 def split(x, num_or_sections, axis=0, group=None):
-    """paddle.distributed.split parity for weight splitting — TPU model keeps
-    logical tensors; returns the input annotated for sharding."""
-    return x
+    """paddle.distributed.split parity for tensor splitting across the mp
+    group — the TPU model keeps logical tensors, so a valid split request
+    returns the input annotated with the matching sharding (GSPMD
+    partitions dim `axis` over the group's mesh axis). Invalid requests
+    raise instead of being silently ignored."""
+    shape = tuple(x.shape)
+    ndim = len(shape)
+    if not isinstance(axis, int):
+        raise TypeError(f"split axis must be an int, got {type(axis).__name__}")
+    if not (-ndim <= axis < ndim):
+        raise ValueError(f"split axis {axis} out of range for rank {ndim}")
+    axis = axis % ndim
+    dim = int(shape[axis])
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if n <= 0:
+            raise ValueError(f"num_or_sections must be positive, got {n}")
+        if dim % n:
+            raise ValueError(
+                f"dim {dim} of axis {axis} not divisible into {n} sections")
+    elif isinstance(num_or_sections, (list, tuple)):
+        if not num_or_sections or sum(num_or_sections) != dim:
+            raise ValueError(
+                f"sections {list(num_or_sections)} must sum to dim {dim}")
+        if len(set(num_or_sections)) != 1:
+            raise ValueError(
+                "sharded split needs equal sections (a mesh axis partitions "
+                f"evenly), got {list(num_or_sections)}")
+        n = len(num_or_sections)
+    else:
+        raise TypeError("num_or_sections must be an int or a list/tuple, "
+                        f"got {type(num_or_sections).__name__}")
+    mesh = env.get_mesh()
+    ax_name = _group_axis(group)
+    if mesh is None or mesh.shape.get(ax_name, 1) <= 1:
+        return x  # single-chip view: validated, identity
+    if n != mesh.shape[ax_name]:
+        import warnings
+        warnings.warn(
+            f"split into {n} sections does not match mesh axis "
+            f"{ax_name!r} of size {mesh.shape[ax_name]}; returning the "
+            f"input unannotated")
+        return x
+    spec = P(*[ax_name if i == axis else None for i in range(ndim)])
+    return _constrain(x, spec)
 
 
 def mp_allreduce(x, group=None):

@@ -161,9 +161,12 @@ class BucketPlan:
                 coll += 1
         return by_dtype, coll
 
-    def gather_record(self):
+    def gather_record(self, emulated=False):
+        """Ring all-gather moves (n-1)/n of the buffer; the psum-emulated
+        gather of the mp-composed schedule (see all_gather_shards) is a
+        ring all-reduce of the full buffer — exactly twice that."""
         n = self.n
-        frac = (n - 1) / n
+        frac = (n - 1) / n * (2 if emulated else 1)
         total = sum(int(b.cols * n * jnp.dtype(b.dtype).itemsize * frac)
                     for b in self.buckets)
         return total, len(self.buckets)
@@ -239,14 +242,23 @@ def reduce_scatter_grads(plan, grads, axis, wire_dtype, denom=1):
     return shards
 
 
-def all_gather_shards(plan, shards, axis):
+def all_gather_shards(plan, shards, axis, idx=None):
     """Per-replica flat shards -> full arrays, {name: shape/dtype of plan}.
-    Bucketed: one all_gather per bucket."""
+    Bucketed: one all_gather per bucket. With `idx` given (the mp-composed
+    partial-manual region, where jax 0.4.x cannot partition `all_gather`),
+    the gather is emulated as placement-into-zeros + psum — same result,
+    2x the wire bytes of a ring all-gather (the ledger accounts for it)."""
     out = {}
     for b in plan.buckets:
         row = jnp.concatenate([shards[name] for name in b.names]) \
             if len(b.names) > 1 else shards[b.names[0]]
-        full = lax.all_gather(row, axis, tiled=False)      # (n, cols)
+        if idx is None:
+            full = lax.all_gather(row, axis, tiled=False)      # (n, cols)
+        else:
+            full = jnp.zeros((plan.n,) + row.shape, row.dtype)
+            full = lax.dynamic_update_slice_in_dim(full, row[None], idx,
+                                                   axis=0)
+            full = lax.psum(full, axis)
         for name in b.names:
             e = plan.entries[name]
             flat = full[:, e.offset:e.offset + e.cols].reshape(-1)[:e.size]
@@ -372,6 +384,10 @@ class GradCommConfig:
     wire_dtype: object            # None (native) | jnp.bfloat16 | jnp.int8
     bucket_bytes: int
     plan: BucketPlan = None
+    # mesh axes left in GSPMD-auto mode (the mp composition): the dp
+    # schedule binds only its own axis manually and the model's mp
+    # collectives/constraints keep working inside
+    auto_axes: tuple = ()
 
 
 _warned = set()
@@ -422,10 +438,21 @@ def resolve(mesh, optimizer, opt_state=None, params=None, offload=False,
     dp_like = [a for a in active if a in ("dp", "sharding")]
     if not dp_like:
         return None
-    if len(dp_like) > 1 or len(active) > 1:
+    others = [a for a in active if a not in dp_like]
+    if len(dp_like) > 1 or (others and others != ["mp"]):
         return bail(("axes", tuple(active)),
-                    f"grad_comm needs a single active dp/sharding axis, "
+                    f"grad_comm needs a single active dp/sharding axis "
+                    f"(plus at most a tensor-parallel 'mp' axis), "
                     f"mesh has {active}")
+    # mp composition: the step compiles PARTIAL-manual — only the dp axis
+    # is bound, mp stays GSPMD-auto so the model's tensor-parallel
+    # constraints/collectives keep working inside the region
+    auto_axes = ("mp",) if others else ()
+    if auto_axes and wire is not None:
+        return bail(("mp-wire", raw),
+                    f"compressed FLAGS_allreduce_dtype={raw!r} uses "
+                    f"all_to_all, which jax 0.4.x cannot partition inside "
+                    f"a partial-manual region (active mp axis)")
     if offload:
         return bail("offload", "grad_comm does not compose with host "
                     "offload of optimizer states yet")
@@ -480,7 +507,8 @@ def resolve(mesh, optimizer, opt_state=None, params=None, offload=False,
     return GradCommConfig(axis=axis, n=n,
                           weight_update_sharding=wus, wire_dtype=wire,
                           bucket_bytes=int(F.get("FLAGS_grad_bucket_bytes",
-                                                 16 * 2 ** 20)))
+                                                 16 * 2 ** 20)),
+                          auto_axes=auto_axes)
 
 
 # ---------------------------------------------------------------------------
@@ -511,21 +539,30 @@ class StepComm:
 
 
 def make_step_record(plan, wire_dtype, weight_update_sharding,
-                     with_update=True):
+                     with_update=True, emulated_gather=False):
     """Byte/collective ledger for one executed step of this plan. The
     explicit all-reduce baseline (weight_update_sharding=False) counts
     RS+grad-AG as reduce bytes (= ring all-reduce); the sharded-update
-    schedule counts RS as reduce and the param all-gather as gather."""
+    schedule counts RS as reduce and the param all-gather as gather.
+    `emulated_gather` (mp-composed partial-manual steps) doubles the
+    gather-side bytes — see all_gather_shards."""
     rec = StepComm()
     by_dtype, coll = plan.reduce_record(
         wire_dtype, two_sided=not weight_update_sharding)
+    if not weight_update_sharding and emulated_gather:
+        # the grad-AG half of the explicit all-reduce is emulated too
+        for b in plan.buckets:
+            key = str(jnp.dtype(b.dtype))
+            gb = int(b.cols * plan.n * jnp.dtype(b.dtype).itemsize
+                     * (plan.n - 1) / plan.n)
+            by_dtype[key] = by_dtype.get(key, 0) + gb
     rec.reduce_bytes_by_dtype = by_dtype
     rec.collectives = coll
     rec.buckets = len(plan.buckets)
     rec.payload_bytes = plan.payload_bytes()
     rec.padded_bytes = plan.padded_bytes()
     if weight_update_sharding and with_update:
-        gb, gcoll = plan.gather_record()
+        gb, gcoll = plan.gather_record(emulated=emulated_gather)
         rec.gather_bytes = gb
         rec.collectives += gcoll
     return rec

@@ -37,6 +37,21 @@ _FLAGS = {
     "FLAGS_allreduce_dtype": "float32",
     # Flat-buffer bucket size for grad collectives: few, large transfers.
     "FLAGS_grad_bucket_bytes": 16 * 2 ** 20,
+    # -- tensor-parallel schedule (distributed/tp_overlap.py) ---------------
+    # Sequence parallelism (Megatron-SP done the shard_map way): norms/
+    # residuals between TP blocks compute on seq-sharded activations; the
+    # two per-block all-reduces become a reduce-scatter after RowParallel
+    # and an all-gather before ColumnParallel — same wire bytes, 1/mp
+    # activation memory between blocks. Default OFF: the GSPMD schedule is
+    # untouched and the compiled program is byte-identical to the seed.
+    "FLAGS_sequence_parallel": False,
+    # Ring-decomposed compute/communication overlap on the mp axis: the
+    # pre-QKV/FFN all-gather splits into mp-1 ppermute hops with each
+    # chunk's GEMM issued on arrival, and the RowParallel GEMM emits
+    # partial products chunk-by-chunk into a pipelined reduce-scatter
+    # (T3 / fused computation-collective style). Requires
+    # FLAGS_sequence_parallel; default OFF.
+    "FLAGS_mp_overlap": False,
 }
 
 

@@ -63,7 +63,14 @@ class DataLoader:
         self.worker_mode = worker_mode
         self.worker_init_fn = worker_init_fn
         self._user_collate = collate_fn
-        self.prefetch_factor = max(prefetch_factor, 2)
+        if not isinstance(prefetch_factor, int) or prefetch_factor < 1:
+            raise ValueError(
+                f"prefetch_factor must be a positive int, got "
+                f"{prefetch_factor!r}")
+        # honored as given: prefetch_factor=1 keeps at most one assembled
+        # batch per worker in flight (memory-constrained hosts disable
+        # deeper prefetch this way; the seed silently raised it to 2)
+        self.prefetch_factor = prefetch_factor
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None

@@ -86,6 +86,9 @@ class TrainStep:
         # from flags at first call, None = default GSPMD schedule
         self._gc_cfg = None
         self._comm_records = None
+        # extra args of the compiled grad-comm step (the dp-sharded replica
+        # arange of the mp-composed partial-manual mode); empty otherwise
+        self._gc_extra = ()
 
     # -- sharding helpers ----------------------------------------------------
     def _sharding_for(self, spec):
@@ -314,17 +317,43 @@ class TrainStep:
         wire = cfg.wire_dtype
         k = self.accumulate_steps
         names = list(self._params)
+        # mp composition (cfg.auto_axes): bind ONLY the dp axis manually and
+        # leave mp to GSPMD, so the model's tensor-parallel constraints keep
+        # partitioning inside the region. jax 0.4.x cannot partition
+        # all_gather/axis_index there — all_gather_shards takes the emulated
+        # psum path, and the replica index arrives as an extra dp-sharded
+        # arange argument (a trace-time constant through psum_scatter also
+        # aborts the partitioner).
+        composed = bool(cfg.auto_axes)
+        manual = frozenset({axis}) if composed else None
+        # only the explicit-allreduce baseline's grad gather is emulated in
+        # composed mode; the sharded-update path hands its param gather to
+        # GSPMD outside the manual region (native all-gather bytes)
+        emu = composed and not wus
 
         self._comm_records = {
-            "step": _gc.make_step_record(plan, wire, wus),
-            "micro": _gc.make_step_record(plan, wire, wus, with_update=False),
-            "fire": _gc.make_step_record(plan, wire, wus),
+            "step": _gc.make_step_record(plan, wire, wus,
+                                         emulated_gather=emu),
+            "micro": _gc.make_step_record(plan, wire, wus, with_update=False,
+                                          emulated_gather=emu),
+            "fire": _gc.make_step_record(plan, wire, wus,
+                                         emulated_gather=emu),
         }
+        self._gc_extra = (jnp.arange(n, dtype=jnp.int32),) if composed \
+            else ()
 
-        def local_loss_grads(params, buffers, key, inputs, labels):
+        def replica_idx(ridx):
+            # ridx: () when fully manual, (arange-shard,) when composed
+            return ridx[0][0] if ridx else lax.axis_index(axis)
+
+        def gather_full(shards, idx):
+            return _gc.all_gather_shards(
+                plan, shards, axis, idx=idx if composed else None)
+
+        def local_loss_grads(params, buffers, key, inputs, labels, idx):
             # decorrelate per-replica dropout: the replicas see different
             # batch shards, so their masks must differ too
-            key = jax.random.fold_in(key, lax.axis_index(axis))
+            key = jax.random.fold_in(key, idx)
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_from, has_aux=True)(params, buffers, key, inputs, labels)
             return loss, new_buffers, grads
@@ -336,11 +365,14 @@ class TrainStep:
                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
                     for nm, v in bufs.items()}
 
-        def sharded_update(params, opt_state, gshards, lr):
-            """Fused optimizer update on each replica's 1/n flat shard, then
-            bucketed all-gather of the new params. Elementwise rules make
-            shard-of-update == update-of-shard bitwise."""
-            idx = lax.axis_index(axis)
+        def sharded_update(params, opt_state, gshards, lr, idx):
+            """Fused optimizer update on each replica's 1/n flat shard.
+            Elementwise rules make shard-of-update == update-of-shard
+            bitwise. Returns the updated SHARDS; the caller finishes with a
+            bucketed all-gather (in-region when fully manual) or hands the
+            packed rows to GSPMD outside the region (composed mode — the
+            jax 0.4.x partitioner miscompiles an in-region param gather
+            when jit-level params are mp-sharded)."""
             pshards = {nm: _gc.shard_of(plan, nm, params[nm], idx)
                        for nm in names}
             slots_sh = {nm: {kk: v.reshape(-1) for kk, v in sl.items()}
@@ -348,12 +380,26 @@ class TrainStep:
             new_psh, new_state = optimizer.apply_gradients(
                 pshards, gshards, {"step": opt_state["step"],
                                    "slots": slots_sh}, lr)
-            new_params = _gc.all_gather_shards(plan, new_psh, axis)
             new_opt = {"step": new_state["step"],
                        "slots": {nm: {kk: v.reshape(1, -1)
                                       for kk, v in sl.items()}
                                  for nm, sl in new_state["slots"].items()}}
-            return new_params, new_opt
+            if composed:
+                # packed (1, cols) rows; out_spec P(axis, None) reassembles
+                # the logical (n, cols) layout for the jit-level unpack
+                return {nm: new_psh[nm][None] for nm in names}, new_opt
+            return gather_full(new_psh, idx), new_opt
+
+        def unpack_params(packed):
+            """jit-level (GSPMD, outside the manual region) unpack of the
+            packed (n, cols) rows back to logical param shapes — the
+            reshape is where GSPMD inserts the native dp all-gather."""
+            out = {}
+            for nm in names:
+                e = plan.entries[nm]
+                out[nm] = packed[nm].reshape(-1)[:e.size].reshape(
+                    e.shape).astype(e.dtype)
+            return out
 
         def reduce_mean_shards(grads):
             return _gc.reduce_scatter_grads(plan, grads, axis, wire, denom=n)
@@ -362,6 +408,12 @@ class TrainStep:
         P_rep, P_packed, P_data = P(), P(axis, None), P(axis)
         p_spec = {nm: P_rep for nm in self._params}
         b_spec = {nm: P_rep for nm in self._buffers}
+        # composed mode: shard_map specs mention ONLY the manual dp axis
+        # (params are dp-replicated), while the jit-level shardings keep
+        # each param's mp dist_spec so the tensor-parallel placement
+        # survives the explicit dp schedule
+        p_jit = ({nm: (self._specs.get(nm) or P_rep) for nm in self._params}
+                 if composed else p_spec)
         if wus:
             o_spec = {"step": P_rep,
                       "slots": {nm: {kk: P_packed for kk in sl}
@@ -373,23 +425,37 @@ class TrainStep:
         to_sh = lambda spec_tree: jax.tree_util.tree_map(  # noqa: E731
             lambda s: NamedSharding(mesh, s), spec_tree,
             is_leaf=lambda x: isinstance(x, P))
+        # jit-level opt-state placement must equal what shard_params did:
+        # _opt_shardings (packed+dp-sharded under wus; slots mirroring the
+        # param dist_specs otherwise — which keeps mp-sharded slots
+        # mp-sharded in composed mode)
+        o_jit = self._opt_shardings() if composed else to_sh(o_spec)
         in_data = data_spec(self._sample_inputs)
         in_lab = data_spec(self._sample_labels)
 
+        ridx_spec = (P_data,) if composed else ()
+
+        # params leave the shard_map packed (dp-sharded rows) in composed
+        # wus mode and are unpacked at the jit level
+        p_out_spec = ({nm: P_packed for nm in self._params}
+                      if composed and wus else p_spec)
+
         if k == 1:
-            def body(params, opt_state, buffers, lr, key, inputs, labels):
+            def body(params, opt_state, buffers, lr, key, inputs, labels,
+                     *ridx):
+                idx = replica_idx(ridx)
                 loss, new_buffers, grads = local_loss_grads(
-                    params, buffers, key, inputs, labels)
+                    params, buffers, key, inputs, labels, idx)
                 gshards = reduce_mean_shards(grads)
                 if grad_clip is not None:
                     gshards = _gc.clip_shards(grad_clip, gshards, axis)
                 if wus:
                     new_params, new_opt = sharded_update(
-                        params, opt_state, gshards, lr)
+                        params, opt_state, gshards, lr, idx)
                 else:
                     # explicit all-reduce baseline: finish the reduce with a
                     # grad all-gather (ring AR = RS+AG), replicated update
-                    grads_full = _gc.all_gather_shards(plan, gshards, axis)
+                    grads_full = gather_full(gshards, idx)
                     new_params, new_opt = optimizer.apply_gradients(
                         params, grads_full, opt_state, lr)
                 return (lax.pmean(loss, axis), new_params, new_opt,
@@ -398,14 +464,23 @@ class TrainStep:
             smap = shard_map(
                 body, mesh=mesh,
                 in_specs=(p_spec, o_spec, b_spec, P_rep, P_rep, in_data,
-                          in_lab),
-                out_specs=(P_rep, p_spec, o_spec, b_spec))
+                          in_lab) + ridx_spec,
+                out_specs=(P_rep, p_out_spec, o_spec, b_spec),
+                axis_names=manual)
+            if composed and wus:
+                def stepped(*args):
+                    loss, packed, new_opt, bufs = smap(*args)
+                    return loss, unpack_params(packed), new_opt, bufs
+            else:
+                stepped = smap
             donate = (0, 1, 2) if self._effective_donate() else ()
             return jax.jit(
-                smap, donate_argnums=donate,
-                in_shardings=to_sh((p_spec, o_spec, b_spec, P_rep, P_rep,
-                                    in_data, in_lab)),
-                out_shardings=to_sh((P_rep, p_spec, o_spec, b_spec)))
+                stepped, donate_argnums=donate,
+                in_shardings=(to_sh(p_jit), o_jit, to_sh(b_spec),
+                              to_sh(P_rep), to_sh(P_rep), to_sh(in_data),
+                              to_sh(in_lab)) + to_sh(ridx_spec),
+                out_shardings=(to_sh(P_rep), to_sh(p_jit), o_jit,
+                               to_sh(b_spec)))
 
         # accumulate_steps > 1: separate micro/fire programs selected by the
         # host-side micro counter (deterministic), instead of lax.cond —
@@ -414,9 +489,10 @@ class TrainStep:
                     else {nm: P_rep for nm in self._params})
 
         def micro_body(params, opt_state, buffers, gacc, micro, lr, key,
-                       inputs, labels):
+                       inputs, labels, *ridx):
+            idx = replica_idx(ridx)
             loss, new_buffers, grads = local_loss_grads(
-                params, buffers, key, inputs, labels)
+                params, buffers, key, inputs, labels, idx)
             gshards = reduce_mean_shards(grads)
             if wus:
                 new_gacc = {nm: gacc[nm] +
@@ -424,7 +500,7 @@ class TrainStep:
                                                      ).reshape(1, -1)
                             for nm in names}
             else:
-                grads_full = _gc.all_gather_shards(plan, gshards, axis)
+                grads_full = gather_full(gshards, idx)
                 new_gacc = {nm: gacc[nm] +
                             (grads_full[nm] / k).astype(gacc[nm].dtype)
                             for nm in names}
@@ -432,9 +508,10 @@ class TrainStep:
                     sync_buffers(new_buffers), new_gacc, micro + 1)
 
         def fire_body(params, opt_state, buffers, gacc, micro, lr, key,
-                      inputs, labels):
+                      inputs, labels, *ridx):
+            idx = replica_idx(ridx)
             loss, new_buffers, grads = local_loss_grads(
-                params, buffers, key, inputs, labels)
+                params, buffers, key, inputs, labels, idx)
             gshards = reduce_mean_shards(grads)
             if wus:
                 acc = {nm: gacc[nm].reshape(-1) +
@@ -443,10 +520,10 @@ class TrainStep:
                 if grad_clip is not None:
                     acc = _gc.clip_shards(grad_clip, acc, axis)
                 new_params, new_opt = sharded_update(params, opt_state, acc,
-                                                     lr)
+                                                     lr, idx)
                 zeroed = {nm: jnp.zeros_like(gacc[nm]) for nm in names}
             else:
-                grads_full = _gc.all_gather_shards(plan, gshards, axis)
+                grads_full = gather_full(gshards, idx)
                 acc = {nm: gacc[nm] + (grads_full[nm] / k
                                        ).astype(gacc[nm].dtype)
                        for nm in names}
@@ -455,17 +532,34 @@ class TrainStep:
             return (lax.pmean(loss, axis), new_params, new_opt,
                     sync_buffers(new_buffers), zeroed, micro + 1)
 
+        acc_jit = acc_spec if wus else p_jit
         in_specs = (p_spec, o_spec, b_spec, acc_spec, P_rep, P_rep, P_rep,
-                    in_data, in_lab)
-        out_specs = (P_rep, p_spec, o_spec, b_spec, acc_spec, P_rep)
+                    in_data, in_lab) + ridx_spec
+        in_jit = (to_sh(p_jit), o_jit, to_sh(b_spec), to_sh(acc_jit),
+                  to_sh(P_rep), to_sh(P_rep), to_sh(P_rep), to_sh(in_data),
+                  to_sh(in_lab)) + to_sh(ridx_spec)
+        out_jit = (to_sh(P_rep), to_sh(p_jit), o_jit, to_sh(b_spec),
+                   to_sh(acc_jit), to_sh(P_rep))
         donate = (0, 1, 2, 3) if self._effective_donate() else ()
         jits = {}
         for tag, body in (("micro", micro_body), ("fire", fire_body)):
+            # micro steps return params untouched (replicated); only the
+            # fire step's updated params leave packed in composed wus mode
+            packs = composed and wus and tag == "fire"
+            out_specs = (P_rep, p_out_spec if packs else p_spec, o_spec,
+                         b_spec, acc_spec, P_rep)
             smap = shard_map(body, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs)
-            jits[tag] = jax.jit(smap, donate_argnums=donate,
-                                in_shardings=to_sh(in_specs),
-                                out_shardings=to_sh(out_specs))
+                             out_specs=out_specs, axis_names=manual)
+            if packs:
+                def stepped(*args, _smap=smap):
+                    loss, packed, new_opt, bufs, gacc, micro = _smap(*args)
+                    return (loss, unpack_params(packed), new_opt, bufs,
+                            gacc, micro)
+            else:
+                stepped = smap
+            jits[tag] = jax.jit(stepped, donate_argnums=donate,
+                                in_shardings=in_jit,
+                                out_shardings=out_jit)
         return jits
 
     def build_eval(self):
@@ -559,13 +653,13 @@ class TrainStep:
              self._grad_accum, self._micro) = fn(
                 self._params, self._opt_state, self._buffers,
                 self._grad_accum, self._micro, lr, next_key(),
-                in_arrays, lab_arrays)
+                in_arrays, lab_arrays, *self._gc_extra)
             self._micro_py += 1
         else:
             rec = self._comm_records["step"] if self._comm_records else None
             loss, self._params, self._opt_state, self._buffers = self._jitted(
                 self._params, self._opt_state, self._buffers, lr, next_key(),
-                in_arrays, lab_arrays)
+                in_arrays, lab_arrays, *self._gc_extra)
         if rec is not None:
             from ..distributed import grad_comm as _gc
             _gc.record_step(rec)
@@ -592,7 +686,8 @@ class TrainStep:
             args = (self._params, self._opt_state, self._buffers,
                     jnp.zeros((), jnp.float32), next_key(),
                     self._sample_inputs, self._sample_labels)
-        return jitted.lower(*args).compile().memory_analysis()
+        return jitted.lower(*args, *self._gc_extra).compile() \
+            .memory_analysis()
 
     def sync_to_model(self):
         """Write the device-resident params/buffers back into the Layer tensors."""

@@ -64,6 +64,14 @@ class GPTConfig:
     # (set by HybridTrainStep after permuting; callers passing logical-order
     # params to gpt_forward must leave it False)
     vpp_stage_major: bool = False
+    # True when qkv_w/qkv_b columns are stored head-major ([nh, 3, d] order
+    # instead of [3, nh, d]) — set by HybridTrainStep when the sequence-
+    # parallel schedule activates, so a contiguous 1/mp column shard is
+    # exactly the q/k/v projections of nh/mp heads (the [3, nh, d] layout
+    # interleaves head groups across shard boundaries). Pure storage
+    # relabeling: compute is bitwise identical, but params/checkpoints and
+    # this flag must travel together.
+    qkv_head_major: bool = False
 
 
 # headline model family (GPT-3 sizes; ref benchmark configs)
@@ -273,8 +281,13 @@ def gpt_block_fn(config: GPTConfig):
         d = H // nh
         h1 = ln(x, p["ln1_g"], p["ln1_b"])
         qkv = h1 @ p["qkv_w"].astype(x.dtype) + p["qkv_b"].astype(x.dtype)
-        q, k, v = jnp.split(qkv.reshape(B, S, 3, nh, d), 3, axis=2)
-        ctx = _attention(q[:, :, 0], k[:, :, 0], v[:, :, 0], config.use_flash,
+        if getattr(config, "qkv_head_major", False):
+            qkv4 = qkv.reshape(B, S, nh, 3, d)
+            q, k, v = qkv4[..., 0, :], qkv4[..., 1, :], qkv4[..., 2, :]
+        else:
+            q3, k3, v3 = jnp.split(qkv.reshape(B, S, 3, nh, d), 3, axis=2)
+            q, k, v = q3[:, :, 0], k3[:, :, 0], v3[:, :, 0]
+        ctx = _attention(q, k, v, config.use_flash,
                          block_q=getattr(config, "flash_block_q", 256),
                          block_k=getattr(config, "flash_block_k", 256))
         # named residual: remat_policy="save_attn" keeps ctx so the backward

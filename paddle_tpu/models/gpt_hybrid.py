@@ -115,14 +115,25 @@ def _lm_loss(logits, ids):
 
 def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
     """Pure forward to final-layernorm hidden states [B,S,H] (compute dtype).
-    Under a mesh with pp>1 uses the pipeline."""
+    Under a mesh with pp>1 uses the pipeline. With FLAGS_sequence_parallel
+    and mp>1 the layer scan runs the explicit shard_map schedule
+    (distributed/tp_overlap.py): activations between blocks are seq-sharded
+    at 1/mp size and each block's two all-reduces become RS+AG (ring-
+    decomposed ppermute hops under FLAGS_mp_overlap)."""
     compute = jnp.dtype(config.compute_dtype or "float32")
     B, S = ids.shape
     x = params["wte"].astype(compute)[ids] + \
         params["wpe"].astype(compute)[None, :S]
+    from ..distributed import tp_overlap as _tp
+    sp = _tp.resolve_gpt(config, mesh, batch=B, seq=S) \
+        if mesh is not None else None
     if mesh is not None:
-        x = jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P("dp", None, None)))
+        # seq-parallel entry: the vocab-sharded embedding's psum lands
+        # directly in the seq-sharded layout (a reduce-scatter, GSPMD-emitted
+        # from this constraint) instead of replicating [B,S,H]
+        x_spec = _tp.sp_activation_spec() if sp is not None \
+            else P("dp", None, None)
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, x_spec))
     block = gpt_block_fn(config)
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     from ..distributed.recompute import POLICIES
@@ -164,11 +175,16 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
                                                  False),
                          remat_policy=pol)
     else:
+        if sp is not None:
+            block = _tp.make_sp_block(config, mesh, sp)
         ck_block = jax.checkpoint(block, policy=POLICIES[pol_name])
 
         def scan_body(h, layer_params):
             return ck_block(layer_params, h), None
         x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    # final layernorm is elementwise over H — it runs on the seq shard when
+    # sequence parallelism is active (the head matmul's all-gather is the
+    # first point the full sequence rematerializes)
     return final_ln_fp32(x, params["lnf_g"], params["lnf_b"],
                          config.layer_norm_epsilon).astype(compute)
 
@@ -224,6 +240,24 @@ class HybridTrainStep:
             self.params["blocks"] = jax.tree_util.tree_map(
                 lambda a: a[perm], self.params["blocks"])
             self.config.vpp_stage_major = True
+        mp = self.mesh.shape.get("mp", 1) if self.mesh is not None else 1
+        from ..distributed import tp_overlap as _tp
+        if (_tp.sequence_parallel_requested() and mp > 1 and pp == 1
+                and self.config.hidden_size % mp == 0
+                and self.config.num_heads % mp == 0):
+            # head-major qkv storage so a contiguous 1/mp column shard is
+            # whole heads (see tp_overlap.qkv_head_major_perm); the config
+            # flag records the layout and makes every block-fn consumer
+            # interpret it consistently — even if resolve_gpt later falls
+            # back to GSPMD at trace time. The layout flag must travel with
+            # THIS instance's permuted params only, and callers often hand
+            # in a shared config (GPT_CONFIGS) — mutate a private copy.
+            import copy
+            self.config = copy.copy(self.config)
+            self.params["blocks"] = _tp.to_qkv_head_major(
+                self.params["blocks"], self.config.hidden_size,
+                self.config.num_heads)
+            self.config.qkv_head_major = True
         flat, self._treedef = jax.tree_util.tree_flatten_with_path(self.params)
         self._names = ["/".join(str(p) for p in path) for path, _ in flat]
         self.opt_state = self.optimizer.init_state(self._flat(self.params))
@@ -374,9 +408,27 @@ class HybridTrainStep:
         return jax.jit(step_fn, **jit_kwargs)
 
     def __call__(self, ids):
+        ids = jnp.asarray(ids)
         if self._jitted is None:
             self._jitted = self._build()
-        ids = jnp.asarray(ids)
+        # static mp-axis comm ledger of the compiled schedule
+        # (profiler.mp_comm_counters evidence), keyed per batch shape —
+        # jax.jit retraces per shape and gpt_hidden re-resolves the
+        # schedule at trace time, so the ledger must follow suit
+        recs = getattr(self, "_mp_records", None)
+        if recs is None:
+            recs = self._mp_records = {}
+        shape_key = tuple(ids.shape)
+        if shape_key not in recs:
+            from ..distributed import tp_overlap as _tp
+            B, S = ids.shape
+            sp = _tp.resolve_gpt(self.config, self.mesh, batch=B, seq=S) \
+                if self.mesh is not None else None
+            recs[shape_key] = (_tp.gpt_step_record(self.config, sp, B, S)
+                               if sp is not None else None)
+        if recs[shape_key] is not None:
+            from ..distributed import tp_overlap as _tp
+            _tp.record_step(recs[shape_key])
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         flat_params = self._flat(self.params)
         offload_out = self.offload and not self._offload_in_jit

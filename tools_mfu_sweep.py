@@ -163,10 +163,69 @@ def gpt_flash_tiles(model_name="gpt3-1.3B", batch=8, seq=2048, steps=8):
             jax.clear_caches()
 
 
+def gpt_tp_schedules(model_name="gpt3-1.3B", batch=8, seq=2048, steps=8,
+                     mp=None):
+    """Sweep the tensor-parallel schedule flags (FLAGS_sequence_parallel /
+    FLAGS_mp_overlap) on a multi-chip mp mesh — the GSPMD-vs-explicit
+    ladder of tools_tp_smoke.py at real-chip scale, reported as MFU."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.models.gpt import GPT_CONFIGS
+    from paddle_tpu.models.gpt_hybrid import HybridTrainStep
+
+    mp = mp or jax.device_count()
+    ladder = (("gspmd", {}),
+              ("seqpar", {"FLAGS_sequence_parallel": True}),
+              ("seqpar+overlap", {"FLAGS_sequence_parallel": True,
+                                  "FLAGS_mp_overlap": True}))
+    for name, flags in ladder:
+        try:
+            paddle.set_flags({"FLAGS_sequence_parallel": False,
+                              "FLAGS_mp_overlap": False})
+            paddle.set_flags(flags)
+            profiler.reset_mp_comm_counters()
+            mesh = dist_env.create_hybrid_mesh(dp=-1, mp=mp)
+            cfg = GPT_CONFIGS[model_name]
+            cfg.max_seq_len = max(cfg.max_seq_len, seq)
+            cfg.use_flash = True
+            cfg.compute_dtype = "bfloat16"
+            cfg.remat = True
+            opt = paddle.optimizer.AdamW(
+                2e-4, grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+            step = HybridTrainStep(cfg, opt, mesh=mesh,
+                                   param_dtype=jnp.bfloat16)
+            ids = jax.random.randint(jax.random.key(0), (batch, seq), 0,
+                                     cfg.vocab_size, jnp.int32)
+            loss = step(ids)
+            _sync(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = step(ids)
+            _sync(loss)
+            dt = (time.perf_counter() - t0) / steps
+            tok_s = batch * seq / dt
+            from bench import model_flops_per_token
+            fpt, _ = model_flops_per_token(cfg, seq)
+            peak = _peak() * jax.device_count()
+            print(f"TP {model_name} mp{mp} {name}: {tok_s:.0f} tok/s, "
+                  f"{dt:.3f} s/step, MFU {tok_s * fpt / peak * 100:.1f}%  "
+                  f"[{profiler.mp_comm_summary()}]", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"TP {name}: FAILED {str(e)[:160]}", flush=True)
+        finally:
+            dist_env.set_mesh(None)
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "resnet"
     if which == "flash":
         gpt_flash_tiles()
+        return
+    if which == "tp":
+        gpt_tp_schedules()
         return
     if which == "resnet":
         # big batches first: ~10-15 ms/step of the 62 ms bs128 step is RPC

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Tensor-parallel schedule microbench: GPT-mini on the 8-virtual-device CPU
+mesh (mp-dominant), one line per schedule rung.
+
+Ladder: GSPMD baseline (two blocking all-reduces per block, replicated
+activations) vs sequence parallelism (RS+AG, 1/mp activations between
+blocks) vs sequence parallelism + ring overlap (mp-1 ppermute hops per
+collective, chunk GEMMs issued on arrival) — distributed/tp_overlap.py.
+
+  python tools_tp_smoke.py [--iters N] [--warmup W] [--layers L] \
+      [--hidden H] [--heads NH] [--batch B] [--seq S] [--mp MP] [--dp DP]
+
+Prints, machine-greppable for the BENCH trajectory:
+
+  TP_SMOKE <name>: <ms>/step  mp-wire <MB>MB  collectives <n>  hops <n>  \
+      act-between-blocks <MB>MB  loss <x>
+  TP_SMOKE ratio: seq-parallel activation bytes = <x> of baseline
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+if not os.environ.get("TP_SMOKE_REAL_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+LADDER = [
+    ("gspmd-baseline", {}),
+    ("seq-parallel", {"FLAGS_sequence_parallel": True}),
+    ("seq-parallel+overlap", {"FLAGS_sequence_parallel": True,
+                              "FLAGS_mp_overlap": True}),
+]
+
+
+def run_rung(name, flags, args):
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.distributed import tp_overlap as tp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models.gpt_hybrid import HybridTrainStep
+
+    paddle.set_flags({"FLAGS_sequence_parallel": False,
+                      "FLAGS_mp_overlap": False})
+    paddle.set_flags(flags)
+    profiler.reset_mp_comm_counters()
+    mesh = dist_env.create_hybrid_mesh(dp=args.dp, mp=args.mp)
+    cfg = GPTConfig(vocab_size=512, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.seq, compute_dtype="float32",
+                    use_flash=False, remat=True, dropout=0.0)
+    opt = paddle.optimizer.AdamW(3e-4)
+    step = HybridTrainStep(cfg, opt, mesh=mesh, seed=0)
+    ids = jax.random.randint(jax.random.key(0), (args.batch, args.seq), 0,
+                             cfg.vocab_size, jnp.int32)
+    for _ in range(args.warmup):
+        loss = step(ids)
+    jax.block_until_ready(loss)
+
+    profiler.reset_mp_comm_counters()
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        loss = step(ids)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / args.iters
+
+    c = profiler.mp_comm_counters()
+    if c["steps"]:
+        per = lambda k: c[k] / c["steps"]  # noqa: E731
+        wire = per("rs_bytes") + per("ag_bytes")
+        coll, hops = per("collectives"), per("ppermute_hops")
+        act = c["activation_bytes"]
+    else:  # GSPMD baseline: static ledger of the partitioner's schedule
+        base = tp.gspmd_baseline_record(cfg, args.mp, args.batch, args.seq)
+        wire = sum(base.bytes_by_kind.values())
+        coll, hops = base.collectives, 0
+        act = base.activation_bytes
+    print(f"TP_SMOKE {name}: {dt * 1e3:.1f}ms/step  "
+          f"mp-wire {wire / 1e6:.2f}MB  collectives {coll:.0f}  "
+          f"hops {hops:.0f}  act-between-blocks {act / 1e6:.3f}MB  "
+          f"loss {float(np.asarray(jax.device_get(loss))):.4f}",
+          flush=True)
+    dist_env.set_mesh(None)
+    return {"name": name, "ms": dt * 1e3, "wire": wire, "act": act}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--mp", type=int, default=8)
+    ap.add_argument("--dp", type=int, default=1)
+    args = ap.parse_args()
+
+    results = [run_rung(name, flags, args) for name, flags in LADDER]
+    by = {r["name"]: r for r in results}
+    ratio = by["seq-parallel"]["act"] / by["gspmd-baseline"]["act"]
+    print(f"TP_SMOKE ratio: seq-parallel activation bytes = {ratio:.3f} "
+          f"of baseline (1/mp = {1 / args.mp:.3f})")
+
+
+if __name__ == "__main__":
+    main()
