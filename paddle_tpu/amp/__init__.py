@@ -75,15 +75,33 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def scale(self, var):
         if not self._enable:
             return var
+        # scaling the next iteration's loss opens a new step: re-arm the
+        # unscale_ guard here as well as in update(), so loops that call
+        # optimizer.step() directly (no scaler.step()/update()) still get
+        # their grads unscaled exactly once per iteration. NB the reference
+        # contract requires ALL scaled backwards to precede unscale_ within
+        # a step — scale() after unscale_ in the same step accumulates
+        # scaled grads onto unscaled ones and is invalid either way
+        self._unscaled = False
         return var * self._scale
 
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        if self._unscaled:
+            # already unscaled this step: a second call (user unscale_ for
+            # grad clipping followed by scaler.step, which unscales
+            # internally) must be a no-op until update()/the next scale()
+            # opens a new step — matching the reference's per-step
+            # unscaling cache; silently dividing by the scale twice
+            # corrupts every gradient
+            return
+        self._unscaled = True
         params = optimizer._parameter_list or []
         inv = 1.0 / self._scale
         # one device-side reduction over all grads, one host sync at the end
@@ -115,6 +133,7 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self):
+        self._unscaled = False  # close the step: unscale_ re-arms
         if not self._dynamic:
             return
         if self._found_inf:

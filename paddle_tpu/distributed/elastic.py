@@ -31,6 +31,28 @@ class NonFiniteError(RuntimeError):
     """Raised when a watched value contains NaN/Inf."""
 
 
+def all_finite(*trees):
+    """TRACEABLE all-finite check: one fused boolean scalar over every
+    inexact leaf of ``trees``, for use INSIDE a jitted step program.
+
+    This is the zero-host-sync counterpart of ``check_numerics``: the
+    NanGuard below costs one device->host fetch per guarded step, while the
+    compiled anomaly guard (jit.TrainStep, FLAGS_anomaly_policy) fuses this
+    reduction into the step executable and returns the flag alongside the
+    loss — the host learns about the bad step from the fetch it was already
+    doing. Non-float leaves (int tokens, counters) are skipped, matching
+    check_numerics.
+    """
+    ok = jnp.asarray(True)
+    for l in jax.tree_util.tree_leaves(trees):
+        if hasattr(l, "_data"):
+            l = l._data
+        arr = jnp.asarray(l)
+        if jnp.issubdtype(arr.dtype, jnp.inexact):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(arr)))
+    return ok
+
+
 def check_numerics(tree, name="tensors"):
     """Raise NonFiniteError if any leaf of ``tree`` has a NaN or Inf."""
     arrays = []
@@ -162,6 +184,12 @@ class ElasticAgent:
     ``train_fn`` receives the restored state pytree (or ``initial_state`` when
     no checkpoint exists) and the step to resume from; it is responsible for
     calling ``ckpt.save(step, state)`` periodically.
+
+    Preemption (``incubate.checkpoint.Preempted`` from the SIGTERM hook, or
+    ``utils.fault_injection.Preemption`` from the chaos harness) derives
+    from BaseException on purpose: it unwinds THROUGH this restart loop —
+    a preempted process must exit and be resumed by its scheduler, not
+    burn its restart budget retraining in a machine about to disappear.
     """
 
     def __init__(self, train_fn, ckpt, initial_state=None, max_restarts=3,
@@ -176,8 +204,18 @@ class ElasticAgent:
 
     def run(self):
         while True:
-            step = self.ckpt.latest_step()
-            state = self.ckpt.restore(step) if step is not None else self.initial_state
+            # restore(None) quarantines corrupt checkpoints and falls back
+            # to the previous good step (the crash may have been mid-write).
+            # Pair start_step with the step the restore ACTUALLY loaded —
+            # latest_step() may still list a newer unreadable-but-kept step
+            state = self.ckpt.restore(None)
+            if state is not None:
+                step = (self.ckpt.last_restored_step
+                        if hasattr(self.ckpt, "last_restored_step")
+                        else self.ckpt.latest_step())  # duck-typed managers
+            else:
+                step = None
+                state = self.initial_state
             start_step = 0 if step is None else int(step)
             try:
                 if self.heartbeat is not None:

@@ -45,6 +45,19 @@ _FLAGS = {
     # activation memory between blocks. Default OFF: the GSPMD schedule is
     # untouched and the compiled program is byte-identical to the seed.
     "FLAGS_sequence_parallel": False,
+    # -- fault-tolerant runtime (jit/train_step.py anomaly guard) -----------
+    # Compiled anomaly guard policy. "off" (default): the compiled step is
+    # byte-identical to the unguarded program. "skip": an all-finite check
+    # of loss+grads is fused into the step executable (shard-space psum'd
+    # under grad_comm) and a bad step's update is skipped via lax.cond —
+    # the step_ok flag rides back with the loss in ONE host fetch, no extra
+    # sync. "rollback": skip, plus after FLAGS_anomaly_max_bad_steps
+    # consecutive bad steps the attached CheckpointManager's latest
+    # checkpoint is restored and the RNG stream fast-forwarded past the
+    # poison batches.
+    "FLAGS_anomaly_policy": "off",
+    # Consecutive bad steps tolerated under "rollback" before restoring.
+    "FLAGS_anomaly_max_bad_steps": 3,
     # Ring-decomposed compute/communication overlap on the mp axis: the
     # pre-QKV/FFN all-gather splits into mp-1 ppermute hops with each
     # chunk's GEMM issued on arrival, and the RowParallel GEMM emits

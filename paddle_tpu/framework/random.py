@@ -48,6 +48,36 @@ def next_key():
     return sub
 
 
+def state_dict():
+    """Serializable snapshot of the global RNG stream (exact-resume support:
+    a checkpoint that captures this restores the *stream position*, so every
+    post-restore ``next_key()`` returns exactly the key the uninterrupted
+    run would have drawn). The transient ``fork_rng`` base is trace-local
+    state and is deliberately not captured."""
+    import numpy as np
+    return {"seed": _rng.seed,
+            "key": np.asarray(jax.random.key_data(_rng.key)),
+            "philox_counter": _rng.philox_counter}
+
+
+def set_state_dict(state):
+    """Restore a snapshot taken by ``state_dict``."""
+    import numpy as np
+    _rng.seed = int(state["seed"])
+    _rng.key = jax.random.wrap_key_data(
+        jax.numpy.asarray(np.asarray(state["key"], dtype=np.uint32)))
+    _rng.philox_counter = int(state.get("philox_counter", 0))
+
+
+def advance(n):
+    """Burn ``n`` keys from the global stream (fast-forward). Used by the
+    anomaly-rollback policy to skip past a poison batch: after restoring a
+    checkpoint's RNG state, advancing by the number of batches consumed
+    since that checkpoint realigns the stream with the data position."""
+    for _ in range(int(n)):
+        next_key()
+
+
 @contextlib.contextmanager
 def fork_rng(base_key):
     """Install a (possibly traced) base key; next_key() becomes a pure function

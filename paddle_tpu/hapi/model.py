@@ -205,33 +205,163 @@ class Model:
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            ckpt_dir=None, ckpt_freq=0, resume=False):
+        """Training loop. Fault-tolerance extensions over the reference:
+
+        - ckpt_dir/ckpt_freq: every ``ckpt_freq`` batches the COMPLETE
+          training state (weights, optimizer slots, RNG stream, epoch/batch
+          position) goes to a hardened ``incubate.checkpoint
+          .CheckpointManager`` under ``ckpt_dir``; while fitting, a SIGTERM
+          preemption hook flushes a final blocking save before the process
+          dies.
+        - resume=True: restore the latest good checkpoint from ``ckpt_dir``
+          and continue mid-epoch — on the compiled TrainStep path the
+          resumed run reproduces the uninterrupted parameter trajectory
+          bitwise (RNG stream, in-epoch shuffle order, and batch position
+          are all part of the state).
+        """
+        from ..framework import random as _rnd
         loader = self._loader(train_data, batch_size, shuffle)
+        mgr = None
+        resume_epoch, resume_batch, resume_rng = 0, 0, None
+        if ckpt_dir is not None:
+            from ..incubate.checkpoint import CheckpointManager
+            mgr = CheckpointManager(ckpt_dir, async_save=False)
+            if resume:
+                if not isinstance(loader, DataLoader):
+                    raise ValueError(
+                        "fit(resume=True) needs train_data to be a Dataset "
+                        "or DataLoader (position tracking)")
+                st = mgr.restore(None)
+                if st is not None:
+                    resume_epoch = int(st["epoch"])
+                    resume_batch = int(st["batch"])
+                    resume_rng = st["rng"]
+                    self._load_fit_state(st)
+                    try:
+                        epoch_len = len(loader)
+                    except TypeError:
+                        epoch_len = None
+                    if epoch_len is not None and resume_batch >= epoch_len:
+                        # saved at the last batch of an epoch: roll to the
+                        # next epoch instead of replaying this one empty
+                        # (which would re-fire on_epoch_end with no logs
+                        # and re-run eval); the stream is already at its
+                        # end-of-epoch position
+                        resume_epoch += 1
+                        resume_batch = 0
+                        _rnd.set_state_dict(st["rng"])
+                    else:
+                        # replay the epoch's shuffle from its recorded
+                        # start: the iterator below redraws the same
+                        # permutation, the skip consumes indices only, and
+                        # resume_rng then realigns the stream to the batch
+                        # position
+                        _rnd.set_state_dict(st["rng_epoch_start"])
         cbks = config_callbacks(callbacks, self, epochs=epochs, verbose=verbose,
                                 log_freq=log_freq, save_freq=save_freq,
                                 save_dir=save_dir, metrics=self._metrics)
         self.stop_training = False
         cbks.call("on_train_begin")
         logs = {}
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            for m in self._metrics:
-                m.reset()
-            cbks.call("on_epoch_begin", epoch)
-            logs = {}
-            for step, batch in enumerate(loader):
-                batch = _to_list(batch)
-                ins, labs = batch[:-1] or batch, batch[-1:]
-                cbks.call("on_train_batch_begin", step)
-                losses, metrics = self.train_batch(ins, labs)
-                logs = {"loss": losses[0] if losses else None, **metrics}
-                cbks.call("on_train_batch_end", step, logs)
-            cbks.call("on_epoch_end", epoch, logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=0,
-                              callbacks=cbks.callbacks, _nested=True)
+        pos = {"epoch": resume_epoch, "batch": resume_batch,
+               "rng_epoch_start": None}
+        if mgr is not None:
+            # deferred: the handler only marks preempted; the loop flushes
+            # at the next batch boundary, where weights/RNG/position are a
+            # consistent snapshot (mid-step the donated params are dead)
+            mgr.install_preemption_hook(lambda: self._fit_state(**pos),
+                                        defer=True)
+        try:
+            global_batch = 0
+            # monotonic save tags across resumes: never publish a step id
+            # below one already on disk (rename-aside makes an overwrite
+            # safe, but a resumed run must not shadow a newer checkpoint)
+            step_base = (mgr.latest_step() or 0) if mgr is not None else 0
+            for epoch in range(resume_epoch, epochs):
+                if self.stop_training:
+                    break
+                for m in self._metrics:
+                    m.reset()
+                cbks.call("on_epoch_begin", epoch)
+                logs = {}
+                pos["epoch"], pos["batch"] = epoch, resume_batch
+                pos["rng_epoch_start"] = _rnd.state_dict()
+                if resume_batch and isinstance(loader, DataLoader):
+                    loader.load_state_dict({"batches_served": resume_batch})
+                it = iter(loader)
+                step = resume_batch
+                if resume_batch:
+                    # the first next() above-skip draws the epoch
+                    # permutation from the pre-epoch RNG; after that the
+                    # saved stream position takes over so every subsequent
+                    # key matches the uninterrupted run
+                    batch = next(it, None)
+                    _rnd.set_state_dict(resume_rng)
+                    resume_batch, resume_rng = 0, None
+                else:
+                    batch = next(it, None)
+                while batch is not None:
+                    batch = _to_list(batch)
+                    ins, labs = batch[:-1] or batch, batch[-1:]
+                    cbks.call("on_train_batch_begin", step)
+                    losses, metrics = self.train_batch(ins, labs)
+                    logs = {"loss": losses[0] if losses else None, **metrics}
+                    cbks.call("on_train_batch_end", step, logs)
+                    step += 1
+                    global_batch += 1
+                    pos["batch"] = step
+                    if mgr is not None and mgr.preempted:
+                        mgr.flush_preempted(self._fit_state(**pos),
+                                            step=step_base + global_batch)
+                    if mgr is not None and ckpt_freq and \
+                            global_batch % ckpt_freq == 0:
+                        mgr.save(step_base + global_batch,
+                                 self._fit_state(**pos))
+                    batch = next(it, None)
+                cbks.call("on_epoch_end", epoch, logs)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_data, batch_size=batch_size, verbose=0,
+                                  callbacks=cbks.callbacks, _nested=True)
+        finally:
+            if mgr is not None:
+                mgr.remove_preemption_hook()
         cbks.call("on_train_end", logs)
+
+    # -- fault-tolerant fit state -------------------------------------------
+    def _fit_state(self, epoch, batch, rng_epoch_start):
+        """Complete fit-loop state: model/optimizer (TrainStep.state_dict on
+        the compiled path), position, and the two RNG anchors the resume
+        protocol needs (stream at epoch start for the shuffle replay, and
+        current stream for everything after the skip)."""
+        from ..framework import random as _rnd
+        state = {"epoch": int(epoch), "batch": int(batch),
+                 "rng_epoch_start": rng_epoch_start or _rnd.state_dict(),
+                 "rng": _rnd.state_dict()}
+        if self._train_step is not None:
+            state["kind"] = "train_step"
+            state["ts"] = self._train_step.state_dict()
+        else:
+            state["kind"] = "eager"
+            state["net"] = self.network.state_dict()
+            if self._optimizer is not None:
+                state["opt"] = getattr(self._optimizer, "state_dict",
+                                       dict)()
+            if self._scaler is not None:
+                state["scaler"] = self._scaler.state_dict()
+        return state
+
+    def _load_fit_state(self, state):
+        if state.get("kind") == "train_step" and self._train_step is not None:
+            self._train_step.load_state_dict(state["ts"])
+        else:
+            self.network.set_state_dict(state["net"])
+            if "opt" in state and self._optimizer is not None and \
+                    hasattr(self._optimizer, "set_state_dict"):
+                self._optimizer.set_state_dict(state["opt"])
+            if "scaler" in state and self._scaler is not None:
+                self._scaler.load_state_dict(dict(state["scaler"]))
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, _nested=False):

@@ -10,41 +10,135 @@ TPU-first design notes:
     ``os.rename``) so a crash mid-write can never produce a half checkpoint
     that ``latest_step`` would pick up
   * retention: ``keep_last_n`` prunes old steps after each successful save
+
+Fault hardening (the preemption/corruption story):
+  * every array leaf gets a CRC32 recorded in a per-step ``manifest.json``;
+    ``restore`` re-hashes and refuses a checkpoint whose bytes rotted
+  * a corrupt or unreadable step is QUARANTINED (renamed ``*.corrupt``) and
+    ``restore()`` falls back to the previous step automatically
+  * transient ``OSError`` during the write retries with exponential backoff
+    (``retries`` / ``retry_backoff``) before surfacing
+  * replacing an existing step dir renames the published copy ASIDE before
+    the atomic publish and only then deletes it — there is no window in
+    which the only good copy has been ``rmtree``'d (the seed deleted the
+    published dir before renaming the new one in); ``_recover`` re-adopts
+    an aside/tmp copy left by a crash inside the swap
+  * ``install_preemption_hook`` registers a SIGTERM handler that flushes a
+    blocking save of the latest training state before the process dies
 """
 from __future__ import annotations
 
+import json
 import os
 import shutil
+import signal as _signal
 import threading
+import time
+import zlib
 
 import jax
 import numpy as np
 
 from ..framework import io as fio
 from ..tensor_impl import Tensor
+from ..utils import fault_injection as _fi
 
 _STEP_PREFIX = "step_"
+_MANIFEST = "manifest.json"
+_STATE_FILE = "state.pdckpt"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed its manifest/CRC verification (or is unreadable)."""
+
+
+class Preempted(BaseException):
+    """Raised (in the main thread) by the SIGTERM preemption hook after the
+    blocking flush save completes. BaseException so generic ``except
+    Exception`` retry loops don't eat the shutdown."""
+
+
+# -- counters (profiler.fault_counters surface) ------------------------------
+_counters_lock = threading.Lock()
+_counters = {"saves": 0, "save_retries": 0, "quarantined": 0,
+             "restore_fallbacks": 0, "preempt_saves": 0}
+
+
+def ckpt_counters():
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_ckpt_counters():
+    with _counters_lock:
+        for k in _counters:
+            _counters[k] = 0
+
+
+def _count(key, n=1):
+    with _counters_lock:
+        _counters[key] += n
+
+
+def _tree_checksums(snap):
+    """{tree-path: {crc32, dtype, shape, nbytes}} over the array leaves."""
+    out = {}
+    leaves = jax.tree_util.tree_leaves_with_path(snap)
+    for path, leaf in leaves:
+        if hasattr(leaf, "_data"):
+            leaf = leaf._data
+        if not hasattr(leaf, "dtype"):
+            continue
+        # snap leaves are already host numpy (save()'s _snap); asarray and
+        # ascontiguousarray are no-op views for the common case, and crc32
+        # consumes a uint8 view directly — no .tobytes() copy of the whole
+        # state per save (0-d scalars can't be viewed; their copy is 8B)
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        key = jax.tree_util.keystr(path)
+        buf = arr.view(np.uint8).reshape(-1) if arr.ndim else arr.tobytes()
+        out[key] = {"crc32": zlib.crc32(buf) & 0xFFFFFFFF,
+                    "dtype": str(arr.dtype), "shape": list(arr.shape),
+                    "nbytes": int(arr.nbytes)}
+    return out
 
 
 class CheckpointManager:
-    def __init__(self, directory, keep_last_n=3, async_save=True):
+    def __init__(self, directory, keep_last_n=3, async_save=True,
+                 retries=3, retry_backoff=0.05, verify=True):
         self.directory = os.fspath(directory)
         self.keep_last_n = int(keep_last_n)
         self.async_save = bool(async_save)
+        self.retries = max(int(retries), 0)
+        self.retry_backoff = float(retry_backoff)
+        self.verify = bool(verify)
         os.makedirs(self.directory, exist_ok=True)
         self._thread = None
         self._error = None
         self._lock = threading.Lock()
+        self._prev_sig = None
+        self.preempted = False
+        # step id the last successful restore() actually loaded — may be
+        # older than latest_step() after a fallback past an unreadable
+        # (not quarantined) step; resume logic must pair state with THIS
+        self.last_restored_step = None
+        self._recover()
 
     # -- querying ----------------------------------------------------------
     def all_steps(self):
+        try:
+            names = os.listdir(self.directory)
+        except OSError:  # directory swept away concurrently
+            return []
         steps = []
-        for name in os.listdir(self.directory):
-            if name.startswith(_STEP_PREFIX) and not name.endswith(".tmp"):
-                try:
-                    steps.append(int(name[len(_STEP_PREFIX):]))
-                except ValueError:
-                    pass
+        for name in names:
+            if not name.startswith(_STEP_PREFIX):
+                continue
+            if name.endswith((".tmp", ".old", ".corrupt")):
+                continue
+            try:
+                steps.append(int(name[len(_STEP_PREFIX):]))
+            except ValueError:
+                pass
         return sorted(steps)
 
     def latest_step(self):
@@ -53,6 +147,40 @@ class CheckpointManager:
 
     def _step_dir(self, step):
         return os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
+
+    def _recover(self):
+        """Adopt the survivors of a crash inside ``_write``'s publish swap:
+        a ``step_N.old`` without a ``step_N`` means the crash hit between
+        rename-aside and publish — re-adopt the complete ``.tmp`` if the
+        new bytes finished, else put the old published copy back."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith(_STEP_PREFIX) and name.endswith(".old")):
+                continue
+            final = os.path.join(self.directory, name[:-len(".old")])
+            aside = os.path.join(self.directory, name)
+            if os.path.exists(final):
+                shutil.rmtree(aside, ignore_errors=True)  # swap completed
+                continue
+            tmp = final + ".tmp"
+            # the manifest is written AFTER the state file, so its presence
+            # is the completeness marker — a torn state.pdckpt alone must
+            # not displace the good aside copy
+            if os.path.exists(os.path.join(tmp, _STATE_FILE)) and \
+                    os.path.exists(os.path.join(tmp, _MANIFEST)):
+                try:  # new copy was fully written: finish the publish
+                    os.rename(tmp, final)
+                    shutil.rmtree(aside, ignore_errors=True)
+                    continue
+                except OSError:
+                    pass
+            try:  # otherwise roll the old published copy back in
+                os.rename(aside, final)
+            except OSError:
+                pass
 
     # -- saving ------------------------------------------------------------
     def save(self, step, state, blocking=None):
@@ -91,21 +219,57 @@ class CheckpointManager:
             with self._lock:
                 self._error = e
 
+    def _retrying(self, fn, on_retry=None):
+        """Run an IO op, retrying transient OSError with exponential
+        backoff (retries/retry_backoff) — the one retry policy shared by
+        the write and read sides. Non-OSError propagates immediately."""
+        delay = self.retry_backoff
+        for attempt in range(self.retries + 1):
+            try:
+                return fn()
+            except OSError:
+                if attempt == self.retries:
+                    raise
+                if on_retry is not None:
+                    on_retry()
+                time.sleep(delay)
+                delay *= 2
+
     def _write(self, step, snap):
+        self._retrying(lambda: self._write_once(step, snap),
+                       on_retry=lambda: _count("save_retries"))
+        _count("saves")
+
+    def _write_once(self, step, snap):
+        _fi.maybe_fail_write("ckpt_write")
         final = self._step_dir(step)
         tmp = final + ".tmp"
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
-        fio.save(snap, os.path.join(tmp, "state.pdckpt"))
+        fio.save(snap, os.path.join(tmp, _STATE_FILE))
+        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+            json.dump({"step": int(step), "arrays": _tree_checksums(snap)}, f)
         if os.path.exists(final):
-            shutil.rmtree(final)
-        os.rename(tmp, final)  # atomic publish
+            # never delete the only published copy before the replacement is
+            # live: rename it aside, publish, THEN drop it (the seed did
+            # rmtree(final) before rename(tmp, final) — a crash in between
+            # lost the step entirely). _recover() heals a crash mid-swap.
+            aside = final + ".old"
+            if os.path.exists(aside):
+                shutil.rmtree(aside)
+            os.rename(final, aside)
+            os.rename(tmp, final)  # atomic publish
+            shutil.rmtree(aside, ignore_errors=True)
+        else:
+            os.rename(tmp, final)  # atomic publish
         self._prune()
 
     def _prune(self):
         steps = self.all_steps()
         for s in steps[: max(0, len(steps) - self.keep_last_n)]:
+            # ignore_errors: another rank/process may prune the same step
+            # concurrently; losing the race is success
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     def wait(self):
@@ -120,11 +284,146 @@ class CheckpointManager:
                 raise e
 
     # -- restoring ---------------------------------------------------------
+    def _read_retrying(self, fn):
+        """Reads retry transient OSError with the same backoff as writes
+        (NFS ESTALE/EINTR must not condemn good bytes). OSError after
+        exhausted retries propagates AS OSError — only decode/CRC failures
+        mean corruption."""
+        return self._retrying(fn)
+
+    def _verify_step(self, step):
+        """Load + CRC-verify one step. Raises CheckpointCorruptError for
+        rotten bytes; transient read failures surface as OSError."""
+        d = self._step_dir(step)
+        path = os.path.join(d, _STATE_FILE)
+        try:
+            state = self._read_retrying(lambda: fio.load(path))
+        except OSError:
+            raise
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} unreadable: {e}") from e
+        manifest_path = os.path.join(d, _MANIFEST)
+        if self.verify and os.path.exists(manifest_path):
+            def read_manifest():
+                with open(manifest_path) as f:
+                    return json.load(f)
+            try:
+                manifest = self._read_retrying(read_manifest)
+            except OSError:
+                raise
+            except ValueError as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} manifest unreadable: {e}") from e
+            actual = _tree_checksums(state)
+            for key, rec in manifest.get("arrays", {}).items():
+                got = actual.get(key)
+                if got is None or got["crc32"] != rec["crc32"]:
+                    raise CheckpointCorruptError(
+                        f"checkpoint step {step}: array {key} failed CRC "
+                        f"verification (manifest {rec['crc32']}, got "
+                        f"{got['crc32'] if got else 'missing'})")
+        return state
+
+    def _quarantine(self, step):
+        """Rename a corrupt step dir to ``*.corrupt`` so all_steps/restore
+        never pick it again (kept on disk for postmortem, not rmtree'd)."""
+        d = self._step_dir(step)
+        target = f"{d}.corrupt"
+        try:
+            if os.path.exists(target):
+                shutil.rmtree(target, ignore_errors=True)
+            os.rename(d, target)
+            _count("quarantined")
+        except OSError:
+            pass
+
     def restore(self, step=None):
-        """Load the checkpoint at ``step`` (default: latest). None if empty."""
-        if step is None:
-            step = self.latest_step()
+        """Load the checkpoint at ``step`` (default: latest). None if empty.
+
+        With ``step=None``, a corrupt latest checkpoint is quarantined and
+        the previous step is tried — training resumes from the newest GOOD
+        state instead of dying on rotten bytes. A step that fails to READ
+        (persistent OSError after the retry budget) is skipped but NOT
+        quarantined: its bytes may be fine once the filesystem recovers.
+        An explicitly requested ``step`` raises ``CheckpointCorruptError``
+        (after quarantining) or the underlying ``OSError``."""
+        if step is not None:
+            try:
+                state = self._verify_step(step)
+                self.last_restored_step = int(step)
+                return state
+            except CheckpointCorruptError:
+                self._quarantine(step)
+                raise
+        tried = set()
+        while True:
+            step = max((s for s in self.all_steps() if s not in tried),
+                       default=None)
             if step is None:
+                self.last_restored_step = None
                 return None
-        path = os.path.join(self._step_dir(step), "state.pdckpt")
-        return fio.load(path)
+            try:
+                state = self._verify_step(step)
+                self.last_restored_step = int(step)
+                return state
+            except CheckpointCorruptError:
+                tried.add(step)
+                self._quarantine(step)
+                _count("restore_fallbacks")
+            except OSError:
+                tried.add(step)  # unreadable now != corrupt: keep on disk
+                _count("restore_fallbacks")
+
+    # -- preemption --------------------------------------------------------
+    def install_preemption_hook(self, state_fn, step_fn=None,
+                                signals=(_signal.SIGTERM,), defer=False):
+        """On SIGTERM (the preemption notice on TPU pods), flush a BLOCKING
+        save of ``state_fn()`` at step ``step_fn()`` (default: latest+1),
+        then raise ``Preempted`` in the main thread so the training loop
+        unwinds cleanly. Returns self; undo with ``remove_preemption_hook``.
+
+        ``defer=True`` only marks ``self.preempted`` in the handler; the
+        training loop must poll it at a step boundary and call
+        ``flush_preempted(state)``. Use this inside loops over donated
+        compiled steps — the immediate handler runs between arbitrary
+        bytecodes, where a state_fn snapshot can catch weights mid-rebind
+        (deleted donated buffers) or weights/position from different steps.
+        """
+        def handler(signum, frame):
+            self.preempted = True
+            if defer:
+                return  # loop flushes at the next step boundary
+            try:
+                self.wait()
+            except Exception:
+                pass  # a failed async save must not block the flush
+            step = int(step_fn()) if step_fn is not None else \
+                (self.latest_step() or 0) + 1
+            self.save(step, state_fn(), blocking=True)
+            _count("preempt_saves")
+            raise Preempted(f"preempted (signal {signum}); "
+                            f"state flushed at step {step}")
+
+        self._prev_sig = [(s, _signal.getsignal(s)) for s in signals]
+        for s in signals:
+            _signal.signal(s, handler)
+        return self
+
+    def flush_preempted(self, state, step=None):
+        """Deferred-mode companion: blocking save of ``state`` (taken by
+        the loop at a consistent step boundary), then raise ``Preempted``."""
+        try:
+            self.wait()
+        except Exception:
+            pass
+        if step is None:
+            step = (self.latest_step() or 0) + 1
+        self.save(int(step), state, blocking=True)
+        _count("preempt_saves")
+        raise Preempted(f"preempted; state flushed at step {step}")
+
+    def remove_preemption_hook(self):
+        for s, prev in (self._prev_sig or []):
+            _signal.signal(s, prev)
+        self._prev_sig = None

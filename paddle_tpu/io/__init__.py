@@ -63,6 +63,15 @@ class DataLoader:
         self.worker_mode = worker_mode
         self.worker_init_fn = worker_init_fn
         self._user_collate = collate_fn
+        # honored: a stuck worker (deadlocked transform, dead NFS mount)
+        # raises after `timeout` seconds instead of hanging the step loop
+        # forever. 0 keeps the reference default of waiting indefinitely.
+        self.timeout = float(timeout or 0)
+        if self.timeout < 0:
+            raise ValueError(f"timeout must be >= 0, got {timeout!r}")
+        # exact-resume support: batches served this epoch / skip request
+        self._served = 0
+        self._resume_skip = 0
         if not isinstance(prefetch_factor, int) or prefetch_factor < 1:
             raise ValueError(
                 f"prefetch_factor must be a positive int, got "
@@ -98,35 +107,58 @@ class DataLoader:
         samples = [self.dataset[i] for i in indices]
         return self.collate_fn(samples)
 
-    def _iter_batches(self):
+    # -- resumable position (exact mid-epoch resume) -----------------------
+    def state_dict(self):
+        """Position within the current epoch: how many batches this loader
+        has yielded. Checkpoint it next to the model/optimizer state; on
+        restore, ``load_state_dict`` makes the NEXT ``__iter__`` skip that
+        many batches — for the map-style/batch_sampler path the skip
+        consumes only sampler indices (no data is fetched), so resuming
+        deep into an epoch is cheap."""
+        return {"batches_served": self._served}
+
+    def load_state_dict(self, state):
+        self._resume_skip = int(state.get("batches_served", 0))
+
+    def _iter_batches(self, skip=0):
         if self._iterable:
             it = iter(self.dataset)
-            while True:
+            # iterable datasets have no index stream to skip over: resume
+            # consumes (and drops) the already-served batches
+            for _ in range(skip + 1):
                 chunk = list(itertools.islice(it, self.batch_size))
-                if not chunk:
+                if not chunk or (len(chunk) < self.batch_size
+                                 and self.drop_last):
                     return
+            while chunk:
+                yield self.collate_fn(chunk)
+                chunk = list(itertools.islice(it, self.batch_size))
                 if len(chunk) < self.batch_size and self.drop_last:
                     return
-                yield self.collate_fn(chunk)
         elif self.batch_sampler is None:
-            for i in range(len(self.dataset)):  # batch_size=None: no batching
+            for i in range(skip, len(self.dataset)):  # batch_size=None
                 yield self.dataset[i]
         else:
-            for indices in self.batch_sampler:
+            # skip consumes only sampler indices — no data is fetched for
+            # the already-served prefix, so deep mid-epoch resume is cheap
+            for indices in itertools.islice(self.batch_sampler, skip, None):
                 yield self._fetch(indices)
 
     def __iter__(self):
+        skip, self._resume_skip = self._resume_skip, 0
+        self._served = skip
         if self.num_workers <= 0:
-            yield from self._iter_batches()
-            return
-        if self.worker_mode == "process":
-            yield from self._iter_process()
-            return
-        # threaded prefetch: producer assembles batches ahead of the consumer
-        yield from self._iter_threads()
+            src = self._iter_batches(skip)
+        elif self.worker_mode == "process":
+            src = self._iter_process(skip)
+        else:  # threaded prefetch: producer assembles batches ahead
+            src = self._iter_threads(skip)
+        for b in src:
+            self._served += 1
+            yield b
 
 
-    def _iter_process(self):
+    def _iter_process(self, skip=0):
         """Multiprocess fetch (ref: dataloader_iter.py:439): workers collate
         at the numpy level; the parent re-wraps leaves as Tensors."""
         from .process_workers import ProcessPool, np_collate
@@ -135,7 +167,7 @@ class DataLoader:
             warnings.warn(
                 "worker_mode='process' supports map-style batched datasets; "
                 "falling back to threads for this dataset")
-            yield from self._iter_threads()
+            yield from self._iter_threads(skip)
             return
         # the explicit-default case routes to the numpy collate: Tensor
         # construction must not happen in a forked child (device handles
@@ -147,14 +179,16 @@ class DataLoader:
         worker_collate = user or np_collate
         pool = ProcessPool(self.dataset, worker_collate, self.num_workers,
                            prefetch_factor=self.prefetch_factor,
-                           worker_init_fn=self.worker_init_fn)
+                           worker_init_fn=self.worker_init_fn,
+                           timeout=self.timeout)
         try:
-            for batch in pool.run(self.batch_sampler):
+            batches = itertools.islice(self.batch_sampler, skip, None)
+            for batch in pool.run(batches):
                 yield _wrap_np(batch)
         finally:
             pool.shutdown()
 
-    def _iter_threads(self):
+    def _iter_threads(self, skip=0):
         q: queue.Queue = queue.Queue(
             maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
@@ -162,7 +196,7 @@ class DataLoader:
 
         def producer():
             try:
-                for b in self._iter_batches():
+                for b in self._iter_batches(skip):
                     q.put(b)
             except Exception as e:  # propagate to consumer
                 err.append(e)
@@ -172,7 +206,16 @@ class DataLoader:
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         while True:
-            item = q.get()
+            try:
+                item = q.get(timeout=self.timeout or None)
+            except queue.Empty:
+                # the producer thread is wedged (deadlocked __getitem__ /
+                # transform, hung filesystem): fail loudly instead of
+                # blocking the step loop forever
+                raise RuntimeError(
+                    f"DataLoader worker produced no batch within "
+                    f"timeout={self.timeout}s — stuck dataset/transform "
+                    f"code (worker thread alive: {t.is_alive()})")
             if item is sentinel:
                 if err:
                     raise err[0]

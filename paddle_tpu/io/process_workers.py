@@ -101,10 +101,13 @@ class ProcessPool:
     """Order-preserving multiprocess fetch pool over a map-style dataset."""
 
     def __init__(self, dataset, collate_fn, num_workers, prefetch_factor=2,
-                 worker_init_fn=None, base_seed=None):
+                 worker_init_fn=None, base_seed=None, timeout=0):
         ctx = multiprocessing.get_context("fork")
         self.num_workers = num_workers
         self.prefetch = max(prefetch_factor, 1)
+        # user-facing stuck-worker bound (DataLoader timeout=): 0 waits
+        # forever (dead-worker detection still applies via the 5s poll)
+        self.timeout = float(timeout or 0)
         if base_seed is None:
             # fresh randomness per pool (per epoch): augmentation must not
             # replay byte-identical across epochs
@@ -146,10 +149,14 @@ class ProcessPool:
         for _ in range(self.num_workers * self.prefetch):
             if not dispatch_one():
                 break
+        import time as _time
         import queue as _queue
+        t_last = _time.monotonic()
         while outstanding:
+            poll = 5.0 if not self.timeout else min(5.0, self.timeout)
             try:
-                seq, status, payload = self._result_queue.get(timeout=5.0)
+                seq, status, payload = self._result_queue.get(timeout=poll)
+                t_last = _time.monotonic()
             except _queue.Empty:
                 dead = [p for p in self._workers if not p.is_alive()]
                 if dead:
@@ -158,6 +165,12 @@ class ProcessPool:
                         f"DataLoader worker(s) died without a result "
                         f"(exitcodes {[p.exitcode for p in dead]}) — "
                         f"OOM-kill or crash in the dataset/transform code")
+                if self.timeout and _time.monotonic() - t_last > self.timeout:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker produced no batch within "
+                        f"timeout={self.timeout}s — stuck dataset/"
+                        f"transform code in a live worker process")
                 continue
             outstanding -= 1
             if status == "error":

@@ -40,6 +40,30 @@ from ..framework.random import next_key
 from .functional import capture_params, capture_buffers, param_specs, functional_call
 
 
+# -- anomaly-guard counters (profiler.fault_counters surface) ----------------
+# The compiled guard's host cost model is auditable from here: `host_syncs`
+# counts ONE combined (loss, step_ok...) fetch per UPDATE step — the loss
+# fetch the caller was doing anyway. With accumulate_steps>1 the micro-steps'
+# flags stay device-resident and ride to the fire boundary in the same single
+# fetch (the async micro-dispatch overlap is untouched), so host_syncs equals
+# the number of fire steps, steps/accumulate_steps. Anything above that ratio
+# means a sync snuck in. `skipped_updates` counts updates that were actually
+# due and skipped (k==1 bad steps); under accumulation a poisoned micro only
+# drops its contribution and the boundary update still runs, so only
+# `bad_steps` moves.
+_anomaly_counters = {"steps": 0, "host_syncs": 0, "bad_steps": 0,
+                     "skipped_updates": 0, "rollbacks": 0}
+
+
+def anomaly_counters():
+    return dict(_anomaly_counters)
+
+
+def reset_anomaly_counters():
+    for k in _anomaly_counters:
+        _anomaly_counters[k] = 0
+
+
 class TrainStep:
     def __init__(self, model, loss_fn, optimizer, mesh=None, donate=True,
                  remat=False, batch_spec=None, loss_has_model_kw=False,
@@ -89,6 +113,24 @@ class TrainStep:
         # extra args of the compiled grad-comm step (the dp-sharded replica
         # arange of the mp-composed partial-manual mode); empty otherwise
         self._gc_extra = ()
+        # compiled anomaly guard (FLAGS_anomaly_policy, resolved at first
+        # call): None = unguarded program (byte-identical to the seed), or
+        # ("skip"|"rollback", K). The policy layer below consumes the
+        # step_ok flag that rides back with the loss.
+        self._anomaly = None
+        self._bad_streak = 0
+        self.last_step_ok = True
+        # device-resident step_ok flags of the current accumulation window,
+        # fetched together with the fire step's loss (no per-micro syncs)
+        self._pending_ok = []
+        # fault-tolerance attachments: checkpoint manager (rollback source +
+        # periodic auto-save), data loader / grad scaler whose state rides
+        # along in state_dict() for exact resume
+        self._ckpt_mgr = None
+        self._ckpt_every = 0
+        self._attached_loader = None
+        self._attached_scaler = None
+        self._on_rollback = None
 
     # -- sharding helpers ----------------------------------------------------
     def _sharding_for(self, spec):
@@ -224,21 +266,56 @@ class TrainStep:
         if self._gc_cfg is not None:
             return self._build_grad_comm(loss_from, apply_update)
 
+        # compiled anomaly guard: an all-finite reduction over loss+grads is
+        # fused into the executable and the update is gated on it with
+        # lax.cond — a NaN/Inf step leaves params, slots, and buffers
+        # untouched, and the host learns from the step_ok flag riding back
+        # with the loss (no extra sync). Guard off: programs identical to
+        # the seed.
+        guard = self._anomaly is not None
+        from ..distributed.elastic import all_finite
+
         def step_fn(params, opt_state, buffers, lr, key, inputs, labels):
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_from, has_aux=True)(params, buffers, key, inputs, labels)
-            new_params, new_opt = apply_update(params, grads,
-                                               fetch_opt(opt_state), lr)
-            return loss, new_params, stash_opt(new_opt), new_buffers
+            opt_in = fetch_opt(opt_state)
+            if not guard:
+                new_params, new_opt = apply_update(params, grads, opt_in, lr)
+                return loss, new_params, stash_opt(new_opt), new_buffers
+            ok = all_finite(loss, grads)
+
+            def do(_):
+                new_p, new_o = apply_update(params, grads, opt_in, lr)
+                return new_p, new_o, new_buffers
+
+            def skip(_):
+                return params, opt_in, buffers
+
+            new_params, new_opt, out_buffers = lax.cond(ok, do, skip, None)
+            return loss, ok, new_params, stash_opt(new_opt), out_buffers
 
         def accum_step_fn(params, opt_state, buffers, gacc, micro, lr, key,
                           inputs, labels):
             opt_state = fetch_opt(opt_state)
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_from, has_aux=True)(params, buffers, key, inputs, labels)
+
             # mean over the k micro-batches == one big-batch gradient
-            gacc = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(a.dtype) / k, gacc, grads)
+            def add_contrib(_):
+                return jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(a.dtype) / k, gacc, grads)
+
+            if guard:
+                # a poisoned micro-batch contributes nothing to the
+                # accumulator (and leaves buffers alone); the boundary
+                # update still fires from the clean contributions
+                ok = all_finite(loss, grads)
+                gacc = lax.cond(ok, add_contrib, lambda _: gacc, None)
+                out_buffers = lax.cond(ok, lambda _: new_buffers,
+                                       lambda _: buffers, None)
+            else:
+                gacc = add_contrib(None)
+                out_buffers = new_buffers
             fire = (micro + 1) % k == 0
 
             def do_update(_):
@@ -251,7 +328,10 @@ class TrainStep:
 
             new_params, new_opt, new_gacc = jax.lax.cond(
                 fire, do_update, no_update, None)
-            return (loss, new_params, stash_opt(new_opt), new_buffers,
+            if guard:
+                return (loss, ok, new_params, stash_opt(new_opt), out_buffers,
+                        new_gacc, micro + 1)
+            return (loss, new_params, stash_opt(new_opt), out_buffers,
                     new_gacc, micro + 1)
 
         if k > 1:
@@ -272,7 +352,8 @@ class TrainStep:
                 in_sh = (p_sh, o_sh, b_sh, p_sh, rep, rep, rep,
                          data_tree(self._sample_inputs),
                          data_tree(self._sample_labels))
-                out_sh = (rep, p_sh, o_sh, b_sh, p_sh, rep)
+                out_sh = ((rep,) if guard else ()) + (
+                    rep, p_sh, o_sh, b_sh, p_sh, rep)
                 return jax.jit(accum_step_fn, donate_argnums=donate,
                                in_shardings=in_sh, out_shardings=out_sh)
             return jax.jit(accum_step_fn, donate_argnums=donate)
@@ -291,7 +372,7 @@ class TrainStep:
                                                    self._sample_inputs),
                             jax.tree_util.tree_map(lambda _: data_sh,
                                                    self._sample_labels))
-            out_shardings = (rep, p_sh, o_sh, b_sh)
+            out_shardings = ((rep,) if guard else ()) + (rep, p_sh, o_sh, b_sh)
             return jax.jit(step_fn, donate_argnums=donate,
                            in_shardings=in_shardings, out_shardings=out_shardings)
         return jax.jit(step_fn, donate_argnums=donate)
@@ -365,14 +446,13 @@ class TrainStep:
                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
                     for nm, v in bufs.items()}
 
-        def sharded_update(params, opt_state, gshards, lr, idx):
-            """Fused optimizer update on each replica's 1/n flat shard.
-            Elementwise rules make shard-of-update == update-of-shard
-            bitwise. Returns the updated SHARDS; the caller finishes with a
-            bucketed all-gather (in-region when fully manual) or hands the
-            packed rows to GSPMD outside the region (composed mode — the
-            jax 0.4.x partitioner miscompiles an in-region param gather
-            when jit-level params are mp-sharded)."""
+        def sharded_update_core(params, opt_state, gshards, lr, idx):
+            """Fused optimizer update on each replica's 1/n flat shard —
+            the PURE (collective-free) part, so the anomaly guard can gate
+            it with lax.cond and still run the publish collectives
+            unconditionally outside the branch. Elementwise rules make
+            shard-of-update == update-of-shard bitwise. Returns (current
+            param shards, updated param shards, updated opt state)."""
             pshards = {nm: _gc.shard_of(plan, nm, params[nm], idx)
                        for nm in names}
             slots_sh = {nm: {kk: v.reshape(-1) for kk, v in sl.items()}
@@ -384,11 +464,19 @@ class TrainStep:
                        "slots": {nm: {kk: v.reshape(1, -1)
                                       for kk, v in sl.items()}
                                  for nm, sl in new_state["slots"].items()}}
+            return pshards, new_psh, new_opt
+
+        def publish_shards(psh, idx):
+            """Updated (or passthrough) param shards -> step output: a
+            bucketed all-gather in-region when fully manual, or packed
+            (1, cols) rows handed to GSPMD outside the region (composed
+            mode — the jax 0.4.x partitioner miscompiles an in-region
+            param gather when jit-level params are mp-sharded; out_spec
+            P(axis, None) reassembles the logical (n, cols) layout for
+            the jit-level unpack)."""
             if composed:
-                # packed (1, cols) rows; out_spec P(axis, None) reassembles
-                # the logical (n, cols) layout for the jit-level unpack
-                return {nm: new_psh[nm][None] for nm in names}, new_opt
-            return gather_full(new_psh, idx), new_opt
+                return {nm: psh[nm][None] for nm in names}
+            return gather_full(psh, idx)
 
         def unpack_params(packed):
             """jit-level (GSPMD, outside the manual region) unpack of the
@@ -403,6 +491,19 @@ class TrainStep:
 
         def reduce_mean_shards(grads):
             return _gc.reduce_scatter_grads(plan, grads, axis, wire, denom=n)
+
+        # anomaly guard in shard space: each replica checks its own local
+        # loss and its 1/n reduced grad shards (the shards already contain
+        # every replica's contribution post reduce-scatter), then one psum
+        # of the bad-count makes the verdict identical on all replicas —
+        # no per-param reductions over gathered grads, no host sync.
+        guard = self._anomaly is not None
+        from ..distributed.elastic import all_finite
+
+        def shard_ok(loss, gshards):
+            local = all_finite(loss, gshards)
+            bad = lax.psum(jnp.logical_not(local).astype(jnp.int32), axis)
+            return bad == 0
 
         # -- specs/shardings ------------------------------------------------
         P_rep, P_packed, P_data = P(), P(axis, None), P(axis)
@@ -447,30 +548,53 @@ class TrainStep:
                 loss, new_buffers, grads = local_loss_grads(
                     params, buffers, key, inputs, labels, idx)
                 gshards = reduce_mean_shards(grads)
+                ok = shard_ok(loss, gshards) if guard else None
                 if grad_clip is not None:
                     gshards = _gc.clip_shards(grad_clip, gshards, axis)
                 if wus:
-                    new_params, new_opt = sharded_update(
+                    pshards, new_psh, upd_opt = sharded_update_core(
                         params, opt_state, gshards, lr, idx)
+                    if guard:
+                        # pure select; the publish gather below runs
+                        # unconditionally (no collectives under the cond)
+                        sel_psh, new_opt = lax.cond(
+                            ok, lambda _: (new_psh, upd_opt),
+                            lambda _: (pshards, opt_state), None)
+                    else:
+                        sel_psh, new_opt = new_psh, upd_opt
+                    new_params = publish_shards(sel_psh, idx)
                 else:
                     # explicit all-reduce baseline: finish the reduce with a
                     # grad all-gather (ring AR = RS+AG), replicated update
                     grads_full = gather_full(gshards, idx)
-                    new_params, new_opt = optimizer.apply_gradients(
-                        params, grads_full, opt_state, lr)
-                return (lax.pmean(loss, axis), new_params, new_opt,
-                        sync_buffers(new_buffers))
+                    if guard:
+                        new_params, new_opt = lax.cond(
+                            ok, lambda _: optimizer.apply_gradients(
+                                params, grads_full, opt_state, lr),
+                            lambda _: (params, opt_state), None)
+                    else:
+                        new_params, new_opt = optimizer.apply_gradients(
+                            params, grads_full, opt_state, lr)
+                synced = sync_buffers(new_buffers)
+                out_bufs = (lax.cond(ok, lambda _: synced,
+                                     lambda _: buffers, None)
+                            if guard else synced)
+                return (lax.pmean(loss, axis),) + \
+                    ((ok,) if guard else ()) + (new_params, new_opt, out_bufs)
 
+            ok_spec = (P_rep,) if guard else ()
             smap = shard_map(
                 body, mesh=mesh,
                 in_specs=(p_spec, o_spec, b_spec, P_rep, P_rep, in_data,
                           in_lab) + ridx_spec,
-                out_specs=(P_rep, p_out_spec, o_spec, b_spec),
+                out_specs=(P_rep,) + ok_spec + (p_out_spec, o_spec, b_spec),
                 axis_names=manual)
             if composed and wus:
                 def stepped(*args):
-                    loss, packed, new_opt, bufs = smap(*args)
-                    return loss, unpack_params(packed), new_opt, bufs
+                    loss, *rest = smap(*args)
+                    *flag, packed, new_opt, bufs = rest
+                    return (loss, *flag, unpack_params(packed), new_opt,
+                            bufs)
             else:
                 stepped = smap
             donate = (0, 1, 2) if self._effective_donate() else ()
@@ -479,8 +603,8 @@ class TrainStep:
                 in_shardings=(to_sh(p_jit), o_jit, to_sh(b_spec),
                               to_sh(P_rep), to_sh(P_rep), to_sh(in_data),
                               to_sh(in_lab)) + to_sh(ridx_spec),
-                out_shardings=(to_sh(P_rep), to_sh(p_jit), o_jit,
-                               to_sh(b_spec)))
+                out_shardings=(to_sh(P_rep),) + to_sh(ok_spec) +
+                              (to_sh(p_jit), o_jit, to_sh(b_spec)))
 
         # accumulate_steps > 1: separate micro/fire programs selected by the
         # host-side micro counter (deterministic), instead of lax.cond —
@@ -494,18 +618,29 @@ class TrainStep:
             loss, new_buffers, grads = local_loss_grads(
                 params, buffers, key, inputs, labels, idx)
             gshards = reduce_mean_shards(grads)
+            ok = shard_ok(loss, gshards) if guard else None
             if wus:
-                new_gacc = {nm: gacc[nm] +
-                            (gshards[nm] / k).astype(gacc[nm].dtype
-                                                     ).reshape(1, -1)
-                            for nm in names}
+                cand = {nm: gacc[nm] +
+                        (gshards[nm] / k).astype(gacc[nm].dtype
+                                                 ).reshape(1, -1)
+                        for nm in names}
             else:
                 grads_full = gather_full(gshards, idx)
-                new_gacc = {nm: gacc[nm] +
-                            (grads_full[nm] / k).astype(gacc[nm].dtype)
-                            for nm in names}
-            return (lax.pmean(loss, axis), params, opt_state,
-                    sync_buffers(new_buffers), new_gacc, micro + 1)
+                cand = {nm: gacc[nm] +
+                        (grads_full[nm] / k).astype(gacc[nm].dtype)
+                        for nm in names}
+            synced = sync_buffers(new_buffers)
+            if guard:
+                # a poisoned micro-batch contributes nothing: accumulator
+                # and buffers pass through, the boundary update fires from
+                # the clean contributions only
+                new_gacc = lax.cond(ok, lambda _: cand, lambda _: gacc, None)
+                out_bufs = lax.cond(ok, lambda _: synced,
+                                    lambda _: buffers, None)
+            else:
+                new_gacc, out_bufs = cand, synced
+            return (lax.pmean(loss, axis),) + ((ok,) if guard else ()) + \
+                (params, opt_state, out_bufs, new_gacc, micro + 1)
 
         def fire_body(params, opt_state, buffers, gacc, micro, lr, key,
                       inputs, labels, *ridx):
@@ -513,24 +648,37 @@ class TrainStep:
             loss, new_buffers, grads = local_loss_grads(
                 params, buffers, key, inputs, labels, idx)
             gshards = reduce_mean_shards(grads)
+            ok = shard_ok(loss, gshards) if guard else None
             if wus:
-                acc = {nm: gacc[nm].reshape(-1) +
-                       (gshards[nm] / k).astype(gacc[nm].dtype)
-                       for nm in names}
+                flat_acc = {nm: gacc[nm].reshape(-1) for nm in names}
+                cand = {nm: flat_acc[nm] +
+                        (gshards[nm] / k).astype(gacc[nm].dtype)
+                        for nm in names}
+                # the boundary update always applies (from the accumulated
+                # clean micro-grads); only a poisoned fire micro-batch's own
+                # contribution is dropped
+                acc = (lax.cond(ok, lambda _: cand, lambda _: flat_acc, None)
+                       if guard else cand)
                 if grad_clip is not None:
                     acc = _gc.clip_shards(grad_clip, acc, axis)
-                new_params, new_opt = sharded_update(params, opt_state, acc,
-                                                     lr, idx)
+                _, new_psh, new_opt = sharded_update_core(
+                    params, opt_state, acc, lr, idx)
+                new_params = publish_shards(new_psh, idx)
                 zeroed = {nm: jnp.zeros_like(gacc[nm]) for nm in names}
             else:
                 grads_full = gather_full(gshards, idx)
-                acc = {nm: gacc[nm] + (grads_full[nm] / k
-                                       ).astype(gacc[nm].dtype)
+                cand = {nm: gacc[nm] + (grads_full[nm] / k
+                                        ).astype(gacc[nm].dtype)
                        for nm in names}
+                acc = (lax.cond(ok, lambda _: cand, lambda _: gacc, None)
+                       if guard else cand)
                 new_params, new_opt = apply_update(params, acc, opt_state, lr)
                 zeroed = {nm: jnp.zeros_like(gacc[nm]) for nm in names}
-            return (lax.pmean(loss, axis), new_params, new_opt,
-                    sync_buffers(new_buffers), zeroed, micro + 1)
+            synced = sync_buffers(new_buffers)
+            out_bufs = (lax.cond(ok, lambda _: synced, lambda _: buffers,
+                                 None) if guard else synced)
+            return (lax.pmean(loss, axis),) + ((ok,) if guard else ()) + \
+                (new_params, new_opt, out_bufs, zeroed, micro + 1)
 
         acc_jit = acc_spec if wus else p_jit
         in_specs = (p_spec, o_spec, b_spec, acc_spec, P_rep, P_rep, P_rep,
@@ -538,23 +686,26 @@ class TrainStep:
         in_jit = (to_sh(p_jit), o_jit, to_sh(b_spec), to_sh(acc_jit),
                   to_sh(P_rep), to_sh(P_rep), to_sh(P_rep), to_sh(in_data),
                   to_sh(in_lab)) + to_sh(ridx_spec)
-        out_jit = (to_sh(P_rep), to_sh(p_jit), o_jit, to_sh(b_spec),
-                   to_sh(acc_jit), to_sh(P_rep))
+        ok_spec = (P_rep,) if guard else ()
+        out_jit = (to_sh(P_rep),) + to_sh(ok_spec) + (
+            to_sh(p_jit), o_jit, to_sh(b_spec), to_sh(acc_jit), to_sh(P_rep))
         donate = (0, 1, 2, 3) if self._effective_donate() else ()
         jits = {}
         for tag, body in (("micro", micro_body), ("fire", fire_body)):
             # micro steps return params untouched (replicated); only the
             # fire step's updated params leave packed in composed wus mode
             packs = composed and wus and tag == "fire"
-            out_specs = (P_rep, p_out_spec if packs else p_spec, o_spec,
-                         b_spec, acc_spec, P_rep)
+            out_specs = (P_rep,) + ok_spec + (
+                p_out_spec if packs else p_spec, o_spec,
+                b_spec, acc_spec, P_rep)
             smap = shard_map(body, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, axis_names=manual)
             if packs:
                 def stepped(*args, _smap=smap):
-                    loss, packed, new_opt, bufs, gacc, micro = _smap(*args)
-                    return (loss, unpack_params(packed), new_opt, bufs,
-                            gacc, micro)
+                    loss, *rest = _smap(*args)
+                    *flag, packed, new_opt, bufs, gacc, micro = rest
+                    return (loss, *flag, unpack_params(packed), new_opt,
+                            bufs, gacc, micro)
             else:
                 stepped = smap
             jits[tag] = jax.jit(stepped, donate_argnums=donate,
@@ -603,7 +754,23 @@ class TrainStep:
                           for x in inputs)
         lab_arrays = tuple(x._data if isinstance(x, Tensor) else jnp.asarray(x)
                            for x in labels)
+        # deterministic chaos hooks (utils/fault_injection.py): inactive =
+        # one attribute check, arrays untouched, executables unchanged
+        from ..utils import fault_injection as _fi
+        if _fi._plan is not None:
+            _fi.maybe_preempt(self._step)
+            in_arrays, lab_arrays = _fi.maybe_poison(
+                self._step, in_arrays, lab_arrays)
         if self._jitted is None:
+            from .. import flags as _flags
+            policy = _flags._FLAGS.get("FLAGS_anomaly_policy", "off")
+            if policy in ("skip", "rollback"):
+                self._anomaly = (policy, max(1, int(_flags._FLAGS.get(
+                    "FLAGS_anomaly_max_bad_steps", 3))))
+            elif policy not in ("off", False, None, "0"):
+                raise ValueError(
+                    f"FLAGS_anomaly_policy must be off|skip|rollback, "
+                    f"got {policy!r}")
             self._sample_inputs = in_arrays
             self._sample_labels = lab_arrays
             from ..distributed import grad_comm as _gc
@@ -639,6 +806,8 @@ class TrainStep:
             self._opt_state = self._move_opt(self._opt_state,
                                              self._opt_dev_shardings())
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        guard = self._anomaly is not None
+        ok = None
         if self.accumulate_steps > 1:
             if isinstance(self._jitted, dict):
                 # grad_comm pair: the boundary is host-deterministic, so the
@@ -649,17 +818,25 @@ class TrainStep:
                 rec = self._comm_records["fire" if fire else "micro"]
             else:
                 fn, rec = self._jitted, None
-            (loss, self._params, self._opt_state, self._buffers,
-             self._grad_accum, self._micro) = fn(
-                self._params, self._opt_state, self._buffers,
-                self._grad_accum, self._micro, lr, next_key(),
-                in_arrays, lab_arrays, *self._gc_extra)
+            out = fn(self._params, self._opt_state, self._buffers,
+                     self._grad_accum, self._micro, lr, next_key(),
+                     in_arrays, lab_arrays, *self._gc_extra)
+            if guard:
+                (loss, ok, self._params, self._opt_state, self._buffers,
+                 self._grad_accum, self._micro) = out
+            else:
+                (loss, self._params, self._opt_state, self._buffers,
+                 self._grad_accum, self._micro) = out
             self._micro_py += 1
         else:
             rec = self._comm_records["step"] if self._comm_records else None
-            loss, self._params, self._opt_state, self._buffers = self._jitted(
+            out = self._jitted(
                 self._params, self._opt_state, self._buffers, lr, next_key(),
                 in_arrays, lab_arrays, *self._gc_extra)
+            if guard:
+                loss, ok, self._params, self._opt_state, self._buffers = out
+            else:
+                loss, self._params, self._opt_state, self._buffers = out
         if rec is not None:
             from ..distributed import grad_comm as _gc
             _gc.record_step(rec)
@@ -668,7 +845,132 @@ class TrainStep:
                                              self._opt_host_shardings())
         self._step += 1
         self.optimizer._step_count = self._step
+        if guard:
+            _anomaly_counters["steps"] += 1
+            if self.accumulate_steps > 1:
+                # micro flags stay on device until the boundary — the host
+                # never blocks mid-window, preserving the async micro-batch
+                # dispatch overlap of the grad_comm accumulation path
+                self._pending_ok.append(ok)
+                if self._micro_py % self.accumulate_steps == 0:
+                    loss = self._anomaly_policy_flush(loss)
+            else:
+                loss = self._anomaly_policy_step(loss, ok)
+        self._maybe_autosave()
         return Tensor(loss)
+
+    # -- anomaly policy layer (host side of the compiled guard) --------------
+    def _anomaly_policy_step(self, loss, ok):
+        """Consume the step_ok flag: ONE combined (loss, step_ok) device
+        fetch — the loss fetch the caller was doing anyway — then streak
+        accounting and, under the rollback policy, checkpoint restore after
+        K consecutive bad steps. Returns the host-resident loss."""
+        policy, max_bad = self._anomaly
+        loss, ok = jax.device_get((loss, ok))
+        _anomaly_counters["host_syncs"] += 1
+        self.last_step_ok = bool(ok)
+        if self.last_step_ok:
+            self._bad_streak = 0
+            return loss
+        self._bad_streak += 1
+        _anomaly_counters["bad_steps"] += 1
+        _anomaly_counters["skipped_updates"] += 1  # an update was due
+        if policy == "rollback" and self._bad_streak >= max_bad:
+            self._rollback()
+        return loss
+
+    def _anomaly_policy_flush(self, loss):
+        """Fire-boundary flush under accumulation: fetch the fire loss and
+        the whole window's step_ok flags in ONE device_get, then run streak
+        accounting over them oldest-first. A poisoned micro only dropped
+        its contribution (the boundary update ran from the clean rest), so
+        bad flags count toward the rollback streak but not
+        skipped_updates."""
+        policy, max_bad = self._anomaly
+        fetched = jax.device_get((loss, *self._pending_ok))
+        loss, oks = fetched[0], fetched[1:]
+        self._pending_ok = []
+        _anomaly_counters["host_syncs"] += 1
+        for ok in oks:
+            self.last_step_ok = bool(ok)
+            if self.last_step_ok:
+                self._bad_streak = 0
+                continue
+            self._bad_streak += 1
+            _anomaly_counters["bad_steps"] += 1
+            if policy == "rollback" and self._bad_streak >= max_bad:
+                self._rollback()  # resets the streak; later flags belong
+                break             # to the pre-rollback trajectory — drop
+        return loss
+
+    def _rollback(self):
+        """Restore the attached CheckpointManager's newest good checkpoint
+        and fast-forward the RNG stream past the poison batches: the data
+        loader keeps streaming forward (batch position is NOT rewound), so
+        training resumes from known-good weights on the next fresh batch."""
+        from ..distributed.elastic import NonFiniteError
+        mgr = self._ckpt_mgr
+        if mgr is None:
+            raise NonFiniteError(
+                f"anomaly policy 'rollback' hit {self._bad_streak} "
+                f"consecutive bad steps but no CheckpointManager is "
+                f"attached (TrainStep.attach_checkpoint)")
+        try:
+            mgr.wait()
+        except Exception:
+            pass  # a failed async save must not block recovery
+        target = self._step  # batches consumed so far
+        state = mgr.restore(None)
+        if state is None:
+            raise NonFiniteError(
+                f"anomaly policy 'rollback' hit {self._bad_streak} "
+                f"consecutive bad steps before the first checkpoint")
+        # the data stream keeps moving forward: do NOT rewind the attached
+        # loader to the checkpoint's position (that would re-serve batches
+        # the forwarded RNG stream has already accounted past)
+        state = dict(state)
+        state.pop("loader", None)
+        self.load_state_dict(state)
+        restored = self._step
+        from ..framework import random as _rnd
+        _rnd.advance(max(0, target - restored))
+        self._step = target
+        self.optimizer._step_count = target
+        self._bad_streak = 0
+        _anomaly_counters["rollbacks"] += 1
+        if self._on_rollback is not None:
+            self._on_rollback(restored, target)
+
+    def _maybe_autosave(self):
+        if (self._ckpt_mgr is None or not self._ckpt_every
+                or self._step % self._ckpt_every != 0):
+            return
+        if self._anomaly is not None and not self.last_step_ok:
+            return  # never publish a checkpoint taken off a bad step
+        self._ckpt_mgr.save(self._step, self.state_dict())
+
+    # -- fault-tolerance attachments -----------------------------------------
+    def attach_checkpoint(self, manager, save_every=0, on_rollback=None):
+        """Wire a CheckpointManager in: ``save_every>0`` auto-saves
+        ``state_dict()`` every N good steps, and the rollback anomaly
+        policy restores from it. ``on_rollback(restored_step,
+        resume_step)`` is invoked after a restore so the data pipeline can
+        resynchronize if it tracks position externally."""
+        self._ckpt_mgr = manager
+        self._ckpt_every = int(save_every)
+        if on_rollback is not None:
+            self._on_rollback = on_rollback
+        return self
+
+    def attach_loader(self, loader):
+        """DataLoader whose epoch position rides along in state_dict()."""
+        self._attached_loader = loader
+        return self
+
+    def attach_scaler(self, scaler):
+        """amp.GradScaler whose scaling state rides along in state_dict()."""
+        self._attached_scaler = scaler
+        return self
 
     def memory_analysis(self):
         """Compiled-executable memory analysis (argument/output/temp bytes)
@@ -721,11 +1023,69 @@ class TrainStep:
             state["micro"] = int(jax.device_get(self._micro))
         return state
 
+    def state_dict(self):
+        """Complete training state for EXACT resume: params, buffers,
+        optimizer slots (packed dp-sharded layout preserved as stored —
+        no full materialization on either side), gradient accumulator +
+        micro position, the global RNG stream (framework/random), the LR
+        scheduler, and — when attached — GradScaler scaling state and the
+        DataLoader's epoch position. A run killed at step t and
+        ``load_state_dict``-resumed reproduces the uninterrupted
+        trajectory bitwise."""
+        state = self.state_for_checkpoint()
+        from ..framework import random as _rnd
+        state["rng"] = _rnd.state_dict()
+        from ..optimizer.lr import LRScheduler
+        if isinstance(self.optimizer._learning_rate, LRScheduler):
+            state["lr_sched"] = self.optimizer._learning_rate.state_dict()
+        if self._attached_scaler is not None:
+            state["scaler"] = self._attached_scaler.state_dict()
+        if self._attached_loader is not None and hasattr(
+                self._attached_loader, "state_dict"):
+            state["loader"] = self._attached_loader.state_dict()
+        state["format_version"] = 1
+        return state
+
+    def load_state_dict(self, state):
+        """Restore a ``state_dict()`` snapshot (also accepts the bare
+        ``state_for_checkpoint`` layout). Slot layout differences between
+        the saving and restoring schedule (packed (n, cols) vs
+        param-shaped) are normalized; under a mesh the leaves are
+        device_put straight to their target shardings — a packed
+        dp-sharded slot checkpoint restores shard-wise without ever
+        materializing the full slot tensors in one buffer."""
+        self.restore_from_checkpoint(state)
+        if "rng" in state:
+            from ..framework import random as _rnd
+            _rnd.set_state_dict(state["rng"])
+        if "lr_sched" in state:
+            from ..optimizer.lr import LRScheduler
+            if isinstance(self.optimizer._learning_rate, LRScheduler):
+                self.optimizer._learning_rate.set_state_dict(
+                    dict(state["lr_sched"]))
+        if "scaler" in state and self._attached_scaler is not None:
+            self._attached_scaler.load_state_dict(dict(state["scaler"]))
+        if "loader" in state and self._attached_loader is not None and \
+                hasattr(self._attached_loader, "load_state_dict"):
+            self._attached_loader.load_state_dict(dict(state["loader"]))
+        self._bad_streak = 0
+        self.last_step_ok = True
+        self._pending_ok = []
+        self.optimizer._step_count = self._step
+
     def restore_from_checkpoint(self, state):
-        put = lambda tree: jax.tree_util.tree_map(jnp.asarray, tree)
+        # under a mesh, keep host (numpy) leaves as-is: shard_params below
+        # device_puts each leaf straight to its target sharding (packed
+        # dp-sharded slots restore shard-wise, no replicated intermediate);
+        # without a mesh, arrays go to the default device here
+        if self.mesh is not None:
+            put = lambda tree: tree  # noqa: E731
+        else:
+            put = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+                jnp.asarray, tree)
         self._params = put(state["params"])
         self._opt_state = put(state["opt_state"])
-        self._buffers = put(state["buffers"])
+        self._buffers = jax.tree_util.tree_map(jnp.asarray, state["buffers"])
         self._step = int(state["step"])
         if "grad_accum" in state:
             self._grad_accum = put(state["grad_accum"])

@@ -274,6 +274,49 @@ def mp_comm_summary():
             f"act/block: {c['activation_bytes'] / 1e6:.3f}MB")
 
 
+# -- fault-tolerance counters -------------------------------------------------
+# The compiled anomaly guard (jit/train_step.py, FLAGS_anomaly_policy), the
+# hardened CheckpointManager (incubate/checkpoint.py) and the chaos harness
+# (utils/fault_injection.py) each keep a ledger. `host_syncs` is the audit
+# trail for the guard's zero-extra-sync contract: one combined (loss,
+# step_ok...) fetch per UPDATE step — host_syncs == steps at
+# accumulate_steps=1, and steps/k under accumulation (micro flags ride to
+# the fire boundary in the same fetch). Anything above that means a sync
+# snuck in.
+
+
+def fault_counters():
+    """Snapshot of the fault-tolerance counters: anomaly guard (steps,
+    host_syncs, bad_steps, skipped_updates, rollbacks), checkpoint manager
+    (saves, save_retries, quarantined, restore_fallbacks, preempt_saves)
+    and injected-fault stats."""
+    from ..jit import train_step as _ts
+    from ..incubate import checkpoint as _ck
+    from ..utils import fault_injection as _fi
+    out = {"anomaly": _ts.anomaly_counters(),
+           "checkpoint": _ck.ckpt_counters(),
+           "injected": _fi.stats()}
+    return out
+
+
+def reset_fault_counters():
+    from ..jit import train_step as _ts
+    from ..incubate import checkpoint as _ck
+    _ts.reset_anomaly_counters()
+    _ck.reset_ckpt_counters()
+
+
+def fault_summary():
+    """One-line human-readable fault-tolerance report."""
+    c = fault_counters()
+    a, k = c["anomaly"], c["checkpoint"]
+    return (f"steps: {a['steps']}  host-syncs: {a['host_syncs']}  "
+            f"bad: {a['bad_steps']}  skipped: {a['skipped_updates']}  "
+            f"rollbacks: {a['rollbacks']}  saves: {k['saves']}  "
+            f"retries: {k['save_retries']}  quarantined: {k['quarantined']}  "
+            f"preempt-saves: {k['preempt_saves']}")
+
+
 def benchmark():
     """Step-timer handle (ref profiler.utils.benchmark)."""
     return _Benchmark()

@@ -108,3 +108,4 @@ class unique_name:
 from . import dlpack  # noqa: E402,F401
 from . import download  # noqa: E402,F401
 from . import cpp_extension  # noqa: E402,F401
+from . import fault_injection  # noqa: E402,F401  (chaos-testing harness)

@@ -1,0 +1,141 @@
+"""Deterministic fault injection for the fault-tolerance runtime.
+
+Chaos testing for TPU training: production runs die on NaN steps, torn
+checkpoint writes, and preemptions — this module injects exactly those
+faults at exact, reproducible points so the recovery machinery
+(jit.TrainStep anomaly guard, incubate.checkpoint.CheckpointManager,
+distributed.elastic.ElasticAgent) can be tested without flakiness.
+
+Injection sites are pulled, not pushed: the runtime calls the cheap hooks
+below at its fault-sensitive points and they no-op unless a ``FaultPlan``
+is active (module-level ``_plan`` is None by default, so the cost when
+inactive is one attribute check and the compiled step programs are
+untouched — batch poisoning happens host-side on the already-materialized
+input arrays, never inside an executable).
+
+Faults:
+  * ``nan_at_steps``    — poison the floating-point leaves of the batch fed
+                          to TrainStep at those step indices (0-based call
+                          count) with NaN, which makes loss and grads
+                          non-finite inside the compiled step
+  * ``io_error_on_writes`` — the nth checkpoint write (1-based) raises
+                          ``OSError`` before touching the directory
+                          (transient-IO / flaky-NFS simulation)
+  * ``preempt_at_step`` — raise ``Preemption`` before dispatching that step
+                          (SIGTERM-preemption simulation without signals)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Preemption(BaseException):
+    """Simulated preemption. Derives from BaseException so ordinary
+    ``except Exception`` recovery paths (e.g. ElasticAgent's restart loop)
+    do not swallow it — a preempted process must save and exit, not
+    retrain."""
+
+
+class FaultPlan:
+    """Deterministic schedule of injected faults."""
+
+    def __init__(self, nan_at_steps=(), io_error_on_writes=(),
+                 preempt_at_step=None):
+        self.nan_at_steps = frozenset(int(s) for s in nan_at_steps)
+        self.io_error_on_writes = frozenset(int(n) for n in io_error_on_writes)
+        self.preempt_at_step = (None if preempt_at_step is None
+                                else int(preempt_at_step))
+        # observability: what actually fired
+        self.stats = {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
+                      "writes_seen": 0}
+
+    def __repr__(self):
+        return (f"FaultPlan(nan_at_steps={sorted(self.nan_at_steps)}, "
+                f"io_error_on_writes={sorted(self.io_error_on_writes)}, "
+                f"preempt_at_step={self.preempt_at_step})")
+
+
+_plan: FaultPlan | None = None
+_last_plan: FaultPlan | None = None
+
+
+def activate(plan: FaultPlan):
+    """Install ``plan`` globally; returns it for chaining."""
+    global _plan, _last_plan
+    _plan = _last_plan = plan
+    return plan
+
+
+def deactivate():
+    global _plan
+    _plan = None
+
+
+def active():
+    return _plan
+
+
+class inject:
+    """Context manager form: ``with fault_injection.inject(plan): ...``"""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def __enter__(self):
+        activate(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc):
+        deactivate()
+
+
+# -- hooks consulted by the runtime ------------------------------------------
+
+
+def maybe_poison(step, *trees):
+    """Return ``trees`` with every inexact-float array replaced by NaN when
+    the active plan poisons ``step``; the original objects otherwise
+    (bitwise no-op when inactive — same array identities)."""
+    if _plan is None or int(step) not in _plan.nan_at_steps:
+        return trees if len(trees) != 1 else trees[0]
+    _plan.stats["poisoned_steps"] += 1
+
+    def poison(x):
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full(arr.shape, np.nan, arr.dtype)
+        return x
+
+    import jax
+    out = tuple(jax.tree_util.tree_map(poison, t) for t in trees)
+    return out if len(out) != 1 else out[0]
+
+
+def maybe_preempt(step):
+    """Raise ``Preemption`` when the active plan preempts at ``step``."""
+    if _plan is not None and _plan.preempt_at_step == int(step):
+        _plan.stats["preemptions"] += 1
+        raise Preemption(f"simulated preemption at step {step}")
+
+
+def maybe_fail_write(site="ckpt_write"):
+    """Called by CheckpointManager before each on-disk write attempt; the
+    nth call (1-based, counted across all managers) raises OSError when the
+    plan schedules it."""
+    if _plan is None:
+        return
+    _plan.stats["writes_seen"] += 1
+    if _plan.stats["writes_seen"] in _plan.io_error_on_writes:
+        _plan.stats["io_errors"] += 1
+        raise OSError(
+            f"injected I/O error on checkpoint write "
+            f"#{_plan.stats['writes_seen']} ({site})")
+
+
+def stats():
+    """Stats of the active (or last active) plan; zeros when never active."""
+    plan = _plan or _last_plan
+    if plan is None:
+        return {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
+                "writes_seen": 0}
+    return dict(plan.stats)

@@ -1,0 +1,956 @@
+"""Fault-tolerant training runtime: compiled anomaly guard
+(FLAGS_anomaly_policy), hardened CheckpointManager (CRC manifest,
+quarantine+fallback, retry/backoff, rename-aside publish, SIGTERM flush),
+TrainStep exact-resume state_dict, deterministic fault injection, and the
+satellite fixes (GradScaler double-unscale guard, DataLoader timeout and
+position state, elastic seed-class coverage)."""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import elastic
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.incubate.checkpoint import (
+    CheckpointCorruptError, CheckpointManager, Preempted, ckpt_counters)
+from paddle_tpu.io import DataLoader
+from paddle_tpu.jit.train_step import anomaly_counters, reset_anomaly_counters
+from paddle_tpu.utils import fault_injection as fi
+
+
+_DEFAULT_FLAGS = {
+    "FLAGS_anomaly_policy": "off",
+    "FLAGS_anomaly_max_bad_steps": 3,
+    "FLAGS_grad_comm": "auto",
+    "FLAGS_weight_update_sharding": False,
+    "FLAGS_allreduce_dtype": "float32",
+}
+
+WUS = {"FLAGS_grad_comm": "on", "FLAGS_weight_update_sharding": True}
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    paddle.set_flags(dict(_DEFAULT_FLAGS))
+    dist_env.set_mesh(None)
+    fi.deactivate()
+
+
+def _model(seed=7, width=8, dropout=False):
+    paddle.seed(seed)
+    layers = [nn.Linear(width, width), nn.ReLU()]
+    if dropout:
+        layers.append(nn.Dropout(0.25))
+    layers.append(nn.Linear(width, 4))
+    return nn.Sequential(*layers)
+
+
+def _step(flags=None, seed=7, mesh=None, k=1, dropout=False, width=8,
+          sched=False):
+    paddle.set_flags(dict(_DEFAULT_FLAGS))
+    if flags:
+        paddle.set_flags(flags)
+    m = _model(seed=seed, width=width, dropout=dropout)
+    lr = paddle.optimizer.lr.NaturalExpDecay(0.01, gamma=0.1) if sched \
+        else 0.01
+    opt = paddle.optimizer.AdamW(lr, parameters=m.parameters())
+    return paddle.jit.TrainStep(m, nn.MSELoss(), opt, mesh=mesh,
+                                accumulate_steps=k)
+
+
+def _data(n=8, width=8, rows=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, rows, width)).astype(np.float32),
+            rng.standard_normal((n, rows, 4)).astype(np.float32))
+
+
+def _run(step, X, Y, lo=0, hi=None, lr_step=False):
+    hi = len(X) if hi is None else hi
+    losses = []
+    for i in range(lo, hi):
+        losses.append(step(paddle.to_tensor(X[i]), paddle.to_tensor(Y[i])))
+        if lr_step:
+            step.optimizer._learning_rate.step()
+    return {n: np.asarray(a) for n, a in step.params.items()}, losses
+
+
+# ---------------------------------------------------------------------------
+# compiled anomaly guard
+# ---------------------------------------------------------------------------
+
+
+def test_guard_off_is_default_and_adds_no_host_work():
+    reset_anomaly_counters()
+    X, Y = _data(3)
+    step = _step()
+    _run(step, X, Y)
+    assert step._anomaly is None
+    c = anomaly_counters()
+    assert c["steps"] == 0 and c["host_syncs"] == 0  # policy layer inactive
+
+
+def test_guard_on_no_faults_is_bitwise_identical_single_device():
+    X, Y = _data(5)
+    p_off, _ = _run(_step(), X, Y)
+    p_on, _ = _run(_step({"FLAGS_anomaly_policy": "skip"}), X, Y)
+    for n in p_off:
+        np.testing.assert_array_equal(p_off[n], p_on[n]), n
+
+
+def test_guard_skips_update_on_poisoned_step_and_recovers():
+    X, Y = _data(6)
+    reset_anomaly_counters()
+    step = _step({"FLAGS_anomaly_policy": "skip"})
+    with fi.inject(fi.FaultPlan(nan_at_steps=[2])):
+        _run(step, X, Y, hi=2)
+        p_before = {n: np.asarray(a) for n, a in step.params.items()}
+        loss = step(paddle.to_tensor(X[2]), paddle.to_tensor(Y[2]))
+        assert not step.last_step_ok
+        assert not np.isfinite(np.asarray(loss.numpy()))
+        for n in p_before:  # params/slots untouched by the bad step
+            np.testing.assert_array_equal(
+                p_before[n], np.asarray(step.params[n]))
+        p_after, losses = _run(step, X, Y, lo=3)
+    assert step.last_step_ok
+    assert all(np.isfinite(np.asarray(a)).all() for a in p_after.values())
+    assert fi.stats()["poisoned_steps"] == 1
+    c = anomaly_counters()
+    assert c["bad_steps"] == 1 and c["skipped_updates"] == 1
+
+
+def test_guard_single_host_sync_per_step():
+    """The zero-extra-sync contract: one combined (loss, step_ok) fetch per
+    guarded step — host_syncs == steps exactly, and the returned loss is
+    already host-resident."""
+    reset_anomaly_counters()
+    X, Y = _data(4)
+    step = _step({"FLAGS_anomaly_policy": "skip"})
+    _run(step, X, Y)
+    c = anomaly_counters()
+    assert c["steps"] == 4 and c["host_syncs"] == 4
+
+
+def test_guard_skip_poisoned_step_matches_skipping_the_batch():
+    """Skip semantics are exact: a run whose step k is poisoned (and
+    skipped) ends bitwise identical to a run that never saw step k's batch
+    but consumed the same RNG stream."""
+    X, Y = _data(5)
+    step_a = _step({"FLAGS_anomaly_policy": "skip"})
+    with fi.inject(fi.FaultPlan(nan_at_steps=[2])):
+        p_a, _ = _run(step_a, X, Y)
+    # reference: same stream, but batch 2's update manually elided by
+    # feeding it as a poisoned batch too — instead run steps 0,1,3,4 with
+    # the key stream burning one key at step 2
+    from paddle_tpu.framework import random as frandom
+    step_b = _step({"FLAGS_anomaly_policy": "skip"})
+    _run(step_b, X, Y, hi=2)
+    frandom.advance(1)  # the skipped step still consumed its key
+    p_b, _ = _run(step_b, X, Y, lo=3)
+    for n in p_a:
+        np.testing.assert_array_equal(p_a[n], p_b[n]), n
+
+
+def test_guard_accum_defers_sync_to_fire_boundary():
+    """Under accumulation the micro flags ride to the boundary: one host
+    sync per UPDATE step, not per micro-step — and a bad micro (which only
+    drops its contribution; the boundary update still runs) counts toward
+    bad_steps but never skipped_updates."""
+    reset_anomaly_counters()
+    X, Y = _data(6)
+    step = _step({"FLAGS_anomaly_policy": "skip"}, k=3)
+    with fi.inject(fi.FaultPlan(nan_at_steps=[1])):
+        _run(step, X, Y)
+    c = anomaly_counters()
+    assert c["steps"] == 6 and c["host_syncs"] == 2  # two fire boundaries
+    assert c["bad_steps"] == 1 and c["skipped_updates"] == 0
+    assert step._pending_ok == []
+
+
+def test_guard_rejects_unknown_policy():
+    X, Y = _data(1)
+    step = _step({"FLAGS_anomaly_policy": "explode"})
+    with pytest.raises(ValueError, match="anomaly_policy"):
+        step(paddle.to_tensor(X[0]), paddle.to_tensor(Y[0]))
+
+
+# ---------------------------------------------------------------------------
+# anomaly guard under the explicit grad-comm schedule (dp=8 mesh)
+# ---------------------------------------------------------------------------
+
+
+def test_guard_wus_no_faults_matches_unguarded(devices8):
+    X, Y = _data(4, rows=16)
+    mesh = dist_env.create_hybrid_mesh(dp=8)
+    p_off, _ = _run(_step(WUS, mesh=mesh), X, Y)
+    mesh = dist_env.create_hybrid_mesh(dp=8)
+    p_on, _ = _run(_step(dict(WUS, FLAGS_anomaly_policy="skip"), mesh=mesh),
+                   X, Y)
+    for n in p_off:
+        # the guard's in-graph isfinite blocks one XLA division fusion, so
+        # parity is to rounding (flags-OFF stays bitwise vs main)
+        np.testing.assert_allclose(p_off[n], p_on[n], rtol=1e-5, atol=1e-7)
+
+
+def test_guard_wus_accum_poisoned_micro_is_dropped(devices8):
+    """Under weight-update sharding + accumulation, the shard-space check
+    psums the verdict: a poisoned micro-batch contributes nothing to the
+    packed accumulator and training stays finite."""
+    reset_anomaly_counters()
+    X, Y = _data(6, rows=16)
+    mesh = dist_env.create_hybrid_mesh(dp=8)
+    step = _step(dict(WUS, FLAGS_anomaly_policy="skip"), mesh=mesh, k=2)
+    with fi.inject(fi.FaultPlan(nan_at_steps=[2])):
+        p, _ = _run(step, X, Y)
+    assert not np.isfinite(X[2]).all() or True  # plan poisoned in place
+    assert all(np.isfinite(np.asarray(a)).all() for a in p.values())
+    c = anomaly_counters()
+    assert c["bad_steps"] == 1 and c["steps"] == 6
+    # packed slots stayed finite too
+    for name, sl in step.opt_state["slots"].items():
+        for k_, arr in sl.items():
+            assert np.isfinite(np.asarray(arr)).all(), (name, k_)
+
+
+def test_guard_composed_dp_mp_poisoned_step(devices8):
+    """Guard composes with an active mp axis (partial-manual grad_comm):
+    the verdict psums over the dp axis only, mp stays GSPMD-auto."""
+    from paddle_tpu.distributed.fleet.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+    paddle.set_flags(dict(_DEFAULT_FLAGS))
+    paddle.set_flags(dict(WUS, FLAGS_anomaly_policy="skip"))
+    mesh = dist_env.create_hybrid_mesh(dp=2, mp=4)
+    paddle.seed(7)
+    m = nn.Sequential(ColumnParallelLinear(16, 32, gather_output=False),
+                      nn.ReLU(),
+                      RowParallelLinear(32, 16, input_is_parallel=True),
+                      nn.Linear(16, 8))
+    opt = paddle.optimizer.AdamW(0.01, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.MSELoss(), opt, mesh=mesh)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    y = rng.standard_normal((8, 8)).astype(np.float32)
+    with fi.inject(fi.FaultPlan(nan_at_steps=[1])):
+        for _ in range(3):
+            step(paddle.to_tensor(x), paddle.to_tensor(y))
+    assert step._gc_cfg is not None and step._gc_cfg.auto_axes == ("mp",)
+    assert all(np.isfinite(np.asarray(a)).all()
+               for a in step.params.values())
+    assert anomaly_counters()["bad_steps"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# rollback policy
+# ---------------------------------------------------------------------------
+
+
+def test_rollback_restores_checkpoint_after_k_bad_steps(tmp_path):
+    reset_anomaly_counters()
+    X, Y = _data(9)
+    step = _step({"FLAGS_anomaly_policy": "rollback",
+                  "FLAGS_anomaly_max_bad_steps": 2})
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    events = []
+    step.attach_checkpoint(mgr, save_every=2,
+                           on_rollback=lambda s, t: events.append((s, t)))
+    with fi.inject(fi.FaultPlan(nan_at_steps=[4, 5])):
+        p, _ = _run(step, X, Y, hi=8)
+    c = anomaly_counters()
+    assert c["rollbacks"] == 1 and c["bad_steps"] == 2
+    # restored from the step-4 checkpoint, resumed past the poison batches
+    assert events == [(4, 6)]
+    assert all(np.isfinite(np.asarray(a)).all() for a in p.values())
+    assert step._bad_streak == 0 and step.last_step_ok
+
+
+def test_rollback_does_not_rewind_attached_loader(tmp_path):
+    """The data stream keeps moving forward through a rollback: the
+    checkpointed loader position must NOT be re-installed (that would
+    re-serve batches the fast-forwarded RNG already accounted past)."""
+    reset_anomaly_counters()
+    X, Y = _data(8)
+    step = _step({"FLAGS_anomaly_policy": "rollback",
+                  "FLAGS_anomaly_max_bad_steps": 2})
+    loader = DataLoader(list(range(20)), batch_size=2)
+    loader._served = 4  # position at checkpoint time
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    step.attach_checkpoint(mgr, save_every=2)
+    step.attach_loader(loader)
+    with fi.inject(fi.FaultPlan(nan_at_steps=[4, 5])):
+        _run(step, X, Y, hi=7)
+    assert anomaly_counters()["rollbacks"] == 1
+    assert loader._resume_skip == 0  # not rewound by the rollback
+    # but an explicit load_state_dict (real resume) does restore it
+    step.load_state_dict(mgr.restore())
+    assert loader._resume_skip == 4
+
+
+def test_rollback_without_checkpoint_raises():
+    X, Y = _data(4)
+    step = _step({"FLAGS_anomaly_policy": "rollback",
+                  "FLAGS_anomaly_max_bad_steps": 1})
+    with fi.inject(fi.FaultPlan(nan_at_steps=[1])):
+        step(paddle.to_tensor(X[0]), paddle.to_tensor(Y[0]))
+        with pytest.raises(elastic.NonFiniteError, match="rollback"):
+            step(paddle.to_tensor(X[1]), paddle.to_tensor(Y[1]))
+
+
+# ---------------------------------------------------------------------------
+# exact resume: TrainStep.state_dict / load_state_dict
+# ---------------------------------------------------------------------------
+
+
+def test_exact_resume_bitwise_with_dropout_and_lr_scheduler(tmp_path):
+    """The bitwise interrupted-vs-uninterrupted trajectory test: dropout
+    exercises the RNG stream capture, NaturalExpDecay the scheduler step."""
+    X, Y = _data(8)
+    golden, _ = _run(_step(dropout=True, sched=True), X, Y, lr_step=True)
+
+    step_a = _step(dropout=True, sched=True)
+    _run(step_a, X, Y, hi=4, lr_step=True)
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(4, step_a.state_dict())
+    del step_a  # process dies here
+
+    step_b = _step(seed=999, dropout=True, sched=True)  # different init!
+    step_b.load_state_dict(mgr.restore())
+    assert step_b._step == 4
+    resumed, _ = _run(step_b, X, Y, lo=4, lr_step=True)
+    for n in golden:
+        np.testing.assert_array_equal(golden[n], resumed[n]), n
+    # scheduler position restored too
+    assert step_b.optimizer._learning_rate.last_epoch == 8
+
+
+def test_exact_resume_scaler_and_loader_ride_along(tmp_path):
+    from paddle_tpu.amp import GradScaler
+    X, Y = _data(3)
+    step = _step()
+    scaler = GradScaler(init_loss_scaling=2.0 ** 5)
+    scaler._good_steps = 7
+    loader = DataLoader(list(range(10)), batch_size=2)
+    loader._served = 3
+    step.attach_scaler(scaler).attach_loader(loader)
+    _run(step, X, Y)
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(3, step.state_dict())
+
+    step2 = _step(seed=1)
+    scaler2, loader2 = GradScaler(), DataLoader(list(range(10)), batch_size=2)
+    step2.attach_scaler(scaler2).attach_loader(loader2)
+    step2.load_state_dict(mgr.restore())
+    assert scaler2.get_init_loss_scaling() == 2.0 ** 5
+    assert scaler2._good_steps == 7
+    assert loader2._resume_skip == 3
+
+
+def test_exact_resume_wus_accum_packed_slots(tmp_path, devices8):
+    """Kill-and-resume equivalence under FLAGS_weight_update_sharding with
+    packed dp-sharded optimizer slots and accumulate_steps=2 — the save
+    lands MID accumulation window and the restored slots go straight back
+    to their packed dp-sharded placement."""
+    X, Y = _data(6, rows=16)
+    mesh = dist_env.create_hybrid_mesh(dp=8)
+    golden, _ = _run(_step(WUS, mesh=mesh, k=2), X, Y)
+
+    mesh = dist_env.create_hybrid_mesh(dp=8)
+    step_a = _step(WUS, mesh=mesh, k=2)
+    _run(step_a, X, Y, hi=3)  # 3 % k != 0: mid-window, accumulator live
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(3, step_a.state_dict())
+    # the checkpoint stores the slots packed — never the materialized form
+    st = mgr.restore()
+    for name, sl in st["opt_state"]["slots"].items():
+        for k_, arr in sl.items():
+            assert np.asarray(arr).ndim == 2 and np.asarray(arr).shape[0] == 8
+    del step_a
+
+    mesh = dist_env.create_hybrid_mesh(dp=8)
+    step_b = _step(WUS, mesh=mesh, k=2)
+    step_b.load_state_dict(st)
+    resumed, _ = _run(step_b, X, Y, lo=3)
+    for n in golden:
+        np.testing.assert_array_equal(golden[n], resumed[n]), n
+    for name, sl in step_b.opt_state["slots"].items():
+        for k_, arr in sl.items():
+            assert arr.ndim == 2 and arr.shape[0] == 8, (name, k_)
+            assert arr.sharding.spec[0] == "dp", (name, k_)
+
+
+def test_exact_resume_after_simulated_preemption(tmp_path):
+    """Acceptance path: a run interrupted by simulated preemption resumes
+    from the latest checkpoint and reproduces the uninterrupted trajectory
+    bitwise (the preempting step re-executes)."""
+    X, Y = _data(8)
+    golden, _ = _run(_step(), X, Y)
+
+    step_a = _step()
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    step_a.attach_checkpoint(mgr, save_every=2)
+    with pytest.raises(fi.Preemption):
+        with fi.inject(fi.FaultPlan(preempt_at_step=5)):
+            _run(step_a, X, Y)
+    del step_a
+
+    step_b = _step(seed=123)
+    step_b.load_state_dict(mgr.restore())
+    start = step_b._step
+    assert start == 4  # latest periodic save before the preemption
+    resumed, _ = _run(step_b, X, Y, lo=start)
+    for n in golden:
+        np.testing.assert_array_equal(golden[n], resumed[n]), n
+
+
+# ---------------------------------------------------------------------------
+# hardened CheckpointManager
+# ---------------------------------------------------------------------------
+
+
+def test_ckpt_crc_corruption_quarantined_with_fallback(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(1, {"w": np.arange(8.0)})
+    mgr.save(2, {"w": np.ones(8)})
+    p = tmp_path / "step_2" / "state.pdckpt"
+    raw = bytearray(p.read_bytes())
+    raw[-16] ^= 0xFF
+    p.write_bytes(bytes(raw))
+    got = mgr.restore()  # falls back past the rotten step
+    np.testing.assert_array_equal(got["w"], np.arange(8.0))
+    assert mgr.all_steps() == [1]
+    assert (tmp_path / "step_2.corrupt").is_dir()  # kept for postmortem
+
+
+def test_ckpt_explicit_corrupt_step_raises(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(3, {"w": np.zeros(4)})
+    p = tmp_path / "step_3" / "state.pdckpt"
+    p.write_bytes(b"rotten")
+    with pytest.raises(CheckpointCorruptError):
+        mgr.restore(3)
+    assert (tmp_path / "step_3.corrupt").is_dir()
+
+
+def test_ckpt_all_corrupt_returns_none(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(1, {"w": np.zeros(4)})
+    (tmp_path / "step_1" / "state.pdckpt").write_bytes(b"x")
+    assert mgr.restore() is None
+
+
+def test_ckpt_transient_read_error_does_not_quarantine(tmp_path,
+                                                       monkeypatch):
+    """An OSError while READING (flaky NFS) must not condemn good bytes:
+    the read retries with backoff, and a persistently unreadable latest
+    step is skipped — still on disk, not renamed *.corrupt."""
+    from paddle_tpu.incubate import checkpoint as ckpt_mod
+    mgr = CheckpointManager(tmp_path, async_save=False, retries=2,
+                            retry_backoff=0.01)
+    mgr.save(1, {"w": np.arange(4.0)})
+    mgr.save(2, {"w": np.ones(4)})
+    real_load = ckpt_mod.fio.load
+    flaky = {"fails": 1}
+
+    def flaky_load(path, **kw):
+        if flaky["fails"] > 0:
+            flaky["fails"] -= 1
+            raise OSError("ESTALE")
+        return real_load(path, **kw)
+
+    monkeypatch.setattr(ckpt_mod.fio, "load", flaky_load)
+    got = mgr.restore()  # one transient failure -> retried, step 2 intact
+    np.testing.assert_array_equal(got["w"], 1.0)
+    assert mgr.all_steps() == [1, 2]
+
+    flaky["fails"] = 10 ** 9  # step 2 persistently unreadable
+    got = mgr.restore()
+    assert got is None  # every step unreadable, nothing quarantined
+    monkeypatch.undo()
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["step_1", "step_2"]
+    np.testing.assert_array_equal(mgr.restore()["w"], 1.0)  # fs recovered
+
+
+def test_ckpt_transient_io_retries_with_backoff(tmp_path):
+    before = ckpt_counters()["save_retries"]
+    mgr = CheckpointManager(tmp_path, async_save=False, retries=3,
+                            retry_backoff=0.01)
+    with fi.inject(fi.FaultPlan(io_error_on_writes=[1, 2])):
+        mgr.save(5, {"w": np.zeros(4)})
+    assert mgr.latest_step() == 5
+    assert ckpt_counters()["save_retries"] - before == 2
+
+
+def test_ckpt_exhausted_retries_surface(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False, retries=1,
+                            retry_backoff=0.01)
+    with fi.inject(fi.FaultPlan(io_error_on_writes=[1, 2])):
+        with pytest.raises(OSError, match="injected"):
+            mgr.save(1, {"w": np.zeros(4)})
+    # async saves surface the error on the next wait()
+    mgr2 = CheckpointManager(tmp_path, async_save=True, retries=0,
+                             retry_backoff=0.01)
+    with fi.inject(fi.FaultPlan(io_error_on_writes=[1])):
+        mgr2.save(2, {"w": np.zeros(4)})
+        with pytest.raises(OSError, match="injected"):
+            mgr2.wait()
+
+
+def test_ckpt_overwrite_never_deletes_only_copy(tmp_path):
+    """Replacing an existing step dir goes rename-aside -> publish -> drop;
+    a crash between the renames is healed by _recover (both survivor
+    shapes: complete .tmp adopted, else .old rolled back)."""
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(7, {"w": np.zeros(3)})
+    mgr.save(7, {"w": np.ones(3)})  # overwrite same step
+    np.testing.assert_array_equal(mgr.restore(7)["w"], 1.0)
+    assert not (tmp_path / "step_7.old").exists()
+
+    # crash shape 1: aside exists, no final, no tmp -> old copy re-adopted
+    os.rename(tmp_path / "step_7", tmp_path / "step_7.old")
+    m2 = CheckpointManager(tmp_path, async_save=False)
+    assert m2.all_steps() == [7]
+    np.testing.assert_array_equal(m2.restore(7)["w"], 1.0)
+
+    # crash shape 2: aside + complete tmp -> the NEW bytes win
+    mgr3 = CheckpointManager(tmp_path / "b", async_save=False)
+    mgr3.save(9, {"w": np.zeros(2)})
+    os.rename(tmp_path / "b" / "step_9", tmp_path / "b" / "step_9.old")
+    import shutil
+    shutil.copytree(tmp_path / "b" / "step_9.old",
+                    tmp_path / "b" / "step_9.tmp")
+    m4 = CheckpointManager(tmp_path / "b", async_save=False)
+    assert m4.all_steps() == [9]
+    assert not (tmp_path / "b" / "step_9.tmp").exists()
+
+    # crash shape 3: aside + TORN tmp (state file but no manifest, i.e.
+    # killed mid-write) -> the good old copy must win, not the torn bytes
+    mgr5 = CheckpointManager(tmp_path / "c", async_save=False)
+    mgr5.save(4, {"w": np.full(2, 5.0)})
+    os.rename(tmp_path / "c" / "step_4", tmp_path / "c" / "step_4.old")
+    os.makedirs(tmp_path / "c" / "step_4.tmp")
+    (tmp_path / "c" / "step_4.tmp" / "state.pdckpt").write_bytes(b"torn")
+    m6 = CheckpointManager(tmp_path / "c", async_save=False)
+    np.testing.assert_array_equal(m6.restore(4)["w"], 5.0)
+
+
+def test_ckpt_prune_and_all_steps_tolerate_races(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last_n=2, async_save=False)
+    for s in range(4):
+        mgr.save(s, {"x": np.zeros(2)})
+    assert mgr.all_steps() == [2, 3]
+    # concurrent deletion between listdir and rmtree: losing the race is ok
+    import shutil
+    shutil.rmtree(tmp_path / "step_2")
+    mgr._prune()
+    assert mgr.all_steps() == [3]
+    # directory swept away entirely
+    gone = CheckpointManager(tmp_path / "gone", async_save=False)
+    shutil.rmtree(tmp_path / "gone")
+    assert gone.all_steps() == []
+    assert gone.latest_step() is None
+
+
+def test_ckpt_sigterm_preemption_hook(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    state = {"w": np.full(3, 9.0), "step": 11}
+    mgr.install_preemption_hook(lambda: state, step_fn=lambda: 11)
+    try:
+        with pytest.raises(Preempted, match="flushed"):
+            signal.raise_signal(signal.SIGTERM)
+    finally:
+        mgr.remove_preemption_hook()
+    assert signal.getsignal(signal.SIGTERM) is prev_handler
+    assert mgr.preempted
+    got = mgr.restore(11)
+    np.testing.assert_array_equal(got["w"], 9.0)
+    assert ckpt_counters()["preempt_saves"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# GradScaler double-unscale guard
+# ---------------------------------------------------------------------------
+
+
+def test_gradscaler_second_unscale_is_noop_until_update():
+    from paddle_tpu.amp import GradScaler
+    paddle.seed(5)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    scaler = GradScaler(init_loss_scaling=2.0 ** 8)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+    def one_step(double_unscale):
+        opt.clear_grad()
+        out = net(x)
+        loss = (out * out).mean()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
+        if double_unscale:
+            scaler.unscale_(opt)  # must NOT divide by the scale again
+        g = {p.name: np.asarray(p._grad._data) for p in net.parameters()}
+        scaler.step(opt)  # internal unscale_ is also a no-op now
+        scaler.update()
+        return g
+
+    g1 = one_step(double_unscale=False)
+    g2 = one_step(double_unscale=True)
+    # same weights moved identically => second step's grads are the honest
+    # once-unscaled grads of the updated net, not double-divided
+    assert all(np.isfinite(v).all() for v in g2.values())
+    for k in g1:
+        assert not np.allclose(g2[k], g1[k] / 2.0 ** 8)
+    # update() re-arms: the next step unscales exactly once again
+    g3 = one_step(double_unscale=False)
+    assert all(np.abs(v).max() < 1e3 for v in g3.values())
+
+
+def test_gradscaler_rearms_on_next_scale_without_update():
+    """Loops that call unscale_ + optimizer.step() directly (no
+    scaler.step()/update()) must still unscale once EVERY iteration: the
+    next scale() opens a new step."""
+    from paddle_tpu.amp import GradScaler
+    paddle.seed(6)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.01, parameters=net.parameters())
+    scaler = GradScaler(init_loss_scaling=2.0 ** 10)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    grads = []
+    for _ in range(2):
+        opt.clear_grad()
+        out = net(x)
+        loss = (out * out).mean()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
+        grads.append({p.name: np.asarray(p._grad._data)
+                      for p in net.parameters()})
+        opt.step()  # no scaler.update(): iteration 2 must still unscale
+    for k in grads[1]:
+        assert np.abs(grads[1][k]).max() < 1e3, k  # not scale-inflated
+
+
+# ---------------------------------------------------------------------------
+# DataLoader: timeout + position state
+# ---------------------------------------------------------------------------
+
+
+class _StuckDataset:
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 4:
+            time.sleep(30)
+        return np.zeros(2, np.float32)
+
+
+def test_dataloader_timeout_raises_on_stuck_worker():
+    dl = DataLoader(_StuckDataset(), batch_size=2, num_workers=1,
+                    timeout=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="timeout"):
+        for _ in dl:
+            pass
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_dataloader_timeout_zero_still_waits():
+    class Slow:
+        def __len__(self):
+            return 2
+
+        def __getitem__(self, i):
+            time.sleep(0.2)
+            return np.zeros(2, np.float32)
+
+    dl = DataLoader(Slow(), batch_size=1, num_workers=1, timeout=0)
+    assert len(list(dl)) == 2
+    with pytest.raises(ValueError, match="timeout"):
+        DataLoader(Slow(), batch_size=1, timeout=-1)
+
+
+def test_dataloader_position_state_skips_without_fetching():
+    fetched = []
+
+    class Tracking:
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            fetched.append(i)
+            return np.float32(i)
+
+    dl = DataLoader(Tracking(), batch_size=2)
+    seen = []
+    for i, b in enumerate(dl):
+        seen.append(np.asarray(b._data).tolist())
+        if i == 2:
+            st = dl.state_dict()
+            assert st == {"batches_served": 3}
+    fetched.clear()
+    dl2 = DataLoader(Tracking(), batch_size=2)
+    dl2.load_state_dict(st)
+    rest = [np.asarray(b._data).tolist() for b in dl2]
+    assert rest == seen[3:]
+    assert min(fetched) >= 6  # skipped prefix fetched nothing
+    # the skip is one-shot: the next epoch starts from the top
+    assert len(list(dl2)) == 6
+
+
+def test_dataloader_position_state_iterable_dataset():
+    from paddle_tpu.io import IterableDataset
+
+    class Stream(IterableDataset):
+        def __iter__(self):
+            return iter(np.arange(10, dtype=np.float32))
+
+    dl = DataLoader(Stream(), batch_size=2)
+    dl.load_state_dict({"batches_served": 3})
+    got = [np.asarray(b._data).tolist() for b in dl]
+    assert got == [[6.0, 7.0], [8.0, 9.0]]
+
+
+# ---------------------------------------------------------------------------
+# elastic seed classes (previously untested semantics)
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_agent_max_restarts_boundary(tmp_path):
+    """Exactly max_restarts failures then success -> run() completes and
+    the budget is fully spent; one more failure would give up."""
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    calls = {"n": 0}
+
+    def flaky(state, start_step):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError(f"boom {calls['n']}")
+        return "done"
+
+    agent = elastic.ElasticAgent(flaky, mgr, max_restarts=2)
+    assert agent.run() == "done"
+    assert agent.restarts == 2 and calls["n"] == 3
+
+
+def test_elastic_agent_preemption_is_not_a_restart(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+
+    def preempted(state, start_step):
+        raise fi.Preemption("scheduler said goodbye")
+
+    agent = elastic.ElasticAgent(preempted, mgr, max_restarts=5)
+    with pytest.raises(fi.Preemption):
+        agent.run()
+    assert agent.restarts == 0  # budget untouched: exit, don't retrain
+
+
+def test_elastic_agent_falls_back_past_corrupt_checkpoint(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(3, {"v": np.float64(3.0)})
+    mgr.save(6, {"v": np.float64(6.0)})
+    (tmp_path / "step_6" / "state.pdckpt").write_bytes(b"rot")
+    crashed = {"done": False}
+
+    def train_fn(state, start_step):
+        if not crashed["done"]:
+            crashed["done"] = True
+            raise RuntimeError("die once")
+        return float(state["v"]), start_step
+
+    agent = elastic.ElasticAgent(train_fn, mgr, max_restarts=1)
+    v, start = agent.run()
+    assert (v, start) == (3.0, 3)  # step 6 quarantined, step 3 adopted
+
+
+def test_elastic_agent_start_step_matches_loaded_state(tmp_path,
+                                                       monkeypatch):
+    """When restore falls back past an unreadable (not corrupt) newest
+    step, the agent's start_step must be the step it ACTUALLY loaded —
+    not latest_step(), which still lists the unreadable one."""
+    from paddle_tpu.incubate import checkpoint as ckpt_mod
+    mgr = CheckpointManager(tmp_path, async_save=False, retries=0)
+    mgr.save(5, {"v": np.float64(5.0)})
+    mgr.save(7, {"v": np.float64(7.0)})
+    real_load = ckpt_mod.fio.load
+
+    def load(path, **kw):
+        if "step_7" in path:
+            raise OSError("EIO")
+        return real_load(path, **kw)
+
+    monkeypatch.setattr(ckpt_mod.fio, "load", load)
+    seen = []
+
+    def train_fn(state, start_step):
+        seen.append((float(state["v"]), start_step))
+        return "ok"
+
+    assert elastic.ElasticAgent(train_fn, mgr).run() == "ok"
+    assert seen == [(5.0, 5)]  # state and step agree
+    assert mgr.all_steps() == [5, 7]  # step 7 kept on disk, not quarantined
+
+
+def test_nanguard_every_n_cadence():
+    guard = elastic.NanGuard(every_n_steps=3)
+    guard(np.array([np.nan]))  # steps 1,2 unchecked
+    guard(np.array([np.nan]))
+    with pytest.raises(elastic.NonFiniteError):
+        guard(np.array([np.nan]))  # step 3 checked
+    guard(np.array([1.0]))  # 4
+    guard(np.array([np.inf]))  # 5
+    with pytest.raises(elastic.NonFiniteError):
+        guard(np.array([np.inf]))  # 6 checked
+
+
+def test_heartbeat_monitor_stale_and_missing(tmp_path):
+    import json
+    # rank 0: stale beat (frozen clock), rank 1: missing file entirely
+    with open(tmp_path / "hb_0.json", "w") as f:
+        json.dump({"ts": time.time() - 60.0, "rank": 0, "step": 5,
+                   "status": "running"}, f)
+    mon = elastic.HeartbeatMonitor(tmp_path, world_size=2, timeout=1.0)
+    assert mon.failed_ranks() == [0, 1]
+    info = mon.poll()
+    assert info[0]["age"] > 50 and info[1] is None
+    # fresh beat clears rank 0
+    elastic.Heartbeat(tmp_path, rank=0).beat(step=6)
+    assert mon.failed_ranks() == [1]
+
+
+def test_all_finite_traceable():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return elastic.all_finite({"a": x, "b": jnp.ones(3),
+                                   "n": jnp.arange(3)})
+
+    assert bool(f(jnp.ones(4)))
+    assert not bool(f(jnp.array([1.0, jnp.nan, 0.0, 2.0])))
+
+
+# ---------------------------------------------------------------------------
+# hapi Model.fit: checkpointed fit with mid-epoch exact resume
+# ---------------------------------------------------------------------------
+
+
+def _fit_model(seed):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.AdamW(0.01, parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=nn.MSELoss(), jit=True)
+    return model
+
+
+def _fit_dataset():
+    rng = np.random.default_rng(0)
+    from paddle_tpu.io import TensorDataset
+    return TensorDataset([
+        paddle.to_tensor(rng.standard_normal((24, 8)).astype(np.float32)),
+        paddle.to_tensor(rng.standard_normal((24, 2)).astype(np.float32))])
+
+
+def test_fit_preempt_and_resume_bitwise_mid_epoch(tmp_path):
+    ds = _fit_dataset()
+    m1 = _fit_model(11)
+    m1.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0)
+    golden = {n: np.asarray(p._data) for n, p in m1.network.named_parameters()}
+
+    # ckpt_freq=5 lands the last save MID epoch 1 (batch 5 of 6); preempt
+    # during epoch 2
+    m2 = _fit_model(11)
+    with pytest.raises(fi.Preemption):
+        with fi.inject(fi.FaultPlan(preempt_at_step=8)):
+            m2.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0,
+                   ckpt_dir=tmp_path, ckpt_freq=5)
+
+    m3 = _fit_model(11)  # fresh "process": different live weights until load
+    m3.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0,
+           ckpt_dir=tmp_path, ckpt_freq=5, resume=True)
+    resumed = {n: np.asarray(p._data)
+               for n, p in m3.network.named_parameters()}
+    for n in golden:
+        np.testing.assert_array_equal(golden[n], resumed[n]), n
+
+
+def test_fit_sigterm_deferred_flush_and_resume_bitwise(tmp_path):
+    """SIGTERM during fit defers to the next batch boundary: the handler
+    only marks preempted, the loop flushes a CONSISTENT snapshot (weights,
+    RNG, position from the same boundary) and raises Preempted; the resumed
+    run stays bitwise on the golden trajectory."""
+    from paddle_tpu.incubate.checkpoint import Preempted
+    ds = _fit_dataset()
+    m1 = _fit_model(13)
+    m1.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0)
+    golden = {n: np.asarray(p._data) for n, p in m1.network.named_parameters()}
+
+    m2 = _fit_model(13)
+    fired = {"n": 0}
+
+    class Arm:  # raise SIGTERM from a callback: lands mid-loop like a real one
+        def on_train_batch_end(self, *a, **k):
+            fired["n"] += 1
+            if fired["n"] == 7:
+                signal.raise_signal(signal.SIGTERM)
+
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    with pytest.raises(Preempted, match="flushed"):
+        m2.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0,
+               ckpt_dir=tmp_path, callbacks=[Arm()])
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL  # hook removed
+
+    m3 = _fit_model(13)
+    m3.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0,
+           ckpt_dir=tmp_path, resume=True)
+    resumed = {n: np.asarray(p._data)
+               for n, p in m3.network.named_parameters()}
+    for n in golden:
+        np.testing.assert_array_equal(golden[n], resumed[n]), n
+
+
+def test_fit_resume_from_epoch_final_save_rolls_to_next_epoch(tmp_path):
+    """A checkpoint taken at the last batch of an epoch resumes INTO the
+    next epoch — no empty-epoch replay re-firing on_epoch_end/eval."""
+    ds = _fit_dataset()  # 24 samples / batch 4 = 6 batches per epoch
+    m1 = _fit_model(17)
+    m1.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0)
+    golden = {n: np.asarray(p._data) for n, p in m1.network.named_parameters()}
+
+    m2 = _fit_model(17)
+    with pytest.raises(fi.Preemption):
+        with fi.inject(fi.FaultPlan(preempt_at_step=8)):
+            # ckpt_freq=6 == epoch length: last save is the epoch-1 final
+            m2.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0,
+                   ckpt_dir=tmp_path, ckpt_freq=6)
+    epoch_ends = []
+
+    class Spy:
+        def on_epoch_end(self, epoch, logs=None):
+            epoch_ends.append((epoch, logs))
+
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    m3 = _fit_model(17)
+    m3.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0,
+           ckpt_dir=tmp_path, ckpt_freq=6, resume=True, callbacks=[Spy()])
+    assert [e for e, _ in epoch_ends] == [1]  # epoch 0 NOT replayed empty
+    assert epoch_ends[0][1].get("loss") is not None
+    resumed = {n: np.asarray(p._data)
+               for n, p in m3.network.named_parameters()}
+    for n in golden:
+        np.testing.assert_array_equal(golden[n], resumed[n]), n
+
+
+def test_fit_resume_requires_positional_loader(tmp_path):
+    m = _fit_model(1)
+    gen = iter([])
+    with pytest.raises(ValueError, match="resume"):
+        m.fit(gen, epochs=1, verbose=0, ckpt_dir=tmp_path, resume=True)

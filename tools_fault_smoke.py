@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Fault-tolerance chaos bench: kill-and-resume + anomaly-guard smoke on
+CPU (JAX_PLATFORMS=cpu), exercising the whole recovery stack end to end.
+
+Legs (each seeded, deterministic):
+
+  1. kill-resume     — train an MLP T steps (golden), rerun with a simulated
+                       preemption at a pseudo-random step, resume from the
+                       latest hardened checkpoint, assert the final params
+                       are BITWISE equal to the uninterrupted run
+  2. kill-resume-wus — same under FLAGS_weight_update_sharding + dp=8 mesh
+                       + accumulate_steps=2 (packed dp-sharded slots)
+  3. nan-skip        — poison one batch mid-run under
+                       FLAGS_anomaly_policy=skip; assert the step was
+                       skipped compiled-side (no host sync added) and the
+                       final params are finite
+  4. nan-rollback    — K consecutive poisoned batches under rollback;
+                       assert the step restored the last checkpoint and
+                       training finished finite
+  5. io-chaos        — inject transient OSErrors into checkpoint writes and
+                       corrupt the latest checkpoint on disk; assert saves
+                       retried and restore quarantined + fell back
+
+  python tools_fault_smoke.py [--steps N] [--kill-step K] [--seed S]
+
+Prints, machine-greppable:
+
+  FAULT_SMOKE <leg>: <status>  <details>
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+DEFAULT_FLAGS = {
+    "FLAGS_anomaly_policy": "off",
+    "FLAGS_anomaly_max_bad_steps": 3,
+    "FLAGS_grad_comm": "auto",
+    "FLAGS_weight_update_sharding": False,
+    "FLAGS_allreduce_dtype": "float32",
+}
+
+
+def build_step(paddle, nn, seed, flags=None, mesh=None, k=1):
+    paddle.set_flags(dict(DEFAULT_FLAGS))
+    if flags:
+        paddle.set_flags(flags)
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(32, 64), nn.GELU(), nn.Dropout(0.1),
+                      nn.Linear(64, 8))
+    opt = paddle.optimizer.AdamW(0.01, parameters=m.parameters())
+    return paddle.jit.TrainStep(m, nn.MSELoss(), opt, mesh=mesh,
+                                accumulate_steps=k)
+
+
+def make_data(steps, seed):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((steps, 16, 32)).astype(np.float32),
+            rng.standard_normal((steps, 16, 8)).astype(np.float32))
+
+
+def run(paddle, step, X, Y, lo=0, hi=None):
+    hi = len(X) if hi is None else hi
+    loss = None
+    for i in range(lo, hi):
+        loss = step(paddle.to_tensor(X[i]), paddle.to_tensor(Y[i]))
+    return ({n: np.asarray(a) for n, a in step.params.items()},
+            float(np.asarray(loss.numpy())) if loss is not None else None)
+
+
+def leg_kill_resume(paddle, nn, fi, args, flags=None, mesh_fn=None, k=1,
+                    name="kill-resume"):
+    from paddle_tpu.incubate.checkpoint import CheckpointManager
+    X, Y = make_data(args.steps, args.seed)
+    mesh = mesh_fn() if mesh_fn else None
+    golden, gloss = run(paddle, build_step(paddle, nn, args.seed, flags,
+                                           mesh, k), X, Y)
+
+    # pseudo-random but seeded kill point, at least one checkpoint before it
+    kill = args.kill_step or (3 + int(
+        np.random.default_rng(args.seed).integers(args.steps - 4)))
+    ckpt_dir = tempfile.mkdtemp(prefix="fault_smoke_")
+    try:
+        mesh = mesh_fn() if mesh_fn else None
+        step_a = build_step(paddle, nn, args.seed, flags, mesh, k)
+        mgr = CheckpointManager(ckpt_dir, async_save=False)
+        step_a.attach_checkpoint(mgr, save_every=2)
+        try:
+            with fi.inject(fi.FaultPlan(preempt_at_step=kill)):
+                run(paddle, step_a, X, Y)
+            raise AssertionError("preemption did not fire")
+        except fi.Preemption:
+            pass
+        del step_a
+
+        mesh = mesh_fn() if mesh_fn else None
+        step_b = build_step(paddle, nn, args.seed + 99, flags, mesh, k)
+        step_b.load_state_dict(mgr.restore())
+        resumed, rloss = run(paddle, step_b, X, Y, lo=step_b._step)
+        for n in golden:
+            np.testing.assert_array_equal(golden[n], resumed[n])
+        assert rloss == gloss, (rloss, gloss)  # final loss bitwise too
+        print(f"FAULT_SMOKE {name}: OK  killed@{kill} "
+              f"resumed@{mgr.latest_step()} steps={args.steps} "
+              f"final-loss={rloss:.6f} (golden {gloss:.6f}) bitwise-equal")
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def leg_nan_skip(paddle, nn, fi, args):
+    from paddle_tpu.jit.train_step import (anomaly_counters,
+                                           reset_anomaly_counters)
+    X, Y = make_data(args.steps, args.seed)
+    reset_anomaly_counters()
+    step = build_step(paddle, nn, args.seed,
+                      {"FLAGS_anomaly_policy": "skip"})
+    poison = args.steps // 2
+    with fi.inject(fi.FaultPlan(nan_at_steps=[poison])):
+        params, loss = run(paddle, step, X, Y)
+    c = anomaly_counters()
+    assert c["bad_steps"] == 1 and c["skipped_updates"] == 1, c
+    assert c["host_syncs"] == c["steps"], c  # zero extra syncs
+    assert all(np.isfinite(v).all() for v in params.values())
+    print(f"FAULT_SMOKE nan-skip: OK  poisoned@{poison} "
+          f"skipped=1 host-syncs={c['host_syncs']}/{c['steps']} "
+          f"final-loss={loss:.6f}")
+
+
+def leg_nan_rollback(paddle, nn, fi, args):
+    from paddle_tpu.incubate.checkpoint import CheckpointManager
+    from paddle_tpu.jit.train_step import (anomaly_counters,
+                                           reset_anomaly_counters)
+    X, Y = make_data(args.steps, args.seed)
+    reset_anomaly_counters()
+    step = build_step(paddle, nn, args.seed,
+                      {"FLAGS_anomaly_policy": "rollback",
+                       "FLAGS_anomaly_max_bad_steps": 2})
+    ckpt_dir = tempfile.mkdtemp(prefix="fault_smoke_")
+    try:
+        mgr = CheckpointManager(ckpt_dir, async_save=False)
+        step.attach_checkpoint(mgr, save_every=2)
+        p = args.steps // 2
+        with fi.inject(fi.FaultPlan(nan_at_steps=[p, p + 1])):
+            params, loss = run(paddle, step, X, Y)
+        c = anomaly_counters()
+        assert c["rollbacks"] == 1, c
+        assert all(np.isfinite(v).all() for v in params.values())
+        print(f"FAULT_SMOKE nan-rollback: OK  poisoned@{p},{p + 1} "
+              f"rollbacks=1 final-loss={loss:.6f}")
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def leg_io_chaos(paddle, fi, args):
+    from paddle_tpu.incubate.checkpoint import (CheckpointManager,
+                                                ckpt_counters)
+    ckpt_dir = tempfile.mkdtemp(prefix="fault_smoke_")
+    try:
+        before = ckpt_counters()
+        mgr = CheckpointManager(ckpt_dir, async_save=False, retries=3,
+                                retry_backoff=0.01)
+        with fi.inject(fi.FaultPlan(io_error_on_writes=[1, 3])):
+            mgr.save(1, {"w": np.arange(16.0), "step": 1})
+            mgr.save(2, {"w": np.full(16, 2.0), "step": 2})
+        retries = ckpt_counters()["save_retries"] - before["save_retries"]
+        # rot the newest step on disk
+        with open(os.path.join(ckpt_dir, "step_2", "state.pdckpt"),
+                  "r+b") as f:
+            f.seek(-8, 2)
+            f.write(b"\x00" * 8)
+        got = mgr.restore()
+        assert int(got["step"]) == 1, got
+        quarantined = (ckpt_counters()["quarantined"]
+                       - before["quarantined"])
+        assert quarantined == 1
+        print(f"FAULT_SMOKE io-chaos: OK  transient-errors=2 "
+              f"retries={retries} corrupt-quarantined={quarantined} "
+              f"fell-back-to=step_1")
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--kill-step", type=int, default=0,
+                    help="fixed kill point (default: seeded random)")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.utils import fault_injection as fi
+
+    leg_kill_resume(paddle, nn, fi, args)
+    leg_kill_resume(
+        paddle, nn, fi, args,
+        flags={"FLAGS_grad_comm": "on", "FLAGS_weight_update_sharding": True},
+        mesh_fn=lambda: dist_env.create_hybrid_mesh(dp=8), k=2,
+        name="kill-resume-wus")
+    dist_env.set_mesh(None)
+    leg_nan_skip(paddle, nn, fi, args)
+    leg_nan_rollback(paddle, nn, fi, args)
+    leg_io_chaos(paddle, fi, args)
+    paddle.set_flags(dict(DEFAULT_FLAGS))
+    print("FAULT_SMOKE all: OK")
+
+
+if __name__ == "__main__":
+    main()
