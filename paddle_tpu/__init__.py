@@ -60,6 +60,7 @@ from . import sparse  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+from . import serving  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401

@@ -58,6 +58,21 @@ _FLAGS = {
     "FLAGS_anomaly_policy": "off",
     # Consecutive bad steps tolerated under "rollback" before restoring.
     "FLAGS_anomaly_max_bad_steps": 3,
+    # -- continuous-batching serving engine (serving/engine.py) -------------
+    # Decode-batch slot count B: the fixed batch dim of the pooled KV cache
+    # and the one-token decode executable. More slots = more requests decoded
+    # per iteration (throughput) at B x Smax x L x H KV memory.
+    "FLAGS_serving_slots": 8,
+    # KV pool sequence capacity Smax per slot; 0 = the model's max_seq_len.
+    # Every request needs prompt_len + max_new_tokens <= Smax.
+    "FLAGS_serving_max_seq_len": 0,
+    # Prefill length buckets: a prompt is right-padded to the smallest
+    # bucket that holds it, so steady state compiles ONE prefill executable
+    # per bucket instead of one per prompt length. Buckets above Smax clamp.
+    "FLAGS_serving_prefill_buckets": (64, 256, 1024),
+    # Wait-queue bound: submit() past this raises QueueFullError — the
+    # backpressure signal a frontend turns into HTTP 429 / retry-after.
+    "FLAGS_serving_max_queue": 256,
     # Ring-decomposed compute/communication overlap on the mp axis: the
     # pre-QKV/FFN all-gather splits into mp-1 ppermute hops with each
     # chunk's GEMM issued on arrival, and the RowParallel GEMM emits

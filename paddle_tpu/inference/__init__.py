@@ -349,6 +349,55 @@ class Predictor:
         if not hasattr(self, "_outputs"):
             raise RuntimeError("call run()/run_handles() first")
 
+    # -- serving handoff ----------------------------------------------------
+    def serve(self, gpt_config, **engine_kwargs):
+        """Hand this artifact's weights to the continuous-batching engine
+        (`paddle_tpu.serving.Engine`). The exported StableHLO is a
+        whole-sequence forward — the wrong program for token-at-a-time
+        serving — so `serve()` rebuilds the functional GPT param tree from
+        the artifact's weight dict instead (artifact must be a
+        GPTForCausalLM export; `gpt_config` is its GPTConfig)."""
+        params = _gpt_functional_params(self._params, gpt_config)
+        from ..serving import Engine
+        return Engine(params=params, config=gpt_config, **engine_kwargs)
+
+
+def _gpt_functional_params(named, config):
+    """Predictor weight dict (capture_params qualified names) -> the
+    functional layout generation/serving consume (init_gpt_params)."""
+    import jax.numpy as jnp
+    need = ["gpt.wte.weight", "gpt.wpe.weight",
+            "gpt.ln_f.weight", "gpt.ln_f.bias"]
+    if any(k not in named for k in need):
+        raise ValueError(
+            "artifact is not a GPTForCausalLM export (missing gpt.* "
+            "weights); serve() only maps the GPT family")
+    from ..models.gpt import BLOCK_PARAM_PATHS
+    L = config.num_layers
+    blocks = {k: jnp.stack([jnp.asarray(named[f"gpt.h.{i}.{suffix}"])
+                            for i in range(L)])
+              for k, suffix in BLOCK_PARAM_PATHS.items()}
+    head = (jnp.asarray(named["lm_head.weight"])
+            if "lm_head.weight" in named
+            else jnp.asarray(named["gpt.wte.weight"]).T)
+    return {
+        "wte": jnp.asarray(named["gpt.wte.weight"]),
+        "wpe": jnp.asarray(named["gpt.wpe.weight"]),
+        "lnf_g": jnp.asarray(named["gpt.ln_f.weight"]),
+        "lnf_b": jnp.asarray(named["gpt.ln_f.bias"]),
+        "head_w": head,
+        "blocks": blocks,
+    }
+
+
+def serve(model=None, *, params=None, config=None, **engine_kwargs):
+    """Build a continuous-batching serving engine
+    (`paddle_tpu.serving.Engine`) from a GPTForCausalLM Layer or a
+    functional param tree — the deploy entry point once a model graduates
+    from single-shot `Predictor.run` to request traffic."""
+    from ..serving import Engine
+    return Engine(model, params=params, config=config, **engine_kwargs)
+
 
 def load_inference_model(path_prefix):
     return Predictor(path_prefix)

@@ -56,9 +56,23 @@ def _layer_cached(p, h, kc, vc, start, nh, eps):
         kc, vc
 
 
-def _forward_cached(params, config, ids, kc, vc, start):
+def _final_logits(params, config, xlast):
+    """Final LN (fp32) + LM head over last-position hidden states [B,H]."""
+    xf = xlast.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    xn = (xf - mu) * jax.lax.rsqrt(var + config.layer_norm_epsilon)
+    xn = xn * params["lnf_g"].astype(jnp.float32) + \
+        params["lnf_b"].astype(jnp.float32)
+    return xn @ params["head_w"].astype(jnp.float32)
+
+
+def _forward_cached(params, config, ids, kc, vc, start, last_index=None):
     """ids [B,T] at absolute positions [start, start+T); returns logits of
-    the LAST position [B,V] and the updated cache."""
+    the LAST position [B,V] and the updated cache. ``last_index`` (traced
+    scalar) selects which position's logits to return instead of T-1 — the
+    serving engine prefills prompts right-padded to a bucket length and
+    reads logits at the true last prompt token."""
     compute = jnp.dtype(config.compute_dtype or "float32")
     B, T = ids.shape
     pos = start + jnp.arange(T)
@@ -73,33 +87,116 @@ def _forward_cached(params, config, ids, kc, vc, start):
         return h, (kc_l, vc_l)
 
     x, (kc, vc) = jax.lax.scan(layer_fn, x, (params["blocks"], kc, vc))
-    xf = x[:, -1].astype(jnp.float32)
-    mu = jnp.mean(xf, -1, keepdims=True)
-    var = jnp.var(xf, -1, keepdims=True)
-    xn = (xf - mu) * jax.lax.rsqrt(var + config.layer_norm_epsilon)
-    xn = xn * params["lnf_g"].astype(jnp.float32) + \
-        params["lnf_b"].astype(jnp.float32)
-    logits = xn @ params["head_w"].astype(jnp.float32)
-    return logits, kc, vc
+    if last_index is None:
+        xlast = x[:, -1]
+    else:
+        xlast = jax.lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)[:, 0]
+    return _final_logits(params, config, xlast), kc, vc
+
+
+def _layer_decode_slots(p, h, kc, vc, pos, nh, eps):
+    """One transformer block over h [B,1,H] where each batch row is an
+    independent serving SLOT at its own absolute position pos[b]. KV is
+    scattered row-wise at pos[b]; attention masks keys per slot
+    (key_pos <= pos[b]). Math mirrors _layer_cached exactly so a slot's
+    token stream is bitwise identical to single-request decode."""
+    B, T, H = h.shape
+    d = H // nh
+
+    def ln(x, g, b):
+        return ln_fp32(x, g, b, eps)
+
+    h1 = ln(h, p["ln1_g"], p["ln1_b"])
+    qkv = h1 @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+    q, k, v = jnp.split(qkv.reshape(B, T, 3, nh, d), 3, axis=2)
+    q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+    rows = jnp.arange(B)
+    kc = kc.at[rows, pos].set(k[:, 0].astype(kc.dtype))
+    vc = vc.at[rows, pos].set(v[:, 0].astype(vc.dtype))
+    Smax = kc.shape[1]
+    mask = jnp.arange(Smax)[None, :] <= pos[:, None]          # [B, Smax]
+    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        kc.astype(jnp.float32)) / (d ** 0.5)
+    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhts,bshd->bthd", probs,
+                     vc.astype(jnp.float32)).astype(h.dtype)
+    attn = ctx.reshape(B, T, H) @ p["out_w"].astype(h.dtype) + \
+        p["out_b"].astype(h.dtype)
+    h = h + attn
+    h2 = ln(h, p["ln2_g"], p["ln2_b"])
+    up = h2 @ p["up_w"].astype(h.dtype) + p["up_b"].astype(h.dtype)
+    up = jax.nn.gelu(up, approximate=True)
+    return h + up @ p["down_w"].astype(h.dtype) + p["down_b"].astype(h.dtype), \
+        kc, vc
+
+
+def _forward_decode_slots(params, config, tok, kc, vc, pos):
+    """One decode step over B independent slots: tok [B] is each slot's
+    last token, fed at absolute position pos[b]. Returns logits [B,V] and
+    the updated cache [L,B,Smax,nh,d]."""
+    compute = jnp.dtype(config.compute_dtype or "float32")
+    x = params["wte"].astype(compute)[tok[:, None]] + \
+        jnp.take(params["wpe"].astype(compute), pos, axis=0)[:, None]
+    nh = config.num_heads
+
+    def layer_fn(h, xs):
+        p_l, kc_l, vc_l = xs
+        h, kc_l, vc_l = _layer_decode_slots(p_l, h, kc_l, vc_l, pos, nh,
+                                            config.layer_norm_epsilon)
+        return h, (kc_l, vc_l)
+
+    x, (kc, vc) = jax.lax.scan(layer_fn, x, (params["blocks"], kc, vc))
+    return _final_logits(params, config, x[:, 0]), kc, vc
+
+
+def _mask_logits(logits, temperature, top_k, top_p):
+    """Sampling logits transform: temperature scale, static top-k cut,
+    nucleus (top-p) cut. temperature/top_p are TRACED operands (scalar or
+    per-row [B] — sweeping them never recompiles); top_k stays static (it
+    changes the top_k kernel's shape). top_p=None skips the nucleus branch
+    structurally (the old static `top_p in (None, 1.0)` contract)."""
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    if getattr(t, "ndim", 0) == logits.ndim - 1:
+        t = t[..., None]
+    logits = logits / t
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        p = jnp.asarray(top_p, jnp.float32)
+        if getattr(p, "ndim", 0) == logits.ndim - 1:
+            p = p[..., None]
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < p          # always keeps the top token
+        # p >= 1.0 must keep EVERY token (a traced 1.0 stands in for
+        # "no nucleus cut" — the serving engine's per-slot top_p=None):
+        # float32 cumsum saturates at 1.0 before the tail, so without this
+        # the comparison would mask tiny-probability tail tokens and break
+        # bitwise parity with the structural top_p=None skip.
+        keep_sorted = keep_sorted | (p >= 1.0)
+        inv = jnp.argsort(sort_idx, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    return logits
 
 
 def _select_token(logits, key, do_sample, temperature, top_k, top_p):
     if not do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / jnp.maximum(temperature, 1e-6)
-    if top_k is not None and top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p is not None and top_p < 1.0:
-        sort_idx = jnp.argsort(-logits, axis=-1)
-        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        keep_sorted = (cum - probs) < top_p      # always keeps the top token
-        inv = jnp.argsort(sort_idx, axis=-1)
-        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
-        logits = jnp.where(keep, logits, -jnp.inf)
-    return jax.random.categorical(key, logits).astype(jnp.int32)
+    return jax.random.categorical(
+        key, _mask_logits(logits, temperature, top_k, top_p)).astype(jnp.int32)
+
+
+def _is_stop(tok, stop_token_ids):
+    """Elementwise membership of tok in the static stop-id tuple."""
+    hit = tok == stop_token_ids[0]
+    for s in stop_token_ids[1:]:
+        hit = hit | (tok == s)
+    return hit
 
 
 def _cfg_view(cfg):
@@ -119,10 +216,18 @@ def _alloc_cache(config, rows, total):
     return jnp.zeros(shape, compute), jnp.zeros(shape, compute)
 
 
+# number of times _generate_jit has actually been TRACED (the body runs
+# only on a cache miss) — the no-recompile evidence for traced sampling
+# params. Tests measure deltas across sampling-config sweeps.
+_gen_traces = 0
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "do_sample",
-                                   "top_k", "top_p", "eos_token_id"))
+                                   "top_k", "stop_token_ids"))
 def _generate_jit(params, ids, key, *, cfg, max_new_tokens, do_sample,
-                  temperature, top_k, top_p, eos_token_id):
+                  temperature, top_k, top_p, stop_token_ids):
+    global _gen_traces
+    _gen_traces += 1
     config = _cfg_view(cfg)
     B, P = ids.shape
     total = P + max_new_tokens
@@ -131,8 +236,8 @@ def _generate_jit(params, ids, key, *, cfg, max_new_tokens, do_sample,
     logits, kc, vc = _forward_cached(params, config, ids, kc, vc, 0)
     key, sub = jax.random.split(key)
     tok = _select_token(logits, sub, do_sample, temperature, top_k, top_p)
-    finished = jnp.zeros((B,), bool) if eos_token_id is None else \
-        (tok == eos_token_id)
+    finished = jnp.zeros((B,), bool) if stop_token_ids is None else \
+        _is_stop(tok, stop_token_ids)
 
     def step(carry, i):
         kc, vc, tok, finished, key = carry
@@ -141,9 +246,9 @@ def _generate_jit(params, ids, key, *, cfg, max_new_tokens, do_sample,
         logits, kc, vc = _forward_cached(params, config, tok[:, None],
                                          kc, vc, P + i)
         nxt = _select_token(logits, sub, do_sample, temperature, top_k, top_p)
-        if eos_token_id is not None:
-            nxt = jnp.where(finished, eos_token_id, nxt)
-            finished = finished | (nxt == eos_token_id)
+        if stop_token_ids is not None:
+            nxt = jnp.where(finished, stop_token_ids[0], nxt)
+            finished = finished | _is_stop(nxt, stop_token_ids)
         return (kc, vc, nxt, finished, key), tok
 
     (kc, vc, last, finished, key), toks = jax.lax.scan(
@@ -224,38 +329,102 @@ def _beam_search_jit(params, ids, *, cfg, max_new_tokens, num_beams,
     return jnp.concatenate([ids, best_seq], axis=1)
 
 
+def _normalize_stop(eos_token_id, stop_token_ids):
+    """Merge the scalar eos alias with the stop-id list into one static
+    tuple (eos first: it doubles as the pad id for finished rows, keeping
+    the scalar form's output bitwise unchanged). Returns None when no stop
+    condition was requested."""
+    ids = []
+    if eos_token_id is not None:
+        ids.append(int(eos_token_id))
+    if stop_token_ids is not None:
+        if isinstance(stop_token_ids, (int, jnp.integer)):
+            stop_token_ids = [stop_token_ids]
+        for s in stop_token_ids:
+            if int(s) not in ids:
+                ids.append(int(s))
+    return tuple(ids) if ids else None
+
+
+def _collect_params(model):
+    """GPTForCausalLM Layer -> the functional param layout
+    (models/gpt_hybrid.py init_gpt_params)."""
+    from .gpt import stack_block_params
+    gpt = model.gpt
+    head_w = (gpt.wte.weight._data.T if model.lm_head is None
+              else model.lm_head.weight._data)
+    return {
+        "wte": gpt.wte.weight._data,
+        "wpe": gpt.wpe.weight._data,
+        "lnf_g": gpt.ln_f.weight._data,
+        "lnf_b": gpt.ln_f.bias._data,
+        "head_w": head_w,
+        "blocks": stack_block_params(model),
+    }
+
+
+def _cfg_key(config):
+    return (config.num_heads, config.num_layers, config.hidden_size,
+            config.layer_norm_epsilon, config.compute_dtype)
+
+
+def _logical_qkv(params, config):
+    """Undo HybridTrainStep's head-major qkv storage (config.qkv_head_major,
+    set under sequence parallelism — see tp_overlap.to_qkv_head_major).
+    Decode always splits qkv as [3, nh, d], so head-major blocks must be
+    permuted back to the logical layout or q/k/v columns interleave into
+    the wrong heads. Pure relabeling, bitwise identical. Runs once per
+    generate_from_params CALL (amortized over the whole decode); for
+    repeated-generation loops pre-permute once — or use the serving
+    Engine, which does this at construction."""
+    if not getattr(config, "qkv_head_major", False):
+        return params
+    from ..distributed.tp_overlap import qkv_head_major_perm
+    import numpy as np
+    inv = np.argsort(qkv_head_major_perm(config.hidden_size,
+                                         config.num_heads))
+    blocks = dict(params["blocks"])
+    blocks["qkv_w"] = jnp.asarray(blocks["qkv_w"])[..., inv]
+    blocks["qkv_b"] = jnp.asarray(blocks["qkv_b"])[..., inv]
+    return {**params, "blocks": blocks}
+
+
 def generate_from_params(params, input_ids, config, max_new_tokens=32,
                          do_sample=False, temperature=1.0, top_k=None,
-                         top_p=None, eos_token_id=None, seed=0):
+                         top_p=None, eos_token_id=None, seed=0,
+                         stop_token_ids=None):
     """Generate from a FUNCTIONAL param tree (models/gpt_hybrid.py
     init_gpt_params layout) — the public decode entry for params produced
-    by HybridTrainStep / the Engine, no Layer required."""
+    by HybridTrainStep / the serving Engine, no Layer required."""
     from ..tensor_impl import Tensor
     ids = jnp.asarray(input_ids._data if isinstance(input_ids, Tensor)
                       else input_ids, jnp.int32)
+    if max_new_tokens < 1:
+        if max_new_tokens == 0:
+            return Tensor(ids)
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     assert ids.shape[1] + max_new_tokens <= config.max_seq_len, \
         "prompt + max_new_tokens exceeds config.max_seq_len (wpe table)"
-    cfg_key = (config.num_heads, config.num_layers, config.hidden_size,
-               config.layer_norm_epsilon, config.compute_dtype)
-    out = _generate_jit(params, ids, jax.random.key(seed), cfg=cfg_key,
+    params = _logical_qkv(params, config)
+    out = _generate_jit(params, ids, jax.random.key(seed), cfg=_cfg_key(config),
                         max_new_tokens=int(max_new_tokens),
                         do_sample=bool(do_sample),
                         temperature=float(temperature),
                         top_k=None if top_k in (None, 0)
                         else min(int(top_k), config.vocab_size),
                         top_p=None if top_p in (None, 1.0) else float(top_p),
-                        eos_token_id=eos_token_id)
+                        stop_token_ids=_normalize_stop(eos_token_id,
+                                                       stop_token_ids))
     return Tensor(out)
 
 
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
-             seed=0, num_beams=1, length_penalty=1.0):
+             seed=0, num_beams=1, length_penalty=1.0, stop_token_ids=None):
     """Generate from a GPTForCausalLM Layer. Collects its weights into the
     functional layout (models/gpt_hybrid.py init_gpt_params) and runs the
     single-program decode above."""
     from ..tensor_impl import Tensor
-    from .gpt import stack_block_params
     config = model.config
     ids = jnp.asarray(input_ids._data if isinstance(input_ids, Tensor)
                       else input_ids, jnp.int32)
@@ -265,35 +434,28 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     assert ids.shape[1] + max_new_tokens <= config.max_seq_len, \
         "prompt + max_new_tokens exceeds config.max_seq_len (wpe table)"
-    gpt = model.gpt
-    head_w = (gpt.wte.weight._data.T if model.lm_head is None
-              else model.lm_head.weight._data)
-    params = {
-        "wte": gpt.wte.weight._data,
-        "wpe": gpt.wpe.weight._data,
-        "lnf_g": gpt.ln_f.weight._data,
-        "lnf_b": gpt.ln_f.bias._data,
-        "head_w": head_w,
-        "blocks": stack_block_params(model),
-    }
-    cfg_key = (config.num_heads, config.num_layers, config.hidden_size,
-               config.layer_norm_epsilon, config.compute_dtype)
+    params = _collect_params(model)
+    stop = _normalize_stop(eos_token_id, stop_token_ids)
     if num_beams > 1:
         if do_sample:
             raise ValueError("beam search is deterministic; do_sample=True "
                              "with num_beams > 1 is not supported")
-        out = _beam_search_jit(params, ids, cfg=cfg_key,
+        if stop is not None and len(stop) > 1:
+            raise NotImplementedError(
+                "beam search supports a single stop id (the frozen-beam "
+                "rewrite needs one pad token); pass eos_token_id only")
+        out = _beam_search_jit(params, ids, cfg=_cfg_key(config),
                                max_new_tokens=int(max_new_tokens),
                                num_beams=int(num_beams),
                                length_penalty=float(length_penalty),
-                               eos_token_id=eos_token_id)
+                               eos_token_id=None if stop is None else stop[0])
         return Tensor(out)
-    out = _generate_jit(params, ids, jax.random.key(seed), cfg=cfg_key,
+    out = _generate_jit(params, ids, jax.random.key(seed), cfg=_cfg_key(config),
                         max_new_tokens=int(max_new_tokens),
                         do_sample=bool(do_sample),
                         temperature=float(temperature),
                         top_k=None if top_k in (None, 0)
                         else min(int(top_k), config.vocab_size),
                         top_p=None if top_p in (None, 1.0) else float(top_p),
-                        eos_token_id=eos_token_id)
+                        stop_token_ids=stop)
     return Tensor(out)

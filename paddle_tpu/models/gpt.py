@@ -240,13 +240,14 @@ class GPTForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
-                 seed=0, num_beams=1, length_penalty=1.0):
+                 seed=0, num_beams=1, length_penalty=1.0,
+                 stop_token_ids=None):
         """Single-XLA-program autoregressive decode with a static KV cache;
         num_beams > 1 switches to beam search (see models/generation.py)."""
         from .generation import generate as _generate
         return _generate(self, input_ids, max_new_tokens, do_sample,
                          temperature, top_k, top_p, eos_token_id, seed,
-                         num_beams, length_penalty)
+                         num_beams, length_penalty, stop_token_ids)
 
 
 def gpt_loss_fn(logits, labels):
@@ -307,19 +308,29 @@ def gpt_block_fn(config: GPTConfig):
     return block
 
 
+# functional block-param key -> submodule path inside one GPT block. THE
+# name table: stack_block_params walks it as attributes, inference's
+# _gpt_functional_params as capture_params qualified names ("gpt.h.{i}.{p}")
+# — one place to touch when a block parameter is added or renamed.
+BLOCK_PARAM_PATHS = {
+    "ln1_g": "ln_1.weight", "ln1_b": "ln_1.bias",
+    "qkv_w": "attn.qkv_proj.weight", "qkv_b": "attn.qkv_proj.bias",
+    "out_w": "attn.out_proj.weight", "out_b": "attn.out_proj.bias",
+    "ln2_g": "ln_2.weight", "ln2_b": "ln_2.bias",
+    "up_w": "mlp.up_proj.weight", "up_b": "mlp.up_proj.bias",
+    "down_w": "mlp.down_proj.weight", "down_b": "mlp.down_proj.bias",
+}
+
+
 def stack_block_params(model: GPTForCausalLM):
     """Collect per-block weights from a GPTForCausalLM into stacked arrays
     [L, ...] for the pipeline path."""
     blocks = list(model.gpt.h)
-    names = {
-        "ln1_g": lambda b: b.ln_1.weight, "ln1_b": lambda b: b.ln_1.bias,
-        "qkv_w": lambda b: b.attn.qkv_proj.weight,
-        "qkv_b": lambda b: b.attn.qkv_proj.bias,
-        "out_w": lambda b: b.attn.out_proj.weight,
-        "out_b": lambda b: b.attn.out_proj.bias,
-        "ln2_g": lambda b: b.ln_2.weight, "ln2_b": lambda b: b.ln_2.bias,
-        "up_w": lambda b: b.mlp.up_proj.weight, "up_b": lambda b: b.mlp.up_proj.bias,
-        "down_w": lambda b: b.mlp.down_proj.weight,
-        "down_b": lambda b: b.mlp.down_proj.bias,
-    }
-    return {k: jnp.stack([fn(b)._data for b in blocks]) for k, fn in names.items()}
+
+    def get(b, path):
+        for part in path.split("."):
+            b = getattr(b, part)
+        return b
+
+    return {k: jnp.stack([get(b, p)._data for b in blocks])
+            for k, p in BLOCK_PARAM_PATHS.items()}

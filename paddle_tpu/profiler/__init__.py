@@ -317,6 +317,36 @@ def fault_summary():
             f"preempt-saves: {k['preempt_saves']}")
 
 
+# -- serving counters ---------------------------------------------------------
+# The continuous-batching engine (serving/engine.py) ledgers every request,
+# prefill call, decode iteration and token. prefill_traces/decode_traces are
+# the no-recompile audit trail: each jitted body counts only when actually
+# traced, so after warmup (one prefill per bucket + one decode) the counts
+# freeze — joins, evicts and sampling-param changes must not move them.
+# TTFT/token-latency percentiles, tokens/s, slot occupancy and queue depth
+# are the serving SLO surface.
+
+
+def serving_counters():
+    """Snapshot of the serving-engine counters: request lifecycle
+    (submitted/admitted/completed/expired/rejected), executable calls and
+    traces, tokens_out, ttft_p50/p99, token_latency_p50, tokens_per_s,
+    occupancy, queue depth."""
+    from ..serving import metrics
+    return metrics.serving_counters()
+
+
+def reset_serving_counters():
+    from ..serving import metrics
+    metrics.reset_serving_counters()
+
+
+def serving_summary():
+    """One-line human-readable serving report."""
+    from ..serving import metrics
+    return metrics.serving_summary()
+
+
 def benchmark():
     """Step-timer handle (ref profiler.utils.benchmark)."""
     return _Benchmark()

@@ -1,0 +1,17 @@
+"""paddle_tpu.serving — continuous-batching TPU serving engine.
+
+Iteration-level (Orca-style) scheduling over a fixed B-slot decode batch
+with a pooled KV cache and exactly two steady-state executables (bucketed
+single-sequence prefill + one-token decode over all slots). See engine.py
+for the design; `profiler.serving_counters()` / `serving_summary()` for
+observability.
+"""
+from .request import (  # noqa: F401
+    Request, GenerationResult,
+    QUEUED, RUNNING, FINISHED, STOP, LENGTH, EXPIRED, CANCELLED,
+)
+from .scheduler import Scheduler, QueueFullError  # noqa: F401
+from .engine import Engine  # noqa: F401
+from .metrics import (  # noqa: F401
+    serving_counters, reset_serving_counters, serving_summary,
+)

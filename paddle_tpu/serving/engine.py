@@ -1,0 +1,398 @@
+"""Iteration-level continuous-batching engine (Orca-style) for the GPT
+family, built on the fixed-shape / cached-executable discipline of the
+eager+jit runtime.
+
+Design
+------
+The engine owns a fixed batch of B decode SLOTS backed by one pooled KV
+cache ``[L, B, Smax, nh, d]`` and exactly TWO steady-state executables:
+
+* **prefill** — ONE sequence, prompt right-padded to a length bucket,
+  forwarded with the slot's cache rows sliced out of the pool
+  (`dynamic_slice`), KV written back via `dynamic_update_slice`, logits read
+  at the true last prompt token. One executable per configured bucket; the
+  bucket ladder is static so steady state never sees a new shape.
+* **decode** — one token for ALL B slots at once. Every per-slot quantity
+  that varies across requests — absolute position, active mask, do_sample
+  mask, temperature, top_p, PRNG key — is a TRACED operand, so admission,
+  eviction, slot recycling and sampling-config changes are pure data
+  changes: the executable is reused, never re-traced (`top_k` stays static,
+  it shapes the top_k kernel).
+
+Requests join and leave at step boundaries (continuous batching): a finished
+request's slot is recycled into a prefill for the next queued request while
+the other slots' decode continues undisturbed — each slot's token stream is
+bitwise identical to running that request alone through
+`models.generation.generate_from_params` (greedy; tested).
+
+The host loop fetches each step's B next-tokens (serving must stream tokens
+out anyway) and keeps all scheduling state in numpy; only the KV pool stays
+device-resident (donated back into the next step's executable off-CPU).
+"""
+from __future__ import annotations
+
+import time
+from functools import lru_cache
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..flags import get_flags
+from ..models.generation import (
+    _cfg_key, _cfg_view, _collect_params, _forward_cached,
+    _forward_decode_slots, _logical_qkv, _mask_logits,
+)
+from . import metrics
+from .request import (
+    CANCELLED, EXPIRED, FINISHED, LENGTH, QUEUED, RUNNING, STOP,
+    GenerationResult, Request,
+)
+from .scheduler import QueueFullError, Scheduler
+
+
+# Both builders are memoized on (cfg, top_k, donate): every Engine with the
+# same model config shares ONE jit wrapper, so a rebuilt/second engine reuses
+# the already-compiled executables instead of re-tracing (fast restart). The
+# trace counters are correspondingly GLOBAL — a new engine over warm shapes
+# adds zero traces.
+@lru_cache(maxsize=None)
+def _make_prefill(cfg, top_k, donate):
+    """Build the bucketed single-sequence prefill executable. Distinct
+    bucket lengths arrive as distinct ids shapes -> one trace per bucket."""
+    config = _cfg_view(cfg)
+
+    def fn(params, kc, vc, ids, plen, slot, key_data, do_sample,
+           temperature, top_p):
+        metrics.bump("prefill_traces")  # body runs only when traced
+        kcs = jax.lax.dynamic_slice_in_dim(kc, slot, 1, axis=1)
+        vcs = jax.lax.dynamic_slice_in_dim(vc, slot, 1, axis=1)
+        logits, kcs, vcs = _forward_cached(params, config, ids[None],
+                                           kcs, vcs, 0, last_index=plen - 1)
+        kc = jax.lax.dynamic_update_slice_in_dim(kc, kcs, slot, axis=1)
+        vc = jax.lax.dynamic_update_slice_in_dim(vc, vcs, slot, axis=1)
+        key, sub = jax.random.split(jax.random.wrap_key_data(key_data))
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        sampled = jax.random.categorical(
+            sub, _mask_logits(logits, temperature, top_k, top_p)
+        ).astype(jnp.int32)
+        tok = jnp.where(do_sample, sampled, greedy)[0]
+        return kc, vc, tok, jax.random.key_data(key)
+
+    return jax.jit(fn, donate_argnums=donate)
+
+
+@lru_cache(maxsize=None)
+def _make_decode(cfg, top_k, donate):
+    """Build the one-token decode executable over all B slots."""
+    config = _cfg_view(cfg)
+
+    def fn(params, kc, vc, tok, pos, active, do_sample, temperature, top_p,
+           key_data):
+        metrics.bump("decode_traces")  # body runs only when traced
+        logits, kc, vc = _forward_decode_slots(params, config, tok, kc, vc,
+                                               pos)
+        keys = jax.random.wrap_key_data(key_data)           # [B] keys
+        pair = jax.vmap(jax.random.split)(keys)             # [B, 2] keys
+        subs = pair[:, 1]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        sampled = jax.vmap(jax.random.categorical)(
+            subs, _mask_logits(logits, temperature, top_k, top_p)
+        ).astype(jnp.int32)
+        nxt = jnp.where(do_sample & active, sampled, greedy)
+        return kc, vc, nxt, jax.random.key_data(pair[:, 0])
+
+    return jax.jit(fn, donate_argnums=donate)
+
+
+class Engine:
+    """Continuous-batching serving engine.
+
+    Accepts a ``GPTForCausalLM`` Layer or the functional param tree
+    (``init_gpt_params`` layout, the thing ``HybridTrainStep`` trains), so
+    trained params serve directly::
+
+        eng = serving.Engine(model, num_slots=8)              # from a Layer
+        eng = serving.Engine(params=step.params, config=cfg)  # from params
+
+        eng.submit(serving.Request([1, 2, 3], max_new_tokens=32,
+                                   eos_token_id=50256, on_token=stream_cb))
+        results = eng.run()        # drain queue + slots
+
+    Defaults come from FLAGS_serving_* (flags.py); kwargs override.
+    """
+
+    def __init__(self, model=None, *, params=None, config=None,
+                 num_slots=None, max_seq_len=None, prefill_buckets=None,
+                 max_queue=None, top_k=None):
+        if model is not None:
+            params = _collect_params(model)
+            config = model.config
+        if params is None or config is None:
+            raise ValueError("Engine needs a GPTForCausalLM model, or "
+                             "params= (init_gpt_params layout) + config=")
+        self.config = config
+        # undo head-major qkv storage (sequence-parallel HybridTrainStep)
+        # once at construction — decode splits qkv logically
+        params = _logical_qkv(params, config)
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+
+        flags = get_flags()
+        self.num_slots = int(num_slots or flags.get("FLAGS_serving_slots", 8))
+        self.max_seq_len = int(max_seq_len or
+                               flags.get("FLAGS_serving_max_seq_len", 0) or
+                               config.max_seq_len)
+        if self.max_seq_len > config.max_seq_len:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the model's wpe "
+                f"table ({config.max_seq_len})")
+        buckets = prefill_buckets or flags.get(
+            "FLAGS_serving_prefill_buckets", (64, 256, 1024))
+        buckets = sorted({min(int(b), self.max_seq_len) for b in buckets})
+        self.scheduler = Scheduler(
+            buckets,
+            max_queue=int(max_queue or
+                          flags.get("FLAGS_serving_max_queue", 256)))
+        self.top_k = (None if top_k in (None, 0)
+                      else min(int(top_k), config.vocab_size))
+
+        cfg = _cfg_key(config)
+        donate_ok = jax.default_backend() != "cpu"  # cpu: donation unimplemented
+        self._prefill = _make_prefill(cfg, self.top_k,
+                                      (1, 2) if donate_ok else ())
+        self._decode = _make_decode(cfg, self.top_k,
+                                    (1, 2) if donate_ok else ())
+
+        B = self.num_slots
+        nh = config.num_heads
+        d = config.hidden_size // nh
+        compute = jnp.dtype(config.compute_dtype or "float32")
+        shape = (config.num_layers, B, self.max_seq_len, nh, d)
+        self._kc = jnp.zeros(shape, compute)
+        self._vc = jnp.zeros(shape, compute)
+
+        # host-authoritative per-slot state (numpy; re-uploaded every step —
+        # tiny arrays, and exactly why joins/evicts can never retrace)
+        self._slots = [None] * B          # Request or None
+        self._pos = np.zeros(B, np.int32)       # write position of next token
+        self._tok = np.zeros(B, np.int32)       # last emitted token
+        self._keys = np.zeros((B, 2), np.uint32)
+        self._temp = np.ones(B, np.float32)
+        self._top_p = np.ones(B, np.float32)
+        self._do_sample = np.zeros(B, bool)
+        self._results = {}                # request_id -> GenerationResult
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, request):
+        """Queue a request (FCFS). Raises QueueFullError past max_queue,
+        ValueError for requests the pool can never hold."""
+        if not isinstance(request, Request):
+            request = Request(request)
+        if request.state != QUEUED:
+            # single-use: the max_new_tokens==0 fast path below must not
+            # re-resolve (and re-ledger) an already-finished request
+            raise ValueError(f"request {request.request_id} already "
+                             f"{request.state}; requests are single-use")
+        metrics.bump("submitted")
+        plen = request.prompt_len
+        if plen + request.max_new_tokens > self.max_seq_len:
+            metrics.bump("rejected")
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds the KV pool's "
+                f"max_seq_len ({self.max_seq_len})")
+        if plen > self.scheduler.buckets[-1]:
+            metrics.bump("rejected")
+            raise ValueError(
+                f"prompt length {plen} exceeds the largest prefill bucket "
+                f"{self.scheduler.buckets[-1]}")
+        if request.top_k not in (None, self.top_k):
+            metrics.bump("rejected")
+            raise ValueError(
+                f"request top_k={request.top_k} differs from the engine's "
+                f"static top_k={self.top_k}; per-value top_k would recompile "
+                f"the shared executables (construct the Engine with that "
+                f"top_k instead)")
+        if request.do_sample and request.top_k is None \
+                and self.top_k is not None:
+            # greedy is top-k-invariant (argmax survives the mask), but a
+            # sampled request would silently draw from top-k-truncated
+            # logits, diverging from generate_from_params(top_k=None)
+            metrics.bump("rejected")
+            raise ValueError(
+                f"sampled request with top_k=None on an engine compiled "
+                f"with static top_k={self.top_k}; pass top_k={self.top_k} "
+                f"to accept the engine's truncation, or serve it from an "
+                f"Engine built with top_k=None")
+        if request.max_new_tokens == 0:
+            # parity with generate(max_new_tokens=0): prompt unchanged
+            request.submit_t = time.perf_counter()
+            self._resolve(request, LENGTH)
+            return request
+        try:
+            self.scheduler.submit(request)
+        except QueueFullError:
+            metrics.bump("rejected")
+            raise
+        return request
+
+    def cancel(self, request):
+        """Abort a queued or running request; its slot (if any) is recycled
+        at the next step boundary."""
+        if request.state == QUEUED and self.scheduler.cancel(request):
+            self._resolve(request, CANCELLED, count="cancelled")
+        elif request.state == RUNNING:
+            self._free_slot(request.slot)
+            self._resolve(request, CANCELLED, count="cancelled")
+
+    # -- one engine iteration ------------------------------------------------
+    def step(self):
+        """One scheduling boundary + one decode iteration: evict expired,
+        admit (prefill) into free slots, decode one token for every active
+        slot. Returns True while any work remains."""
+        now = time.perf_counter()
+
+        # 1) evict running requests whose deadline passed
+        for b, req in enumerate(self._slots):
+            if req is not None and req.deadline is not None \
+                    and now > req.deadline:
+                self._free_slot(b)
+                self._resolve(req, EXPIRED, count="expired")
+
+        # 2) reap deadline-expired queued requests (even with zero free
+        #    slots — they must not count toward backpressure), then FCFS
+        #    admission into free slots at the boundary
+        expired = self.scheduler.expire(now)
+        free = [b for b, r in enumerate(self._slots) if r is None]
+        admitted, admit_expired = self.scheduler.admit(len(free), now)
+        for req in expired + admit_expired:
+            self._results[req.request_id] = req.result()
+            metrics.bump("expired")
+        for req, b in zip(admitted, free):
+            self._admit(req, b)
+
+        # 3) one decode iteration over all slots
+        active = np.array([r is not None for r in self._slots])
+        metrics.observe_boundary(self.scheduler.qsize(), int(active.sum()),
+                                 self.num_slots)
+        if active.any():
+            t0 = time.perf_counter()
+            self._kc, self._vc, nxt, keys = self._decode(
+                self.params, self._kc, self._vc,
+                jnp.asarray(self._tok), jnp.asarray(self._pos),
+                jnp.asarray(active), jnp.asarray(self._do_sample),
+                jnp.asarray(self._temp), jnp.asarray(self._top_p),
+                jnp.asarray(self._keys))
+            nxt = np.asarray(nxt)
+            # copy: device_get views are read-only and _admit writes rows
+            self._keys = np.array(keys)
+            dt = time.perf_counter() - t0
+            metrics.bump("decode_steps")
+            metrics.add_time("decode_time_s", dt)
+            metrics.observe_token_latency(dt, 1)
+            for b, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                tok = int(nxt[b])
+                req._emit(tok)
+                metrics.bump("tokens_out")
+                self._tok[b] = tok
+                self._pos[b] += 1
+                if req.stop_token_ids and tok in req.stop_token_ids:
+                    self._free_slot(b)
+                    self._resolve(req, STOP)
+                elif len(req.tokens) >= req.max_new_tokens:
+                    self._free_slot(b)
+                    self._resolve(req, LENGTH)
+
+        return self.scheduler.qsize() > 0 or \
+            any(r is not None for r in self._slots)
+
+    def _admit(self, req, b):
+        """Prefill req's prompt into slot b (prompt padded to its bucket);
+        the prefill emits the request's FIRST token (TTFT stops here)."""
+        plen = req.prompt_len
+        bucket = self.scheduler.bucket_for(plen)
+        ids = np.zeros(bucket, np.int32)
+        ids[:plen] = req.prompt
+        key0 = jax.random.key_data(jax.random.key(req.seed))
+        t0 = time.perf_counter()
+        self._kc, self._vc, tok, key = self._prefill(
+            self.params, self._kc, self._vc, jnp.asarray(ids),
+            jnp.int32(plen), jnp.int32(b), jnp.asarray(key0),
+            jnp.asarray(bool(req.do_sample)),
+            jnp.float32(req.temperature),
+            jnp.float32(1.0 if req.top_p is None else req.top_p))
+        tok = int(np.asarray(tok))
+        metrics.bump("prefill_calls")
+        metrics.add_time("prefill_time_s", time.perf_counter() - t0)
+        metrics.bump("admitted")
+
+        req.state = RUNNING
+        req.slot = b
+        req._emit(tok)
+        metrics.bump("tokens_out")
+        metrics.observe_ttft(req.first_token_t - req.submit_t)
+        if req.stop_token_ids and tok in req.stop_token_ids:
+            self._resolve(req, STOP)
+            return
+        if req.max_new_tokens == 1:
+            self._resolve(req, LENGTH)
+            return
+        self._slots[b] = req
+        self._keys[b] = np.asarray(key)
+        self._tok[b] = tok
+        self._pos[b] = plen            # first decode writes token's KV here
+        self._do_sample[b] = bool(req.do_sample)
+        self._temp[b] = float(req.temperature)
+        self._top_p[b] = 1.0 if req.top_p is None else float(req.top_p)
+
+    def _free_slot(self, b):
+        self._slots[b] = None
+        self._pos[b] = 0
+        self._tok[b] = 0
+
+    def _resolve(self, req, reason, count="completed"):
+        if req.state != FINISHED:
+            req._finish(reason)
+        req.slot = None
+        self._results[req.request_id] = req.result()
+        metrics.bump(count)
+        if reason in (STOP, LENGTH):
+            metrics.bump(f"finished_{reason}")
+
+    # -- draining ------------------------------------------------------------
+    def pop_results(self):
+        """Drain resolved requests: returns {request_id: GenerationResult}
+        for everything resolved since the last drain and forgets them.
+        Call this from a ``step()`` loop — results are held until popped,
+        so an undrained long-running engine grows without bound."""
+        out, self._results = self._results, {}
+        return out
+
+    def run(self, requests=None):
+        """Submit ``requests`` (optional) and step until queue and slots are
+        empty. Returns {request_id: GenerationResult} for everything that
+        resolved during this call (including earlier submissions)."""
+        if requests is not None:
+            for r in requests:
+                self.submit(r)
+        while self.step():
+            pass
+        return self.pop_results()
+
+    def generate(self, prompts, **kw):
+        """Batch convenience: one Request per prompt (shared kwargs),
+        results returned in submission order."""
+        reqs = [Request(p, **kw) for p in prompts]
+        results = self.run(reqs)
+        return [results[r.request_id] for r in reqs]
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def active_slots(self):
+        return sum(r is not None for r in self._slots)
+
+    @property
+    def queue_depth(self):
+        return self.scheduler.qsize()

@@ -1,0 +1,117 @@
+"""Serving observability counters (profiler counter pattern of
+dispatch/comm/mp_comm/fault: a module-level ledger, snapshot via
+`profiler.serving_counters()`, one-line `profiler.serving_summary()`).
+
+The two trace counters are the engine's no-recompile audit trail: each jitted
+body bumps its counter only when actually TRACED, so after warmup
+(one prefill trace per bucket + one decode trace) the counts must freeze —
+admission, eviction and sampling-param changes reuse the cached executables.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import numpy as np
+
+_lock = threading.Lock()
+
+
+def _zero():
+    return {
+        # request lifecycle
+        "submitted": 0, "admitted": 0, "completed": 0, "rejected": 0,
+        "expired": 0, "cancelled": 0,
+        "finished_stop": 0, "finished_length": 0,
+        # executables
+        "prefill_calls": 0, "prefill_traces": 0,
+        "decode_steps": 0, "decode_traces": 0,
+        # tokens / time
+        "tokens_out": 0,
+        "decode_time_s": 0.0, "prefill_time_s": 0.0,
+        # occupancy: sum of active slots over decode steps / (steps * slots)
+        "active_slot_steps": 0, "slot_steps": 0,
+        # queue depth observed at step boundaries
+        "queue_depth_sum": 0, "queue_depth_max": 0, "boundaries": 0,
+    }
+
+
+_C = _zero()
+# ring buffers: percentiles track the LAST window of traffic, not the
+# first — a long-running server must surface a late latency regression
+_MAX_SAMPLES = 65536
+_ttft = deque(maxlen=_MAX_SAMPLES)      # seconds
+_tok_lat = deque(maxlen=_MAX_SAMPLES)   # per-token decode latency (seconds)
+
+
+def bump(name, n=1):
+    with _lock:
+        _C[name] += n
+
+
+def add_time(name, dt):
+    with _lock:
+        _C[name] += dt
+
+
+def observe_boundary(queue_depth, active, slots):
+    with _lock:
+        _C["boundaries"] += 1
+        _C["queue_depth_sum"] += queue_depth
+        _C["queue_depth_max"] = max(_C["queue_depth_max"], queue_depth)
+        _C["active_slot_steps"] += active
+        _C["slot_steps"] += slots
+
+
+def observe_ttft(seconds):
+    with _lock:
+        _ttft.append(seconds)
+
+
+def observe_token_latency(seconds, n=1):
+    with _lock:
+        _tok_lat.append(seconds / max(n, 1))
+
+
+def serving_counters():
+    """Snapshot of the serving ledger plus derived rates: ttft p50/p99,
+    per-token latency, tokens/s over decode time, slot occupancy, mean
+    queue depth."""
+    with _lock:
+        out = dict(_C)
+        ttft = list(_ttft)
+        lat = list(_tok_lat)
+    out["ttft_p50"] = float(np.percentile(ttft, 50)) if ttft else None
+    out["ttft_p99"] = float(np.percentile(ttft, 99)) if ttft else None
+    out["token_latency_p50"] = float(np.percentile(lat, 50)) if lat else None
+    # tokens_out counts prefill-emitted first tokens too, so the rate
+    # divides by total executable time (prefill + decode), not decode alone
+    exec_t = out["decode_time_s"] + out["prefill_time_s"]
+    out["tokens_per_s"] = out["tokens_out"] / exec_t if exec_t > 0 else 0.0
+    out["occupancy"] = (out["active_slot_steps"] / out["slot_steps"]
+                        if out["slot_steps"] else 0.0)
+    out["queue_depth_mean"] = (out["queue_depth_sum"] / out["boundaries"]
+                               if out["boundaries"] else 0.0)
+    return out
+
+
+def reset_serving_counters():
+    global _C
+    with _lock:
+        _C = _zero()
+        _ttft.clear()
+        _tok_lat.clear()
+
+
+def serving_summary():
+    """One-line human-readable serving report."""
+    c = serving_counters()
+    ttft = ("n/a" if c["ttft_p50"] is None
+            else f"{c['ttft_p50'] * 1e3:.1f}/{c['ttft_p99'] * 1e3:.1f}ms")
+    return (f"requests: {c['submitted']} submitted / {c['completed']} done "
+            f"({c['expired']} expired, {c['rejected']} rejected)  "
+            f"tokens: {c['tokens_out']}  tokens/s: {c['tokens_per_s']:.1f}  "
+            f"ttft p50/p99: {ttft}  occupancy: {c['occupancy'] * 100:.1f}%  "
+            f"queue: {c['queue_depth_mean']:.1f} avg/{c['queue_depth_max']} max  "
+            f"executables: {c['prefill_traces']} prefill + "
+            f"{c['decode_traces']} decode")

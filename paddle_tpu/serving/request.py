@@ -1,0 +1,159 @@
+"""Serving request/result types.
+
+A `Request` is one user generation job; the engine assigns it a slot in the
+fixed decode batch, streams tokens to `on_token` as they are produced, and
+resolves it into a `GenerationResult`. Sampling params (temperature/top_p,
+per-request seed) are TRACED per-slot operands of the shared decode
+executable, so any mix of greedy and sampled requests batches together
+without recompiling.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..models.generation import _normalize_stop
+
+_req_ids = itertools.count()
+
+# request lifecycle states
+QUEUED = "queued"
+RUNNING = "running"
+FINISHED = "finished"
+
+# finish reasons
+STOP = "stop"          # produced a stop token
+LENGTH = "length"      # hit max_new_tokens
+EXPIRED = "expired"    # deadline passed before/while running
+CANCELLED = "cancelled"
+
+
+@dataclass(eq=False)  # identity equality: deque.remove/cancel compare BY
+class Request:        # OBJECT, and field-wise eq would compare numpy prompts
+    """One generation job. ``prompt`` is a 1-D int sequence. ``eos_token_id``
+    is the scalar alias for ``stop_token_ids`` (both accepted, merged).
+    ``top_k`` must match the engine's static top_k (it shapes the top_k
+    kernel and would recompile per value). ``deadline_s`` is a relative
+    deadline from submit time: expired requests are failed at the next step
+    boundary instead of occupying a slot."""
+    prompt: object
+    max_new_tokens: int = 32
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_p: float | None = None
+    top_k: int | None = None
+    eos_token_id: int | None = None
+    stop_token_ids: object = None
+    seed: int = 0
+    deadline_s: float | None = None
+    on_token: object = None          # callback(request, token_id)
+
+    # -- engine-managed state ------------------------------------------------
+    request_id: int = field(default_factory=lambda: next(_req_ids))
+    state: str = field(default=QUEUED)
+    tokens: list = field(default_factory=list)
+    slot: int | None = field(default=None)
+    submit_t: float | None = field(default=None)
+    first_token_t: float | None = field(default=None)
+    finish_t: float | None = field(default=None)
+    finish_reason: str | None = field(default=None)
+    callback_error: object = field(default=None)  # first on_token exception
+
+    def __post_init__(self):
+        self.prompt = np.asarray(
+            self.prompt._data if hasattr(self.prompt, "_data") else self.prompt,
+            np.int32).reshape(-1)
+        if self.prompt.shape[0] == 0:
+            # an empty prompt would read logits at the pad token (the
+            # prefill's last_index clamps to 0) — plausible-looking output
+            # conditioned on nothing the user sent
+            raise ValueError("prompt must be non-empty")
+        if self.max_new_tokens < 0:
+            raise ValueError(
+                f"max_new_tokens must be >= 0, got {self.max_new_tokens}")
+        self.stop_token_ids = _normalize_stop(
+            self.eos_token_id, self.stop_token_ids) or ()
+        if self.top_k == 0:            # generate's "disabled" spelling
+            self.top_k = None
+
+    @property
+    def prompt_len(self):
+        return int(self.prompt.shape[0])
+
+    @property
+    def deadline(self):
+        """Absolute deadline (perf_counter clock), or None."""
+        if self.deadline_s is None or self.submit_t is None:
+            return None
+        return self.submit_t + self.deadline_s
+
+    def _emit(self, token):
+        self.tokens.append(int(token))
+        if self.first_token_t is None:
+            self.first_token_t = time.perf_counter()
+        if self.on_token is not None:
+            try:
+                self.on_token(self, int(token))
+            except Exception as e:    # noqa: BLE001 — user callback
+                # A broken client stream must not unwind step(): the KV
+                # cache and PRNG keys advanced BEFORE this emission, so an
+                # escaping error would leave host _tok/_pos stale and the
+                # next step() would re-feed old tokens at old positions
+                # (duplicated token, diverged sampled stream). Disable the
+                # callback, record the error, finish the request normally.
+                self.callback_error = e
+                self.on_token = None
+                import warnings
+                warnings.warn(
+                    f"request {self.request_id}: on_token callback raised "
+                    f"{type(e).__name__}: {e}; streaming disabled for this "
+                    f"request (see GenerationResult.callback_error)")
+
+    def _finish(self, reason):
+        self.state = FINISHED
+        self.finish_reason = reason
+        self.finish_t = time.perf_counter()
+
+    def result(self):
+        if self.state != FINISHED:
+            raise RuntimeError(
+                f"request {self.request_id} not finished (state={self.state})")
+        return GenerationResult(
+            request_id=self.request_id,
+            prompt=self.prompt,
+            tokens=list(self.tokens),
+            finish_reason=self.finish_reason,
+            ttft=(None if self.first_token_t is None or self.submit_t is None
+                  else self.first_token_t - self.submit_t),
+            latency=(None if self.finish_t is None or self.submit_t is None
+                     else self.finish_t - self.submit_t),
+            callback_error=self.callback_error,
+        )
+
+
+@dataclass
+class GenerationResult:
+    """Resolved output of one Request. ``tokens`` are the NEW tokens only
+    (stop token included when one fired, matching `generate`'s output);
+    ``sequence`` is prompt + tokens."""
+    request_id: int
+    prompt: np.ndarray
+    tokens: list
+    finish_reason: str
+    ttft: float | None = None
+    latency: float | None = None
+    callback_error: object = None    # first on_token exception, if any
+
+    @property
+    def sequence(self):
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    @property
+    def tokens_per_s(self):
+        if not self.tokens or not self.latency:
+            return 0.0
+        return len(self.tokens) / self.latency

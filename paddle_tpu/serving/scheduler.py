@@ -1,0 +1,94 @@
+"""Request scheduler for the continuous-batching engine.
+
+FCFS admission at STEP boundaries (Orca-style iteration-level scheduling):
+between decode iterations the engine asks the scheduler for requests to
+prefill into free slots. The scheduler owns the wait queue (bounded —
+`submit` raises `QueueFullError` past `max_queue`, the backpressure signal a
+frontend turns into HTTP 429), prefill-bucket selection (prompt padded up to
+the smallest configured bucket, so steady state compiles one prefill
+executable per bucket, not per length), and per-request deadlines (expired
+requests are failed at the boundary instead of wasting a prefill).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from .request import EXPIRED, QUEUED
+
+
+class QueueFullError(RuntimeError):
+    """Raised by submit() when the wait queue is at max_queue."""
+
+
+class Scheduler:
+    def __init__(self, buckets, max_queue=256):
+        buckets = sorted(int(b) for b in buckets)
+        if not buckets:
+            raise ValueError("need at least one prefill bucket")
+        self.buckets = tuple(buckets)
+        self.max_queue = int(max_queue)
+        self._q = deque()
+
+    # -- queue ---------------------------------------------------------------
+    def submit(self, req):
+        if len(self._q) >= self.max_queue:
+            raise QueueFullError(
+                f"serving queue full ({self.max_queue} waiting); retry later")
+        if req.state != QUEUED:
+            raise ValueError(f"request {req.request_id} already "
+                             f"{req.state}; requests are single-use")
+        req.submit_t = time.perf_counter()
+        self._q.append(req)
+
+    def cancel(self, req):
+        """Remove a still-queued request; returns True if it was waiting."""
+        try:
+            self._q.remove(req)
+            return True
+        except ValueError:
+            return False
+
+    def qsize(self):
+        return len(self._q)
+
+    # -- bucket selection ----------------------------------------------------
+    def bucket_for(self, prompt_len):
+        """Smallest configured bucket >= prompt_len."""
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds largest prefill bucket "
+            f"{self.buckets[-1]}")
+
+    # -- expiry --------------------------------------------------------------
+    def expire(self, now=None):
+        """Remove and return every queued request whose deadline passed —
+        called at EVERY step boundary (not just when a slot frees), so dead
+        entries never inflate qsize()/backpressure while all slots are busy.
+        Returned requests are already marked EXPIRED."""
+        now = time.perf_counter() if now is None else now
+        expired = [r for r in self._q
+                   if r.deadline is not None and now > r.deadline]
+        for req in expired:
+            self._q.remove(req)
+            req._finish(EXPIRED)
+        return expired
+
+    # -- admission -----------------------------------------------------------
+    def admit(self, free_slots, now=None):
+        """Pop up to free_slots admissible requests FCFS. Requests whose
+        deadline already passed are popped, marked EXPIRED and returned
+        separately (they never occupy a slot)."""
+        now = time.perf_counter() if now is None else now
+        admitted, expired = [], []
+        while self._q and len(admitted) < free_slots:
+            req = self._q.popleft()
+            dl = req.deadline
+            if dl is not None and now > dl:
+                req._finish(EXPIRED)
+                expired.append(req)
+                continue
+            admitted.append(req)
+        return admitted, expired

@@ -20,6 +20,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running benches excluded from the tier-1 '-m not slow' "
+        "gate")
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()
