@@ -73,6 +73,39 @@ _FLAGS = {
     # Wait-queue bound: submit() past this raises QueueFullError — the
     # backpressure signal a frontend turns into HTTP 429 / retry-after.
     "FLAGS_serving_max_queue": 256,
+    # KV-cache layout: "paged" (block-paged pool [L,P,page,nh,d] + slot->page
+    # table, vLLM-style — admission is bounded by PAGES, not worst-case
+    # Smax slots, long prompts prefill in chunks interleaved with decode,
+    # and common prompt prefixes share physical pages copy-on-write) or
+    # "pooled" (the PR 5 contiguous [L,B,Smax,nh,d] layout, kept as the
+    # bitwise parity baseline).
+    "FLAGS_serving_kv_layout": "paged",
+    # Tokens per KV page. Smaller pages = less per-request fragmentation
+    # (waste < page_size tokens per sequence) but a bigger page table.
+    "FLAGS_serving_page_size": 16,
+    # Physical pages in the paged pool. 0 = auto: num_slots * ceil(Smax /
+    # page_size) + 1 (memory-equal to the pooled layout, +1 trash page).
+    "FLAGS_serving_num_pages": 0,
+    # Chunked-prefill budget: long prompts prefill in chunks interleaved
+    # between decode iterations (Sarathi-style), so admitting a 1024-token
+    # prompt costs each inter-token gap one chunk instead of a monolithic
+    # prefill stall. Chunks walk a power-of-two LADDER of sizes (page_size
+    # .. this value): bulk prefill rides the largest rung, the tail steps
+    # down so per-request padding waste stays < page_size. Executable set
+    # = the fused step at [B, 1] (decode) + one [1, rung] trace per ladder
+    # rung actually used. Must be >= page_size.
+    "FLAGS_serving_prefill_chunk": 16,
+    # Hash-match admitted prompts against previously served ones and map
+    # the common page-aligned prefix (or the exact full prompt) to the SAME
+    # physical pages, copy-on-write on first divergence. Sharing is bitwise
+    # safe: KV for a token depends only on the token prefix.
+    "FLAGS_serving_prefix_cache": True,
+    # Route the paged decode attention through the Pallas TPU kernel
+    # (serving/paged_attention.py) instead of the pure-jnp page gather.
+    # TPU-only; the kernel's online-softmax accumulation is numerically
+    # equivalent but NOT bitwise identical to the jnp path — disable when
+    # auditing bitwise parity on TPU.
+    "FLAGS_serving_paged_kernel": True,
     # Ring-decomposed compute/communication overlap on the mp axis: the
     # pre-QKV/FFN all-gather splits into mp-1 ppermute hops with each
     # chunk's GEMM issued on arrival, and the RowParallel GEMM emits

@@ -389,6 +389,16 @@ def _logical_qkv(params, config):
     return {**params, "blocks": blocks}
 
 
+def _check_temperature(do_sample, temperature):
+    """Sampled decoding divides logits by the temperature (_mask_logits);
+    <= 0 would blow them up to +/-inf before the 1e-6 clamp makes the
+    distribution a numerical accident. Greedy paths never read it."""
+    if do_sample and temperature <= 0:
+        raise ValueError(
+            f"temperature must be > 0 when do_sample=True, got "
+            f"{temperature} (use do_sample=False for greedy decoding)")
+
+
 def generate_from_params(params, input_ids, config, max_new_tokens=32,
                          do_sample=False, temperature=1.0, top_k=None,
                          top_p=None, eos_token_id=None, seed=0,
@@ -399,6 +409,7 @@ def generate_from_params(params, input_ids, config, max_new_tokens=32,
     from ..tensor_impl import Tensor
     ids = jnp.asarray(input_ids._data if isinstance(input_ids, Tensor)
                       else input_ids, jnp.int32)
+    _check_temperature(do_sample, temperature)
     if max_new_tokens < 1:
         if max_new_tokens == 0:
             return Tensor(ids)
@@ -428,6 +439,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     config = model.config
     ids = jnp.asarray(input_ids._data if isinstance(input_ids, Tensor)
                       else input_ids, jnp.int32)
+    _check_temperature(do_sample, temperature)
     if max_new_tokens < 1:
         if max_new_tokens == 0:
             return Tensor(ids)

@@ -319,19 +319,25 @@ def fault_summary():
 
 # -- serving counters ---------------------------------------------------------
 # The continuous-batching engine (serving/engine.py) ledgers every request,
-# prefill call, decode iteration and token. prefill_traces/decode_traces are
-# the no-recompile audit trail: each jitted body counts only when actually
-# traced, so after warmup (one prefill per bucket + one decode) the counts
-# freeze — joins, evicts and sampling-param changes must not move them.
-# TTFT/token-latency percentiles, tokens/s, slot occupancy and queue depth
-# are the serving SLO surface.
+# prefill call/chunk, decode iteration and token. The trace counters
+# (prefill/decode for the pooled layout; paged_traces/copy_traces for the
+# paged layout's fused step and CoW page copy) are the no-recompile audit
+# trail: each jitted body counts only when actually traced, so after warmup
+# the counts freeze — joins, evicts, chunked admissions, CoW remaps and
+# sampling-param changes must not move them. TTFT/token-latency
+# percentiles, tokens/s, slot occupancy and queue depth are the serving
+# SLO surface; the paged layout adds page occupancy, prefix-cache hit
+# rate / tokens reused, chunk-interleave counters and per-prefill
+# padded-token waste.
 
 
 def serving_counters():
     """Snapshot of the serving-engine counters: request lifecycle
     (submitted/admitted/completed/expired/rejected), executable calls and
     traces, tokens_out, ttft_p50/p99, token_latency_p50, tokens_per_s,
-    occupancy, queue depth."""
+    occupancy, queue depth — plus the paged-KV ledger (page_occupancy,
+    prefix_hit_rate, prefix_tokens_reused, chunk_steps, cow_copies,
+    prefill_waste_mean)."""
     from ..serving import metrics
     return metrics.serving_counters()
 

@@ -1,16 +1,19 @@
 """paddle_tpu.serving — continuous-batching TPU serving engine.
 
-Iteration-level (Orca-style) scheduling over a fixed B-slot decode batch
-with a pooled KV cache and exactly two steady-state executables (bucketed
-single-sequence prefill + one-token decode over all slots). See engine.py
-for the design; `profiler.serving_counters()` / `serving_summary()` for
-observability.
+Iteration-level (Orca-style) scheduling over a fixed B-slot decode batch.
+The default KV layout is block-PAGED (vLLM-style: fixed-size pages + a
+slot->page table, prefix reuse copy-on-write, chunked prefill fused into
+the decode step); the PR 5 pooled ``[L, B, Smax, nh, d]`` layout remains
+available as the bitwise parity baseline (``kv_layout="pooled"``). See
+engine.py for the design; `profiler.serving_counters()` /
+`serving_summary()` for observability.
 """
 from .request import (  # noqa: F401
     Request, GenerationResult,
     QUEUED, RUNNING, FINISHED, STOP, LENGTH, EXPIRED, CANCELLED,
 )
 from .scheduler import Scheduler, QueueFullError  # noqa: F401
+from .paged_kv import PagedKVPool, PagePoolExhausted, pages_for  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .metrics import (  # noqa: F401
     serving_counters, reset_serving_counters, serving_summary,

@@ -2,8 +2,28 @@
 family, built on the fixed-shape / cached-executable discipline of the
 eager+jit runtime.
 
-Design
-------
+Two KV layouts (``kv_layout`` / FLAGS_serving_kv_layout):
+
+* **paged** (default) — block-paged pool ``[L, P, page_size, nh, d]``
+  plus a slot->page table (vLLM-style PagedAttention): admission is
+  bounded by physical PAGES, not worst-case-length slots, so effective
+  batch tracks ACTUAL sequence lengths; prompts with a cached prefix map
+  the same physical pages copy-on-write (serving/paged_kv.py); and long
+  prompts prefill in fixed-size CHUNKS fused into the regular decode step
+  (Sarathi-style), so admitting a 1024-token prompt no longer stalls all
+  B decode streams for a monolithic prefill. Steady state uses a small
+  static executable set — ONE fused step dispatched at its two shapes
+  ([B, 1] decode over all slots, [1, chunk] prefill chunk), plus the CoW
+  page copy — all trace-counter gated, and
+  every per-request quantity (chunk offset, is-prefill/emit, page table,
+  sampling params, PRNG keys) is a traced operand. Token streams stay
+  bitwise identical to single-request ``generate_from_params`` for any
+  admission order, greedy and sampled, with sharing and chunking on.
+* **pooled** — the PR 5 contiguous ``[L, B, Smax, nh, d]`` layout, kept
+  as the bitwise parity baseline.
+
+Pooled design
+-------------
 The engine owns a fixed batch of B decode SLOTS backed by one pooled KV
 cache ``[L, B, Smax, nh, d]`` and exactly TWO steady-state executables:
 
@@ -44,6 +64,8 @@ from ..models.generation import (
     _forward_decode_slots, _logical_qkv, _mask_logits,
 )
 from . import metrics
+from .paged_attention import paged_forward, paged_kernel_supported
+from .paged_kv import PagedKVPool, pages_for
 from .request import (
     CANCELLED, EXPIRED, FINISHED, LENGTH, QUEUED, RUNNING, STOP,
     GenerationResult, Request,
@@ -105,6 +127,56 @@ def _make_decode(cfg, top_k, donate):
     return jax.jit(fn, donate_argnums=donate)
 
 
+@lru_cache(maxsize=None)
+def _make_paged_step(cfg, top_k, page_size, use_kernel, donate):
+    """Build the FUSED chunk/decode executable over the paged pool: every
+    batch row is a slot processing a T-token window (ids' second dim) at
+    its own offset. The engine dispatches it at exactly two steady-state
+    shapes — [B, 1] (one-token decode over all slots) and [1, chunk] (one
+    prefill chunk, Sarathi-interleaved between decodes). start/valid/emit
+    and the page table are traced per-slot operands, so admission, chunk
+    progress, CoW remaps and sampling changes never retrace; distinct
+    shapes -> exactly one trace per rung of the chunk ladder.
+
+    A slot's PRNG key splits ONLY on steps where it emits a token
+    (emit[b]), replicating generate's split-per-emitted-token stream even
+    though prefill now spans several steps."""
+    config = _cfg_view(cfg)
+
+    def fn(params, kc, vc, ids, start, valid, emit, table, do_sample,
+           temperature, top_p, key_data):
+        metrics.bump("paged_traces")  # body runs only when traced
+        logits, kc, vc = paged_forward(params, config, ids, kc, vc, start,
+                                       valid, table, page_size, use_kernel)
+        keys = jax.random.wrap_key_data(key_data)           # [B] keys
+        pair = jax.vmap(jax.random.split)(keys)             # [B, 2] keys
+        subs = pair[:, 1]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        sampled = jax.vmap(jax.random.categorical)(
+            subs, _mask_logits(logits, temperature, top_k, top_p)
+        ).astype(jnp.int32)
+        nxt = jnp.where(do_sample & emit, sampled, greedy)
+        new_keys = jnp.where(emit[:, None], jax.random.key_data(pair[:, 0]),
+                             key_data)
+        return kc, vc, nxt, new_keys
+
+    return jax.jit(fn, donate_argnums=donate)
+
+
+@lru_cache(maxsize=None)
+def _make_page_copy(donate):
+    """Physical page copy (the CoW split): one executable, src/dst traced
+    scalars, reused for every copy-on-write divergence."""
+
+    def fn(kc, vc, src, dst):
+        metrics.bump("copy_traces")  # body runs only when traced
+        kc = kc.at[:, dst].set(kc[:, src])
+        vc = vc.at[:, dst].set(vc[:, src])
+        return kc, vc
+
+    return jax.jit(fn, donate_argnums=donate)
+
+
 class Engine:
     """Continuous-batching serving engine.
 
@@ -124,7 +196,8 @@ class Engine:
 
     def __init__(self, model=None, *, params=None, config=None,
                  num_slots=None, max_seq_len=None, prefill_buckets=None,
-                 max_queue=None, top_k=None):
+                 max_queue=None, top_k=None, kv_layout=None, page_size=None,
+                 num_pages=None, prefill_chunk=None, prefix_cache=None):
         if model is not None:
             params = _collect_params(model)
             config = model.config
@@ -138,6 +211,11 @@ class Engine:
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
 
         flags = get_flags()
+        self.kv_layout = (kv_layout or
+                          flags.get("FLAGS_serving_kv_layout", "paged"))
+        if self.kv_layout not in ("paged", "pooled"):
+            raise ValueError(f"kv_layout must be 'paged' or 'pooled', got "
+                             f"{self.kv_layout!r}")
         self.num_slots = int(num_slots or flags.get("FLAGS_serving_slots", 8))
         self.max_seq_len = int(max_seq_len or
                                flags.get("FLAGS_serving_max_seq_len", 0) or
@@ -158,16 +236,50 @@ class Engine:
 
         cfg = _cfg_key(config)
         donate_ok = jax.default_backend() != "cpu"  # cpu: donation unimplemented
-        self._prefill = _make_prefill(cfg, self.top_k,
-                                      (1, 2) if donate_ok else ())
-        self._decode = _make_decode(cfg, self.top_k,
-                                    (1, 2) if donate_ok else ())
-
         B = self.num_slots
         nh = config.num_heads
         d = config.hidden_size // nh
         compute = jnp.dtype(config.compute_dtype or "float32")
-        shape = (config.num_layers, B, self.max_seq_len, nh, d)
+
+        if self.kv_layout == "pooled":
+            self._prefill = _make_prefill(cfg, self.top_k,
+                                          (1, 2) if donate_ok else ())
+            self._decode = _make_decode(cfg, self.top_k,
+                                        (1, 2) if donate_ok else ())
+            shape = (config.num_layers, B, self.max_seq_len, nh, d)
+        else:
+            self.page_size = int(page_size or
+                                 flags.get("FLAGS_serving_page_size", 16))
+            self.prefill_chunk = int(
+                prefill_chunk or flags.get("FLAGS_serving_prefill_chunk", 16))
+            if self.prefill_chunk < self.page_size:
+                raise ValueError(
+                    f"prefill_chunk ({self.prefill_chunk}) must be >= "
+                    f"page_size ({self.page_size})")
+            # the chunk LADDER: power-of-two multiples of page_size up to
+            # prefill_chunk. Bulk prefill rides the largest rung; the tail
+            # drops down the ladder so the final chunk's padding is always
+            # < page_size. One executable per rung, all trace-gated.
+            self._chunk_ladder = [self.page_size]
+            while self._chunk_ladder[-1] * 2 <= self.prefill_chunk:
+                self._chunk_ladder.append(self._chunk_ladder[-1] * 2)
+            if prefix_cache is None:
+                prefix_cache = bool(
+                    flags.get("FLAGS_serving_prefix_cache", True))
+            self.pool = PagedKVPool(
+                B, self.max_seq_len, self.page_size,
+                num_pages=int(num_pages or
+                              flags.get("FLAGS_serving_num_pages", 0) or 0),
+                prefix_cache=prefix_cache)
+            use_kernel = bool(flags.get("FLAGS_serving_paged_kernel", True)
+                              ) and paged_kernel_supported(
+                                  nh, d, self.page_size, why="serving engine")
+            self._paged_step = _make_paged_step(
+                cfg, self.top_k, self.page_size, use_kernel,
+                (1, 2) if donate_ok else ())
+            self._page_copy = _make_page_copy((0, 1) if donate_ok else ())
+            shape = (config.num_layers, self.pool.num_pages, self.page_size,
+                     nh, d)
         self._kc = jnp.zeros(shape, compute)
         self._vc = jnp.zeros(shape, compute)
 
@@ -180,6 +292,12 @@ class Engine:
         self._temp = np.ones(B, np.float32)
         self._top_p = np.ones(B, np.float32)
         self._do_sample = np.zeros(B, bool)
+        # paged: next prompt index to prefill for slot b (== prompt_len once
+        # prefill is done and the slot is decoding), plus the admission
+        # sequence number that keeps chunked prefill FCFS across slots
+        self._chunk_off = np.zeros(B, np.int32)
+        self._admit_seq = np.zeros(B, np.int64)
+        self._admit_count = 0
         self._results = {}                # request_id -> GenerationResult
 
     # -- submission ----------------------------------------------------------
@@ -199,13 +317,29 @@ class Engine:
             metrics.bump("rejected")
             raise ValueError(
                 f"prompt ({plen}) + max_new_tokens "
-                f"({request.max_new_tokens}) exceeds the KV pool's "
-                f"max_seq_len ({self.max_seq_len})")
-        if plen > self.scheduler.buckets[-1]:
-            metrics.bump("rejected")
-            raise ValueError(
-                f"prompt length {plen} exceeds the largest prefill bucket "
-                f"{self.scheduler.buckets[-1]}")
+                f"({request.max_new_tokens}) exceeds the KV "
+                f"{'table capacity' if self.kv_layout == 'paged' else 'pool'}"
+                f" max_seq_len ({self.max_seq_len})")
+        if self.kv_layout == "pooled":
+            # the pooled layout additionally caps prompts at the largest
+            # prefill bucket; paged prompts prefill in chunks of any count
+            if plen > self.scheduler.buckets[-1]:
+                metrics.bump("rejected")
+                raise ValueError(
+                    f"prompt length {plen} exceeds the largest prefill "
+                    f"bucket {self.scheduler.buckets[-1]}")
+        else:
+            # worst-case demand is exactly the lifetime page count: a CoW
+            # spare is reserved only when >= 1 page is prefix-shared, and
+            # every shared page reduces the fresh-page need by one. A
+            # request that can NEVER fit must fail fast instead of
+            # deadlocking the FCFS queue head.
+            worst = pages_for(plen + request.max_new_tokens, self.page_size)
+            if worst > self.pool.num_pages - 1:
+                metrics.bump("rejected")
+                raise ValueError(
+                    f"request needs up to {worst} KV pages but the pool "
+                    f"only has {self.pool.num_pages - 1}")
         if request.top_k not in (None, self.top_k):
             metrics.bump("rejected")
             raise ValueError(
@@ -261,58 +395,267 @@ class Engine:
 
         # 2) reap deadline-expired queued requests (even with zero free
         #    slots — they must not count toward backpressure), then FCFS
-        #    admission into free slots at the boundary
+        #    admission into free slots at the boundary (page-aware for the
+        #    paged layout: the head is admitted when PAGES suffice for its
+        #    whole lifetime, not when a whole-Smax slot does)
         expired = self.scheduler.expire(now)
         free = [b for b, r in enumerate(self._slots) if r is None]
-        admitted, admit_expired = self.scheduler.admit(len(free), now)
+        fits = self._try_reserve if self.kv_layout == "paged" else None
+        admitted, admit_expired = self.scheduler.admit(len(free), now,
+                                                       fits=fits)
         for req in expired + admit_expired:
             self._results[req.request_id] = req.result()
             metrics.bump("expired")
         for req, b in zip(admitted, free):
             self._admit(req, b)
 
-        # 3) one decode iteration over all slots
+        # 3) one iteration over all slots
         active = np.array([r is not None for r in self._slots])
         metrics.observe_boundary(self.scheduler.qsize(), int(active.sum()),
                                  self.num_slots)
-        if active.any():
-            t0 = time.perf_counter()
-            self._kc, self._vc, nxt, keys = self._decode(
-                self.params, self._kc, self._vc,
-                jnp.asarray(self._tok), jnp.asarray(self._pos),
-                jnp.asarray(active), jnp.asarray(self._do_sample),
-                jnp.asarray(self._temp), jnp.asarray(self._top_p),
-                jnp.asarray(self._keys))
-            nxt = np.asarray(nxt)
-            # copy: device_get views are read-only and _admit writes rows
-            self._keys = np.array(keys)
-            dt = time.perf_counter() - t0
-            metrics.bump("decode_steps")
-            metrics.add_time("decode_time_s", dt)
-            metrics.observe_token_latency(dt, 1)
-            for b, req in enumerate(self._slots):
-                if req is None:
-                    continue
-                tok = int(nxt[b])
-                req._emit(tok)
-                metrics.bump("tokens_out")
-                self._tok[b] = tok
-                self._pos[b] += 1
-                if req.stop_token_ids and tok in req.stop_token_ids:
-                    self._free_slot(b)
-                    self._resolve(req, STOP)
-                elif len(req.tokens) >= req.max_new_tokens:
-                    self._free_slot(b)
-                    self._resolve(req, LENGTH)
+        if self.kv_layout == "paged":
+            metrics.observe_pages(self.pool.pages_in_use,
+                                  self.pool.num_pages - 1)
+            if active.any():
+                self._iterate_paged()
+        elif active.any():
+            self._iterate_pooled(active)
 
         return self.scheduler.qsize() > 0 or \
             any(r is not None for r in self._slots)
 
+    def _iterate_pooled(self, active):
+        """One pooled-layout decode iteration: one token for every active
+        slot through the [L, B, Smax, nh, d] cache."""
+        t0 = time.perf_counter()
+        self._kc, self._vc, nxt, keys = self._decode(
+            self.params, self._kc, self._vc,
+            jnp.asarray(self._tok), jnp.asarray(self._pos),
+            jnp.asarray(active), jnp.asarray(self._do_sample),
+            jnp.asarray(self._temp), jnp.asarray(self._top_p),
+            jnp.asarray(self._keys))
+        nxt = np.asarray(nxt)
+        # copy: device_get views are read-only and _admit writes rows
+        self._keys = np.array(keys)
+        dt = time.perf_counter() - t0
+        metrics.bump("decode_steps")
+        metrics.add_time("decode_time_s", dt)
+        metrics.observe_token_latency(dt, 1)
+        for b, req in enumerate(self._slots):
+            if req is None:
+                continue
+            tok = int(nxt[b])
+            req._emit(tok)
+            metrics.bump("tokens_out")
+            self._tok[b] = tok
+            self._pos[b] += 1
+            if req.stop_token_ids and tok in req.stop_token_ids:
+                self._free_slot(b)
+                self._resolve(req, STOP)
+            elif len(req.tokens) >= req.max_new_tokens:
+                self._free_slot(b)
+                self._resolve(req, LENGTH)
+
+    def _cow(self, b, start, end):
+        """Copy-on-write guard: a slot may only WRITE pages it exclusively
+        owns — split any shared page in [start, end) to a fresh physical
+        page before the dispatch that writes the range."""
+        for src, dst in self.pool.make_writable(b, start, end):
+            self._kc, self._vc = self._page_copy(
+                self._kc, self._vc, jnp.int32(src), jnp.int32(dst))
+            metrics.bump("cow_copies")
+
+    def _iterate_paged(self):
+        """One paged iteration (Sarathi-style interleave): the FCFS-oldest
+        slot still consuming its prompt advances by ONE prefill chunk
+        ([1, chunk] dispatch of the fused step), and every decode-ready
+        slot emits one token ([B, 1] dispatch of the SAME fused step).
+        Decode streams therefore advance at every boundary — a 1024-token
+        admission costs each inter-token gap one chunk, never a monolithic
+        prefill — and decode slots never pay for the chunk window. The two
+        dispatch shapes ARE the steady-state executable set (the chunk
+        ladder), trace-counter gated."""
+        B = self.num_slots
+        t_boundary = time.perf_counter()    # chunks + CoW + decode: the
+        prefilling = sorted(                # whole inter-token gap
+            (b for b in range(B) if self._slots[b] is not None
+             and self._chunk_off[b] < self._slots[b].prompt_len),
+            key=lambda x: self._admit_seq[x])
+        n_dec = sum(1 for b in range(B) if self._slots[b] is not None
+                    and self._chunk_off[b] >= self._slots[b].prompt_len)
+
+        if prefilling:
+            # prefill budget scales with IDLE decode capacity (Sarathi's
+            # principle): while the batch ramps up, several prompts chunk
+            # per boundary; once half the slots decode, only one chunk
+            # rides along, so the inter-token gap stays one-chunk-bounded
+            budget = max(1, B // 2 - n_dec)
+            for b in prefilling[:budget]:
+                self._prefill_chunk(b)
+
+        decoding = [b for b in range(B) if self._slots[b] is not None
+                    and self._chunk_off[b] >= self._slots[b].prompt_len]
+        if not decoding:
+            return
+        # mid-prefill slots ride along inert: valid=0 routes their writes
+        # to the trash page, emit=False parks their PRNG keys
+        valid = np.zeros(B, np.int32)
+        emit = np.zeros(B, bool)
+        valid[decoding] = 1
+        emit[decoding] = True
+        for b in decoding:
+            self._cow(b, int(self._pos[b]), int(self._pos[b]) + 1)
+        t0 = time.perf_counter()
+        self._kc, self._vc, nxt, keys = self._paged_step(
+            self.params, self._kc, self._vc,
+            jnp.asarray(self._tok[:, None]), jnp.asarray(self._pos),
+            jnp.asarray(valid), jnp.asarray(emit),
+            jnp.asarray(self.pool.table), jnp.asarray(self._do_sample),
+            jnp.asarray(self._temp), jnp.asarray(self._top_p),
+            jnp.asarray(self._keys))
+        nxt = np.asarray(nxt)
+        self._keys = np.array(keys)
+        now = time.perf_counter()
+        metrics.bump("paged_steps")
+        metrics.add_time("decode_time_s", now - t0)
+        # the latency a decode stream OBSERVES spans the whole boundary —
+        # interleaved prefill chunks and CoW copies included — which is
+        # exactly the gap chunked prefill is supposed to bound
+        metrics.observe_token_latency(now - t_boundary, 1)
+        for b in decoding:
+            req = self._slots[b]
+            self._pos[b] += 1
+            self._emit_token(req, b, int(nxt[b]), first=False)
+
+    def _prefill_chunk(self, b):
+        """Advance slot b's prefill by one chunk ([1, rung] dispatch of
+        the fused step); the final chunk emits the request's first token."""
+        req = self._slots[b]
+        plen = req.prompt_len
+        off = int(self._chunk_off[b])
+        remaining = plen - off
+        # largest ladder rung <= the page-rounded remainder: bulk prefill
+        # uses the big rung, the tail steps down so the final chunk's
+        # padding stays < page_size
+        target = min(-(-remaining // self.page_size) * self.page_size,
+                     self._chunk_ladder[-1])
+        C = max(c for c in self._chunk_ladder if c <= target)
+        v = min(C, remaining)
+        last = off + v >= plen                # final chunk emits token #1
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :v] = req.prompt[off:off + v]
+        self._cow(b, off, off + v)
+        t0 = time.perf_counter()
+        self._kc, self._vc, nxt, keys = self._paged_step(
+            self.params, self._kc, self._vc, jnp.asarray(ids),
+            jnp.asarray([off], np.int32), jnp.asarray([v], np.int32),
+            jnp.asarray([last]), jnp.asarray(self.pool.table[b:b + 1]),
+            jnp.asarray(self._do_sample[b:b + 1]),
+            jnp.asarray(self._temp[b:b + 1]),
+            jnp.asarray(self._top_p[b:b + 1]),
+            jnp.asarray(self._keys[b:b + 1]))
+        metrics.bump("paged_steps")
+        metrics.bump("chunk_steps")
+        metrics.bump("prefill_chunks")
+        metrics.add_time("prefill_time_s", time.perf_counter() - t0)
+        self._keys[b] = np.asarray(keys)[0]
+        if last:
+            self._chunk_off[b] = plen
+            self._pos[b] = plen               # next decode writes here
+            # only the final chunk is padded: waste < chunk per request
+            metrics.observe_prefill_waste(C - v)
+            tok = int(np.asarray(nxt)[0])
+            self._emit_token(req, b, tok, first=True)
+        else:
+            self._chunk_off[b] = off + v
+
+    def _emit_token(self, req, b, tok, first):
+        req._emit(tok)
+        metrics.bump("tokens_out")
+        self._tok[b] = tok
+        if first:
+            metrics.observe_ttft(req.first_token_t - req.submit_t)
+        if req.stop_token_ids and tok in req.stop_token_ids:
+            self._free_slot(b)
+            self._resolve(req, STOP)
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._free_slot(b)
+            self._resolve(req, LENGTH)
+
+    def _try_reserve(self, req):
+        """Page-aware admission predicate (the scheduler's ``fits``): pin
+        the longest cached prompt prefix, then allocate every page the
+        request can touch over its WHOLE lifetime (prompt + max_new_tokens,
+        plus a copy-on-write spare when sharing overlaps the write range).
+        Returns False — pool untouched — when pages don't suffice yet; the
+        head then waits for running requests to release pages (strict
+        FCFS, no starvation)."""
+        pool = self.pool
+        ps = self.page_size
+        plen = req.prompt_len
+        total = pages_for(plen + req.max_new_tokens, ps)
+        m, shared, exact = pool.lookup(req.prompt)
+        # at least the last prompt token must be (re-)forwarded so the
+        # first emitted token has logits — even on an exact-prompt hit
+        chunk_start = min(m, plen - 1)
+        n_shared = len(shared)
+        pool.incref(shared)       # pin before eviction can drop the entries
+        # CoW spare: needed only when a shared page overlaps this
+        # request's write range (an exact-prompt hit sharing the partial
+        # last page) — prefix registration happens on slot RELEASE, so a
+        # request never CoWs against its own registration
+        spare_needed = n_shared > 0 and n_shared - 1 >= chunk_start // ps
+        need = (total - n_shared) + (1 if spare_needed else 0)
+        got = pool.try_alloc(need)
+        if got is None:
+            pool.decref(shared)
+            return False
+        spare = got.pop() if spare_needed else None
+        req._page_plan = (chunk_start, shared, got, spare)
+        # ledger per successful ADMISSION (fits may poll a waiting head
+        # many times; that must not dilute the hit rate)
+        if pool.prefix_cache_enabled:
+            metrics.bump("prefix_lookups")
+        if n_shared:
+            metrics.bump("prefix_hits")
+            metrics.bump("prefix_tokens_reused", chunk_start)
+        return True
+
     def _admit(self, req, b):
+        if self.kv_layout == "paged":
+            return self._admit_paged(req, b)
+        return self._admit_pooled(req, b)
+
+    def _admit_paged(self, req, b):
+        """Bind slot b to the request's page plan (reserved by
+        _try_reserve): cached prefix pages map logical 0..n_shared-1, fresh
+        pages cover the rest of prompt + max_new_tokens. No forward pass
+        happens here — the prompt prefills chunk-by-chunk inside the fused
+        step, interleaved with every other slot's decode."""
+        chunk_start, shared, private, spare = req._page_plan
+        del req._page_plan
+        self.pool.map_slot(b, list(shared) + list(private), spare)
+        req.state = RUNNING
+        req.slot = b
+        self._slots[b] = req
+        self._chunk_off[b] = chunk_start
+        self._admit_count += 1
+        self._admit_seq[b] = self._admit_count
+        self._pos[b] = 0
+        self._tok[b] = 0
+        self._keys[b] = np.asarray(
+            jax.random.key_data(jax.random.key(req.seed)))
+        self._do_sample[b] = bool(req.do_sample)
+        self._temp[b] = float(req.temperature)
+        self._top_p[b] = 1.0 if req.top_p is None else float(req.top_p)
+        metrics.bump("admitted")
+
+    def _admit_pooled(self, req, b):
         """Prefill req's prompt into slot b (prompt padded to its bucket);
         the prefill emits the request's FIRST token (TTFT stops here)."""
         plen = req.prompt_len
         bucket = self.scheduler.bucket_for(plen)
+        metrics.observe_prefill_waste(bucket - plen)
         ids = np.zeros(bucket, np.int32)
         ids[:plen] = req.prompt
         key0 = jax.random.key_data(jax.random.key(req.seed))
@@ -348,9 +691,32 @@ class Engine:
         self._top_p[b] = 1.0 if req.top_p is None else float(req.top_p)
 
     def _free_slot(self, b):
+        req = self._slots[b]
+        if self.kv_layout == "paged" and req is not None \
+                and int(self._chunk_off[b]) >= req.prompt_len:
+            # publish the prompt's pages for prefix reuse ON RELEASE
+            # (vLLM-style cache-on-free): the slot never decodes into a
+            # cache-pinned page, so registration costs zero CoW splits.
+            # Generated-token KV beyond the prompt in the partial last
+            # page is harmless — a consumer always CoW-copies that page
+            # before its first write, and never unmasks a position it has
+            # not itself written.
+            self.pool.register(req.prompt, b)
         self._slots[b] = None
         self._pos[b] = 0
         self._tok[b] = 0
+        self._chunk_off[b] = 0
+        # reset the sampling state too: a recycled slot must not carry its
+        # predecessor's temp/top_p/do_sample/PRNG key — stale values made
+        # slot-state debug dumps lie, and (worse) an admission that forgot
+        # to overwrite one of these would silently couple the new
+        # occupant's stream to the previous one's
+        self._keys[b] = 0
+        self._temp[b] = 1.0
+        self._top_p[b] = 1.0
+        self._do_sample[b] = False
+        if self.kv_layout == "paged":
+            self.pool.release_slot(b)
 
     def _resolve(self, req, reason, count="completed"):
         if req.state != FINISHED:

@@ -26,6 +26,21 @@ def _zero():
         # executables
         "prefill_calls": 0, "prefill_traces": 0,
         "decode_steps": 0, "decode_traces": 0,
+        # paged engine: fused chunk/decode dispatches. paged_traces freezes
+        # after warmup at 1 (the [B,1] decode shape) + one [1,rung] trace
+        # per chunk-ladder rung actually used; copy_traces at <= 1.
+        "paged_steps": 0, "paged_traces": 0,
+        "chunk_steps": 0, "prefill_chunks": 0,
+        "cow_copies": 0, "copy_traces": 0,
+        # prefix cache
+        "prefix_lookups": 0, "prefix_hits": 0, "prefix_tokens_reused": 0,
+        # page occupancy observed at step boundaries
+        "pages_inuse_sum": 0, "pages_inuse_max": 0, "pages_total": 0,
+        "page_boundaries": 0,
+        # per-prefill padded-token waste: bucket - prompt_len (pooled) or
+        # n_chunks*chunk - prefilled_tokens (paged; < chunk per request)
+        "prefill_padded_tokens": 0, "prefill_padded_reqs": 0,
+        "prefill_padded_max": 0,
         # tokens / time
         "tokens_out": 0,
         "decode_time_s": 0.0, "prefill_time_s": 0.0,
@@ -63,6 +78,22 @@ def observe_boundary(queue_depth, active, slots):
         _C["slot_steps"] += slots
 
 
+def observe_pages(in_use, total):
+    with _lock:
+        _C["page_boundaries"] += 1
+        _C["pages_inuse_sum"] += in_use
+        _C["pages_inuse_max"] = max(_C["pages_inuse_max"], in_use)
+        _C["pages_total"] = total
+
+
+def observe_prefill_waste(padded_tokens):
+    with _lock:
+        _C["prefill_padded_reqs"] += 1
+        _C["prefill_padded_tokens"] += padded_tokens
+        _C["prefill_padded_max"] = max(_C["prefill_padded_max"],
+                                       padded_tokens)
+
+
 def observe_ttft(seconds):
     with _lock:
         _ttft.append(seconds)
@@ -92,6 +123,14 @@ def serving_counters():
                         if out["slot_steps"] else 0.0)
     out["queue_depth_mean"] = (out["queue_depth_sum"] / out["boundaries"]
                                if out["boundaries"] else 0.0)
+    out["page_occupancy"] = (
+        out["pages_inuse_sum"] / (out["page_boundaries"] * out["pages_total"])
+        if out["page_boundaries"] and out["pages_total"] else 0.0)
+    out["prefix_hit_rate"] = (out["prefix_hits"] / out["prefix_lookups"]
+                              if out["prefix_lookups"] else 0.0)
+    out["prefill_waste_mean"] = (
+        out["prefill_padded_tokens"] / out["prefill_padded_reqs"]
+        if out["prefill_padded_reqs"] else 0.0)
     return out
 
 
@@ -108,10 +147,24 @@ def serving_summary():
     c = serving_counters()
     ttft = ("n/a" if c["ttft_p50"] is None
             else f"{c['ttft_p50'] * 1e3:.1f}/{c['ttft_p99'] * 1e3:.1f}ms")
+    paged = ""
+    if c["paged_steps"]:
+        paged = (f"  pages: {c['page_occupancy'] * 100:.1f}% of "
+                 f"{c['pages_total']} used "
+                 f"(max {c['pages_inuse_max']})  "
+                 f"prefix-hit: {c['prefix_hit_rate'] * 100:.1f}% "
+                 f"({c['prefix_tokens_reused']} tok reused)  "
+                 f"chunk-interleaved: {c['chunk_steps']}/{c['paged_steps']} "
+                 f"steps  cow: {c['cow_copies']}")
+    waste = ""
+    if c["prefill_padded_reqs"]:
+        waste = (f"  prefill-waste: {c['prefill_waste_mean']:.1f} "
+                 f"avg/{c['prefill_padded_max']} max pad tok")
     return (f"requests: {c['submitted']} submitted / {c['completed']} done "
             f"({c['expired']} expired, {c['rejected']} rejected)  "
             f"tokens: {c['tokens_out']}  tokens/s: {c['tokens_per_s']:.1f}  "
             f"ttft p50/p99: {ttft}  occupancy: {c['occupancy'] * 100:.1f}%  "
             f"queue: {c['queue_depth_mean']:.1f} avg/{c['queue_depth_max']} max  "
             f"executables: {c['prefill_traces']} prefill + "
-            f"{c['decode_traces']} decode")
+            f"{c['decode_traces']} decode + {c['paged_traces']} paged"
+            f"{paged}{waste}")

@@ -1,0 +1,262 @@
+"""Block-paged KV pool for the serving engine (vLLM-style PagedAttention
+bookkeeping, host side).
+
+The device arrays are ``[L, P, page_size, nh, d]`` — P physical pages shared
+by every slot — plus a host-authoritative slot->page table ``[B, MP]``
+(uploaded as a traced operand each step, like every other per-slot
+quantity). This module owns everything that is pure bookkeeping:
+
+* **free-page allocator** — refcounted physical pages. Page 0 is the
+  reserved TRASH page: never handed out, the write target for padding
+  lanes and inactive slots, and the read target of unmapped table entries
+  (always masked out by the causal mask, so its garbage is never observed).
+* **prefix cache** — hash-matched prompt prefixes map the SAME physical
+  pages (refcount++) instead of recomputing their KV. Two entry kinds:
+  cumulative full-page hashes (``prompt[:k*page_size]`` -> page) and an
+  exact-prompt entry (whole prompt -> all its pages, including a partial
+  last page). LRU entries are evicted when admission needs pages.
+* **copy-on-write** — a slot may only WRITE a page it exclusively owns.
+  ``make_writable`` copies any shared page in the write range to a fresh
+  page first (the engine executes the device copy); sharing therefore
+  never lets one request's decode corrupt another's prefix.
+
+Sharing is bitwise-safe because the KV of a token depends only on the
+token prefix before it: two requests whose prompts agree on ``m`` tokens
+compute bit-identical K/V for those positions, so reading the cached pages
+is indistinguishable from recomputing them.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+
+class PagePoolExhausted(RuntimeError):
+    """No free physical page available (after cache eviction)."""
+
+
+def pages_for(tokens, page_size):
+    """Number of pages covering `tokens` positions."""
+    return -(-int(tokens) // int(page_size))
+
+
+class PagedKVPool:
+    """Host-side page bookkeeping: allocator + slot page table + prefix
+    cache. Device KV arrays live in the engine; this class only decides
+    WHICH physical page each (slot, logical page) maps to."""
+
+    def __init__(self, num_slots, max_seq_len, page_size, num_pages=0,
+                 prefix_cache=True):
+        self.page_size = int(page_size)
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.slot_pages = pages_for(max_seq_len, self.page_size)  # MP
+        self.num_slots = int(num_slots)
+        auto = self.num_slots * self.slot_pages + 1
+        self.num_pages = int(num_pages) or auto
+        if self.num_pages < 2:
+            raise ValueError("need at least 2 pages (one is the trash page)")
+        P = self.num_pages
+        # slot -> physical page, logical order; 0 = unmapped/trash
+        self.table = np.zeros((self.num_slots, self.slot_pages), np.int32)
+        self.ref = np.zeros(P, np.int64)
+        self.ref[0] = 1                      # trash page pinned forever
+        self._free = list(range(P - 1, 0, -1))   # LIFO; pops ascending ids
+        self._spare = [None] * self.num_slots    # per-slot CoW reserve page
+        self.prefix_cache_enabled = bool(prefix_cache)
+        # LRU: key -> page id (full-page entries, key=(b"P", bytes)) or
+        # (tuple(pages), plen) (exact entries, key=(b"E", bytes))
+        self._cache = OrderedDict()
+        # audit counters (the leak gate sums these)
+        self.allocated = 0
+        self.freed = 0
+
+    # -- allocator -----------------------------------------------------------
+    @property
+    def free_count(self):
+        return len(self._free)
+
+    @property
+    def pages_in_use(self):
+        return self.num_pages - 1 - len(self._free)
+
+    def _alloc_one(self):
+        if not self._free:
+            self._evict_until(1)
+        if not self._free:
+            raise PagePoolExhausted(
+                f"no free KV page ({self.num_pages - 1} pages all in use)")
+        p = self._free.pop()
+        assert self.ref[p] == 0
+        self.ref[p] = 1
+        self.allocated += 1
+        return p
+
+    def try_alloc(self, n):
+        """Allocate n pages (evicting LRU cache entries if needed) or None
+        if the pool can't cover them; all-or-nothing."""
+        if self.free_count < n:
+            self._evict_until(n)
+        if self.free_count < n:
+            return None
+        return [self._alloc_one() for _ in range(n)]
+
+    def incref(self, pages):
+        for p in pages:
+            assert p != 0
+            self.ref[p] += 1
+
+    def decref(self, pages):
+        for p in pages:
+            assert p != 0 and self.ref[p] > 0
+            self.ref[p] -= 1
+            if self.ref[p] == 0:
+                self._free.append(int(p))
+                self.freed += 1
+
+    # -- slot mapping --------------------------------------------------------
+    def map_slot(self, b, pages, spare=None):
+        """Bind `pages` (already ref-held by the caller) to slot b's logical
+        pages 0..len-1; optionally park a pre-allocated CoW spare page."""
+        self.table[b] = 0
+        self.table[b, :len(pages)] = pages
+        self._spare[b] = spare
+
+    def release_slot(self, b):
+        """Unmap slot b: decref every mapped page and the CoW spare."""
+        mapped = [int(p) for p in self.table[b] if p != 0]
+        self.table[b] = 0
+        self.decref(mapped)
+        if self._spare[b] is not None:
+            self.decref([self._spare[b]])
+            self._spare[b] = None
+
+    def make_writable(self, b, start, end):
+        """Ensure slot b exclusively owns every page covering positions
+        [start, end): any page with refcount > 1 (shared with another slot
+        or pinned by the prefix cache) is remapped to a fresh page. Returns
+        [(src, dst), ...] physical copies the engine must execute BEFORE
+        the step that writes this range (the CoW split)."""
+        ps = self.page_size
+        copies = []
+        for li in range(start // ps, (end - 1) // ps + 1):
+            phys = int(self.table[b, li])
+            assert phys != 0, f"slot {b} writing unmapped logical page {li}"
+            if self.ref[phys] == 1:
+                continue
+            if self._spare[b] is not None:
+                dst = self._spare[b]
+                self._spare[b] = None
+            else:
+                dst = self._alloc_one()
+            copies.append((phys, dst))
+            self.table[b, li] = dst
+            self.decref([phys])
+        return copies
+
+    # -- prefix cache --------------------------------------------------------
+    def lookup(self, prompt):
+        """Longest cached prefix of `prompt` (np.int32 [plen]). Returns
+        (matched_tokens, pages, exact): `pages` cover logical pages
+        0..ceil(matched/page_size)-1 and are NOT ref-held yet (caller
+        increfs). exact=True when the whole prompt matched an exact entry
+        (prefill reduces to re-forwarding the last prompt token)."""
+        if not self.prefix_cache_enabled:
+            return 0, [], False
+        raw = prompt.tobytes()
+        hit = self._cache.get((b"E", raw))
+        if hit is not None:
+            self._cache.move_to_end((b"E", raw))
+            pages, plen = hit
+            return plen, list(pages), True
+        ps = self.page_size
+        pages = []
+        for j in range(1, len(prompt) // ps + 1):
+            key = (b"P", prompt[:j * ps].tobytes())
+            page = self._cache.get(key)
+            if page is None:
+                break
+            self._cache.move_to_end(key)
+            pages.append(page)
+        return len(pages) * ps, pages, False
+
+    def register(self, prompt, b, min_free_frac=0.25):
+        """Publish slot b's prompt pages into the cache (cumulative
+        full-page hashes + the exact-prompt entry). The engine calls this
+        on slot RELEASE (cache-on-free): the prompt KV is complete on
+        device and the slot will never write these pages again, so
+        registration never forces a copy-on-write against its own owner.
+        Already-cached keys are left untouched.
+
+        Under page pressure (free < min_free_frac of the pool) new
+        registrations are SKIPPED: pinning a one-off prompt's pages when
+        the allocator is tight just evicts hotter entries (the shared
+        system prompts every request re-reads) in an endless churn. Hot
+        entries registered at low pressure survive — every lookup hit
+        refreshes their LRU recency."""
+        if not self.prefix_cache_enabled:
+            return
+        if self.free_count < max(1, int((self.num_pages - 1)
+                                        * min_free_frac)):
+            return
+        ps = self.page_size
+        row = self.table[b]
+        for j in range(1, len(prompt) // ps + 1):
+            key = (b"P", prompt[:j * ps].tobytes())
+            if key not in self._cache:
+                page = int(row[j - 1])
+                self._cache[key] = page
+                self.incref([page])
+        ekey = (b"E", prompt.tobytes())
+        if ekey not in self._cache:
+            pages = tuple(int(p) for p in
+                          row[:pages_for(len(prompt), ps)])
+            self._cache[ekey] = (pages, len(prompt))
+            self.incref(pages)
+
+    def _evict_until(self, need_free):
+        """Drop LRU cache entries until `need_free` pages are free (or the
+        cache is empty). Pages still mapped by running slots survive the
+        decref — eviction only forgets the cache's pin."""
+        while self._cache and self.free_count < need_free:
+            key, val = self._cache.popitem(last=False)
+            pages = [val] if key[0] == b"P" else list(val[0])
+            self.decref(pages)
+
+    def clear_cache(self):
+        self._evict_until(self.num_pages)
+
+    @property
+    def cache_entries(self):
+        return len(self._cache)
+
+    # -- audit ---------------------------------------------------------------
+    def balance(self):
+        """Allocator conservation snapshot for the leak gate: free + in-use
+        must always equal num_pages - 1, and refcounts must account for
+        every mapped/cached pin."""
+        slot_refs = np.zeros(self.num_pages, np.int64)
+        for b in range(self.num_slots):
+            for p in self.table[b]:
+                if p != 0:
+                    slot_refs[p] += 1
+            if self._spare[b] is not None:
+                slot_refs[self._spare[b]] += 1
+        cache_refs = np.zeros(self.num_pages, np.int64)
+        for key, val in self._cache.items():
+            for p in ([val] if key[0] == b"P" else val[0]):
+                cache_refs[p] += 1
+        accounted = bool((self.ref[1:] ==
+                          (slot_refs + cache_refs)[1:]).all())
+        return {
+            "num_pages": self.num_pages,
+            "free": self.free_count,
+            "in_use": self.pages_in_use,
+            "conserved": self.free_count + self.pages_in_use
+            == self.num_pages - 1,
+            "refcounts_accounted": accounted,
+            "cache_entries": len(self._cache),
+            "allocated": self.allocated,
+            "freed": self.freed,
+        }

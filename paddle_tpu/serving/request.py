@@ -74,6 +74,13 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
         if self.max_new_tokens < 0:
             raise ValueError(
                 f"max_new_tokens must be >= 0, got {self.max_new_tokens}")
+        if self.do_sample and self.temperature <= 0:
+            # _mask_logits divides by the (clamped) temperature — a 0/neg
+            # value would push every logit to +/-inf and sample garbage;
+            # greedy requests never touch it, so they pass through
+            raise ValueError(
+                f"temperature must be > 0 for sampled requests, got "
+                f"{self.temperature} (use do_sample=False for greedy)")
         self.stop_token_ids = _normalize_stop(
             self.eos_token_id, self.stop_token_ids) or ()
         if self.top_k == 0:            # generate's "disabled" spelling

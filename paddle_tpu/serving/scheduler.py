@@ -77,18 +77,29 @@ class Scheduler:
         return expired
 
     # -- admission -----------------------------------------------------------
-    def admit(self, free_slots, now=None):
+    def admit(self, free_slots, now=None, fits=None):
         """Pop up to free_slots admissible requests FCFS. Requests whose
         deadline already passed are popped, marked EXPIRED and returned
-        separately (they never occupy a slot)."""
+        separately (they never occupy a slot).
+
+        ``fits`` is the paged engine's page-aware admission predicate: the
+        queue head is admitted only when the page pool can hold its whole
+        lifetime (prompt + max_new_tokens, minus prefix-shared pages) —
+        admission is bounded by PAGES, not whole-Smax slots. A head that
+        doesn't fit STOPS admission (strict FCFS — no head-of-line bypass,
+        so admission order stays deterministic and starvation-free)."""
         now = time.perf_counter() if now is None else now
         admitted, expired = [], []
         while self._q and len(admitted) < free_slots:
-            req = self._q.popleft()
+            req = self._q[0]
             dl = req.deadline
             if dl is not None and now > dl:
+                self._q.popleft()
                 req._finish(EXPIRED)
                 expired.append(req)
                 continue
+            if fits is not None and not fits(req):
+                break
+            self._q.popleft()
             admitted.append(req)
         return admitted, expired

@@ -1,0 +1,500 @@
+"""Paged-KV serving engine (kv_layout="paged", the default).
+
+Mirror of test_serving.py's bitwise gates on the block-paged layout:
+  * for ANY admission order, each request's tokens are bitwise identical
+    to single-request generate_from_params — greedy AND sampled, with
+    chunked prefill and prefix sharing enabled;
+  * prefix-shared requests (page-aligned siblings and exact-prompt
+    duplicates) diverge correctly after the copy-on-write split;
+  * mid-flight join/cancel/evict leaves neighbor streams bitwise-stable;
+  * steady state uses a STATIC executable set (fused step at T=1 and
+    T=chunk + the CoW page copy), trace-counter gated;
+  * the page allocator balances (no leaks) and admission is page-aware
+    (a workload that overflows the pooled layout's per-slot Smax serves
+    fine from pages);
+plus this PR's satellites: temperature validation, recycled-slot state
+reset, prefill padded-waste metric, and the Pallas kernel's interpret-mode
+parity with the jnp gather path.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import profiler, serving
+from paddle_tpu.models.generation import generate_from_params
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.models.gpt_hybrid import init_gpt_params
+
+CFG = GPTConfig(vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+                max_seq_len=128, dropout=0.0, use_flash=False,
+                compute_dtype="float32", remat=False)
+_PARAMS = None
+
+
+def _params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = init_gpt_params(CFG, jax.random.key(0))
+    return _PARAMS
+
+
+def _engine(**kw):
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("kv_layout", "paged")
+    return serving.Engine(params=_params(), config=CFG, **kw)
+
+
+def _ref_tokens(prompt, max_new, **kw):
+    out = np.asarray(generate_from_params(_params(), np.asarray(prompt)[None],
+                                          CFG, max_new_tokens=max_new,
+                                          **kw)._data)
+    return out[0, len(prompt):].tolist()
+
+
+# shape palette shared with test_serving.py (warm jit cache for the
+# reference); includes prompts longer than the chunk so prefill chunking
+# and page crossing are always exercised
+_SHAPES = ((3, 4), (5, 6), (9, 4), (13, 6), (21, 5), (37, 4))
+
+
+def _mixed_requests(n, rng, **kw):
+    reqs = []
+    for i in range(n):
+        plen, mnt = _SHAPES[i % len(_SHAPES)]
+        reqs.append(serving.Request(rng.integers(0, CFG.vocab_size, plen),
+                                    max_new_tokens=mnt, **kw))
+    return reqs
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity gates
+
+
+def test_greedy_bitwise_parity_chunked_mixed_lengths():
+    eng = _engine()
+    reqs = _mixed_requests(8, np.random.default_rng(0))
+    results = eng.run(reqs)
+    for r in reqs:
+        assert results[r.request_id].tokens == \
+            _ref_tokens(r.prompt, r.max_new_tokens), \
+            f"request {r.request_id} diverged from single-request decode"
+
+
+def test_sampled_stream_matches_generate():
+    eng = _engine()
+    prompt = np.array([5, 17, 33, 2, 9])
+    req = serving.Request(prompt, max_new_tokens=8, do_sample=True,
+                          temperature=0.8, top_p=0.9, seed=7)
+    res = eng.run([req])[req.request_id]
+    assert res.tokens == _ref_tokens(prompt, 8, do_sample=True,
+                                     temperature=0.8, top_p=0.9, seed=7)
+    # sampled without a nucleus cut: traced top_p=1.0 stand-in vs the
+    # structural None skip
+    req2 = serving.Request(np.arange(3, 11), max_new_tokens=8,
+                           do_sample=True, temperature=1.3, seed=11)
+    res = eng.run([req2])[req2.request_id]
+    assert res.tokens == _ref_tokens(np.arange(3, 11), 8, do_sample=True,
+                                     temperature=1.3, seed=11)
+
+
+def test_admission_order_invariance_under_page_contention():
+    """Same request set, two submission orders, a pool small enough that
+    admission WAITS on pages: per-request tokens must be identical. Shared
+    prefixes are included — prefix reuse must be output-invariant."""
+    rng = np.random.default_rng(1)
+    base = rng.integers(0, CFG.vocab_size, 17)
+    prompts = [base.copy(),
+               np.concatenate([base[:8], rng.integers(0, 97, 6)]),
+               rng.integers(0, CFG.vocab_size, 5),
+               rng.integers(0, CFG.vocab_size, 11)]
+    outs = []
+    for order in ((0, 1, 2, 3), (3, 2, 1, 0)):
+        eng = _engine(num_slots=2, num_pages=13)   # 12 usable pages
+        reqs = [serving.Request(prompts[i], max_new_tokens=5) for i in order]
+        results = eng.run(reqs)
+        outs.append({tuple(r.prompt.tolist()): results[r.request_id].tokens
+                     for r in reqs})
+    assert outs[0] == outs[1]
+    for p, toks in outs[0].items():
+        assert toks == _ref_tokens(np.asarray(p, np.int32), 5)
+
+
+def test_midflight_join_and_evict_keep_slots_bitwise_stable():
+    eng = _engine(num_slots=3)
+    long_req = serving.Request(np.arange(2, 9), max_new_tokens=24)
+    victim = serving.Request(np.arange(30, 40), max_new_tokens=24)
+    eng.submit(long_req)
+    eng.submit(victim)
+    for _ in range(4):
+        eng.step()
+    joiners = _mixed_requests(4, np.random.default_rng(2))
+    for r in joiners:
+        eng.submit(r)
+    eng.step()
+    eng.cancel(victim)
+    results = eng.run()
+    assert results[victim.request_id].finish_reason == serving.CANCELLED
+    assert results[long_req.request_id].tokens == \
+        _ref_tokens(long_req.prompt, 24)
+    for r in joiners:
+        assert results[r.request_id].tokens == \
+            _ref_tokens(r.prompt, r.max_new_tokens)
+    # a cancel mid-PREFILL must release the slot and its pages cleanly
+    in_prefill = serving.Request(np.arange(1, 40), max_new_tokens=4)
+    eng.submit(in_prefill)
+    eng.step()                       # first chunk issued, prefill unfinished
+    assert in_prefill.state == serving.RUNNING and not in_prefill.tokens
+    eng.cancel(in_prefill)
+    eng.run()
+    bal = eng.pool.balance()
+    assert bal["conserved"] and bal["refcounts_accounted"]
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing + copy-on-write
+
+
+def test_prefix_sharing_bitwise_and_cow_divergence():
+    profiler.reset_serving_counters()
+    eng = _engine(num_slots=4)
+    base = np.arange(1, 22)                   # 2 full pages + partial third
+    r1 = serving.Request(base, max_new_tokens=6)
+    res1 = eng.run([r1])[r1.request_id]
+    assert res1.tokens == _ref_tokens(base, 6)
+
+    # page-aligned sibling: same first 16 tokens, different tail
+    sib = np.concatenate([base[:16], np.array([60, 61, 62, 63, 64])])
+    r2 = serving.Request(sib, max_new_tokens=6)
+    # exact-prompt duplicates: greedy must REPLAY r1 bitwise; sampled must
+    # diverge per its own stream after the CoW split
+    r3 = serving.Request(base.copy(), max_new_tokens=6)
+    r4 = serving.Request(base.copy(), max_new_tokens=6, do_sample=True,
+                         temperature=0.7, seed=5)
+    results = eng.run([r2, r3, r4])
+    assert results[r2.request_id].tokens == _ref_tokens(sib, 6)
+    assert results[r3.request_id].tokens == res1.tokens
+    assert results[r4.request_id].tokens == \
+        _ref_tokens(base, 6, do_sample=True, temperature=0.7, seed=5)
+    assert results[r4.request_id].tokens != res1.tokens
+
+    c = profiler.serving_counters()
+    assert c["prefix_hits"] >= 3
+    assert c["prefix_tokens_reused"] >= 16 + 20 + 20
+    assert c["cow_copies"] >= 2          # exact-dup splits + self-share
+    assert c["prefix_hit_rate"] > 0
+    bal = eng.pool.balance()
+    assert bal["conserved"] and bal["refcounts_accounted"]
+
+
+def test_live_prefix_share_cancel_leaves_owner_stable():
+    """Two requests sharing cached pages CONCURRENTLY: cancelling one
+    mid-flight must not perturb the other's stream (pages are refcounted,
+    never stolen)."""
+    eng = _engine(num_slots=2)
+    base = np.arange(40, 61)
+    r0 = serving.Request(base, max_new_tokens=2)
+    eng.run([r0])                        # registers base's pages on release
+    r1 = serving.Request(base.copy(), max_new_tokens=20)   # shares + CoW
+    eng.submit(r1)
+    for _ in range(3):                   # r1 decoding on shared prefix
+        eng.step()
+    r2 = serving.Request(base.copy(), max_new_tokens=8)    # shares too
+    eng.submit(r2)
+    eng.step()
+    eng.cancel(r2)
+    results = eng.run()
+    assert results[r1.request_id].tokens == _ref_tokens(base, 20)
+    assert results[r2.request_id].finish_reason == serving.CANCELLED
+
+
+# ---------------------------------------------------------------------------
+# executable + allocator gates
+
+
+def test_steady_state_static_executable_set():
+    """After warmup the fused-step trace counter freezes at 2 (token
+    windows T=1 and T=chunk) and the CoW copy at <= 1 — joins, evicts,
+    chunked admissions, sampling sweeps and CoW remaps are pure data.
+    (num_slots=5 is unique in the suite: executables are shared ACROSS
+    engines per shape, so only fresh shapes show warmup traces.)"""
+    profiler.reset_serving_counters()
+    eng = _engine(num_slots=5)
+    eng.run(_mixed_requests(4, np.random.default_rng(3)))   # warmup
+    warm = profiler.serving_counters()
+    assert warm["paged_traces"] == 2
+    assert warm["copy_traces"] <= 1
+    assert warm["prefill_traces"] == 0 and warm["decode_traces"] == 0
+
+    rng = np.random.default_rng(4)
+    reqs = []
+    for i in range(7):
+        reqs.append(serving.Request(
+            rng.integers(0, CFG.vocab_size, int(rng.integers(3, 30))),
+            max_new_tokens=5, do_sample=bool(i % 2),
+            temperature=0.5 + 0.3 * i, top_p=0.7 + 0.04 * i, seed=i))
+    # an exact-prompt duplicate forces prefix reuse + CoW in steady state
+    reqs.append(serving.Request(reqs[0].prompt.copy(), max_new_tokens=5))
+    for r in reqs:
+        eng.submit(r)
+    eng.step()
+    eng.cancel(reqs[0] if reqs[0].state == serving.RUNNING else reqs[-1])
+    eng.run()
+    c = profiler.serving_counters()
+    assert c["paged_traces"] == 2, "fused step re-traced in steady state"
+    assert c["copy_traces"] <= 1, "page copy re-traced in steady state"
+    assert c["paged_steps"] > warm["paged_steps"]
+    assert c["chunk_steps"] > 0 and c["chunk_steps"] < c["paged_steps"]
+
+
+def test_page_allocator_balances_no_leaks():
+    """Allocator conservation through admission, sharing, CoW, eviction
+    and cancellation; after draining and dropping the prefix cache every
+    non-trash page is free again."""
+    profiler.reset_serving_counters()
+    eng = _engine(num_slots=4, num_pages=25)
+    rng = np.random.default_rng(5)
+    reqs = _mixed_requests(7, rng)
+    reqs.append(serving.Request(reqs[0].prompt.copy(), max_new_tokens=4))
+    for r in reqs:
+        eng.submit(r)
+    eng.step()
+    running = next(r for r in reqs if r.state == serving.RUNNING)
+    eng.cancel(running)
+    eng.run()
+    bal = eng.pool.balance()
+    assert bal["conserved"], bal
+    assert bal["refcounts_accounted"], bal
+    assert bal["free"] + bal["in_use"] == bal["num_pages"] - 1
+    eng.pool.clear_cache()
+    bal = eng.pool.balance()
+    assert bal["free"] == bal["num_pages"] - 1      # every page returned
+    assert bal["allocated"] == bal["freed"]
+    c = profiler.serving_counters()
+    assert c["page_occupancy"] > 0
+    assert c["pages_inuse_max"] <= 24
+
+
+def test_page_aware_admission_beyond_pooled_capacity():
+    """The paged engine serves a request whose prompt+max_new exceeds a
+    memory-equal pooled engine's per-slot Smax — admission is bounded by
+    pages, not worst-case slots. (The smoke tool benches the same setup.)"""
+    pooled = serving.Engine(params=_params(), config=CFG, num_slots=4,
+                            max_seq_len=48, prefill_buckets=(48,),
+                            kv_layout="pooled")
+    # same KV bytes: 4 slots x 48 = 192 token-slots = 24 pages x 8 (+trash)
+    paged = _engine(num_slots=4, max_seq_len=128, num_pages=25)
+    long_req = serving.Request(np.arange(1, 45), max_new_tokens=16)  # 60 > 48
+    with pytest.raises(ValueError):
+        pooled.submit(serving.Request(np.arange(1, 45), max_new_tokens=16))
+    shorts = [serving.Request(np.arange(2, 8), max_new_tokens=5)
+              for _ in range(3)]
+    results = paged.run([long_req] + shorts)
+    assert results[long_req.request_id].tokens == \
+        _ref_tokens(np.arange(1, 45), 16)
+    for r in shorts:
+        assert results[r.request_id].tokens == _ref_tokens(r.prompt, 5)
+    # impossible requests still fail fast instead of wedging the queue
+    with pytest.raises(ValueError):
+        paged.submit(serving.Request(np.arange(1, 100), max_new_tokens=60))
+
+
+def test_admission_waits_for_pages_then_proceeds():
+    """With a pool too small for two lifetimes at once, the second request
+    must WAIT (strict FCFS) and then serve bitwise-correctly once the
+    first releases its pages."""
+    eng = _engine(num_slots=2, num_pages=8, prefix_cache=False)  # 7 usable
+    a = serving.Request(np.arange(1, 20), max_new_tokens=13)     # 4 pages
+    b = serving.Request(np.arange(50, 70), max_new_tokens=12)    # 4 pages
+    eng.submit(a)
+    eng.submit(b)
+    eng.step()
+    assert a.state == serving.RUNNING
+    assert b.state == serving.QUEUED        # 3 free pages < 4 needed
+    results = eng.run()
+    assert results[a.request_id].tokens == _ref_tokens(a.prompt, 13)
+    assert results[b.request_id].tokens == _ref_tokens(b.prompt, 12)
+
+
+# ---------------------------------------------------------------------------
+# satellites
+
+
+def test_recycled_slot_sampled_stream_is_bitwise_independent():
+    """A recycled slot must not leak its predecessor's sampling state
+    (_keys/_temp/_top_p/_do_sample are reset by _free_slot): the second
+    occupant's stream is bitwise what a fresh engine would produce —
+    gated on BOTH layouts."""
+    for layout in ("paged", "pooled"):
+        kw = {"prefill_buckets": (16,)} if layout == "pooled" else {}
+        eng = _engine(num_slots=1, kv_layout=layout, **kw)
+        hot = serving.Request(np.arange(1, 6), max_new_tokens=6,
+                              do_sample=True, temperature=0.3, top_p=0.8,
+                              seed=13)
+        eng.run([hot])
+        # slot state must be fully reset after recycling
+        assert eng._slots[0] is None
+        assert not eng._do_sample[0] and eng._temp[0] == 1.0 \
+            and eng._top_p[0] == 1.0 and not eng._keys[0].any()
+        cold = serving.Request(np.arange(7, 13), max_new_tokens=6)
+        res = eng.run([cold])[cold.request_id]
+        assert res.tokens == _ref_tokens(np.arange(7, 13), 6), layout
+        cold2 = serving.Request(np.arange(7, 13), max_new_tokens=6,
+                                do_sample=True, temperature=0.9, seed=3)
+        res = eng.run([cold2])[cold2.request_id]
+        assert res.tokens == _ref_tokens(np.arange(7, 13), 6, do_sample=True,
+                                         temperature=0.9, seed=3), layout
+
+
+def test_temperature_validation():
+    """do_sample with temperature <= 0 is rejected up front (it used to
+    reach _mask_logits' division and sample from inf logits); greedy paths
+    ignore temperature entirely and stay accepted."""
+    for bad in (0.0, -1.0):
+        with pytest.raises(ValueError):
+            serving.Request(np.arange(4), max_new_tokens=2, do_sample=True,
+                            temperature=bad)
+        with pytest.raises(ValueError):
+            generate_from_params(_params(), np.arange(4)[None], CFG,
+                                 max_new_tokens=2, do_sample=True,
+                                 temperature=bad)
+    # greedy with temperature=0 passes through untouched on both entries
+    eng = _engine()
+    req = serving.Request(np.arange(1, 5), max_new_tokens=3, temperature=0.0)
+    res = eng.run([req])[req.request_id]
+    assert res.tokens == _ref_tokens(np.arange(1, 5), 3)
+    out = generate_from_params(_params(), np.arange(1, 5)[None], CFG,
+                               max_new_tokens=3, temperature=0.0)
+    assert np.asarray(out._data)[0, 4:].tolist() == res.tokens
+
+
+def test_prefill_waste_metric():
+    """Padded-token waste per prefill: paged chunks pad only the FINAL
+    chunk (< chunk tokens); the pooled layout pads every prompt to its
+    bucket."""
+    profiler.reset_serving_counters()
+    eng = _engine()                          # chunk == page_size == 8
+    eng.run([serving.Request(np.arange(1, 14), max_new_tokens=2)])  # plen 13
+    c = profiler.serving_counters()
+    assert c["prefill_padded_reqs"] == 1
+    assert c["prefill_padded_tokens"] == 3           # 2*8 - 13
+    assert c["prefill_padded_max"] < eng.page_size
+    assert "prefill-waste" in profiler.serving_summary()
+
+    profiler.reset_serving_counters()
+    pooled = serving.Engine(params=_params(), config=CFG, num_slots=2,
+                            max_seq_len=96, prefill_buckets=(16,),
+                            kv_layout="pooled")
+    pooled.run([serving.Request(np.arange(1, 14), max_new_tokens=2)])
+    c = profiler.serving_counters()
+    assert c["prefill_padded_tokens"] == 3           # 16 - 13
+
+
+def test_stop_conditions_and_deadlines_on_paged():
+    """Stop matrix + queue-expiry on the paged path."""
+    prompt = np.array([3, 14, 15, 92])
+    free = _ref_tokens(prompt, 8)
+    eng = _engine()
+    r_eos = serving.Request(prompt, max_new_tokens=8, eos_token_id=free[2])
+    r_len = serving.Request(prompt, max_new_tokens=4)
+    r_one = serving.Request(prompt, max_new_tokens=1)
+    dead = serving.Request(np.arange(1, 5), max_new_tokens=4, deadline_s=0.0)
+    import time
+    eng.submit(dead)
+    time.sleep(0.01)
+    results = eng.run([r_eos, r_len, r_one])
+    assert results[r_eos.request_id].tokens == free[:3]
+    assert results[r_eos.request_id].finish_reason == serving.STOP
+    assert results[r_len.request_id].tokens == free[:4]
+    assert results[r_one.request_id].tokens == free[:1]
+    assert results[dead.request_id].finish_reason == serving.EXPIRED
+    bal = eng.pool.balance()
+    assert bal["conserved"] and bal["refcounts_accounted"]
+
+
+def test_prefix_cache_disabled_is_private():
+    profiler.reset_serving_counters()
+    eng = _engine(prefix_cache=False)
+    base = np.arange(1, 22)
+    r1 = serving.Request(base, max_new_tokens=5)
+    r2 = serving.Request(base.copy(), max_new_tokens=5)
+    results = eng.run([r1, r2])
+    assert results[r1.request_id].tokens == results[r2.request_id].tokens \
+        == _ref_tokens(base, 5)
+    c = profiler.serving_counters()
+    assert c["prefix_lookups"] == 0 and c["prefix_hits"] == 0
+    assert c["cow_copies"] == 0
+    assert eng.pool.cache_entries == 0
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel (interpret mode — the TPU path's math vs the gather path)
+
+
+def test_paged_decode_kernel_matches_gather_reference():
+    from paddle_tpu.serving.paged_attention import paged_decode_attention
+    rng = np.random.default_rng(0)
+    B, nh, d, ps, MP, P = 3, 8, 128, 8, 4, 11
+    q = jnp.asarray(rng.standard_normal((B, nh, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((P, ps, nh, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((P, ps, nh, d)), jnp.float32)
+    table = jnp.asarray(rng.integers(1, P, (B, MP)), jnp.int32)
+    pos = jnp.asarray([5, 17, 30], jnp.int32)
+
+    S = MP * ps
+    kv_k = kc[table].reshape(B, S, nh, d)
+    kv_v = vc[table].reshape(B, S, nh, d)
+    mask = jnp.arange(S)[None, :] <= pos[:, None]
+    scores = jnp.einsum("bhd,bshd->bhs", q, kv_k) / (d ** 0.5)
+    scores = jnp.where(mask[:, None], scores, -jnp.inf)
+    want = jnp.einsum("bhs,bshd->bhd", jax.nn.softmax(scores, -1), kv_v)
+
+    got = paged_decode_attention(q, kc, vc, table, pos, page_size=ps,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_routing_predicate():
+    from paddle_tpu.serving.paged_attention import paged_kernel_supported
+    # off-TPU backends always fall back to the jnp gather path
+    assert not paged_kernel_supported(8, 128, 16)   # cpu backend here
+    assert not paged_kernel_supported(8, 64, 16)    # head_dim
+
+
+# ---------------------------------------------------------------------------
+# smoke-tool sub-rung: fast + deterministic in tier-1 (full ladder is slow)
+
+
+def _load_smoke():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "tools_serving_smoke",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools_serving_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_smoke_paged_deterministic_subrung():
+    """tools_serving_smoke's paged-vs-pooled rung in deterministic tiny
+    mode: output parity between layouts, chunked waste < page_size, and
+    the over-Smax capacity demo — no wall-clock gates (those are slow)."""
+    mod = _load_smoke()
+    out = mod.run_paged_rung(quick=True, deterministic=True)
+    assert out["outputs_match"]
+    assert out["capacity_only_paged"]
+    assert out["paged"]["prefill_waste_max"] < out["page_size"]
+
+
+@pytest.mark.slow
+def test_smoke_paged_beats_pooled():
+    mod = _load_smoke()
+    out = mod.run_paged_rung(quick=True)
+    assert out["speedup"] >= 1.3
+    assert out["paged"]["intertoken_p99_s"] <= out["pooled"]["intertoken_p99_s"]

@@ -42,6 +42,9 @@ def _engine(**kw):
     kw.setdefault("num_slots", 3)
     kw.setdefault("max_seq_len", 96)
     kw.setdefault("prefill_buckets", (16,))
+    # this suite gates the POOLED (PR 5 parity-baseline) layout; the paged
+    # layout has its own mirror suite in test_paged_serving.py
+    kw.setdefault("kv_layout", "pooled")
     return serving.Engine(params=_params(), config=CFG, **kw)
 
 
