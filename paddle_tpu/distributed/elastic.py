@@ -108,6 +108,9 @@ class Heartbeat:
                 self._step = int(step)
             if status is not None:
                 self._status = status
+            from ..utils import fault_injection as _fi
+            if _fi.maybe_drop_heartbeat(self.rank):
+                return  # chaos: frozen-process simulation — file goes stale
             tmp = self._path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump({"ts": time.time(), "rank": self.rank,
@@ -162,7 +165,8 @@ class HeartbeatMonitor:
         """Ranks that are missing, stale past timeout, or marked failed."""
         bad = []
         for r, info in self.poll().items():
-            if info is None or info["age"] > self.timeout or info["status"] == "failed":
+            if info is None or info["age"] > self.timeout \
+                    or info.get("status") == "failed":
                 bad.append(r)
         return bad
 

@@ -106,6 +106,21 @@ _FLAGS = {
     # equivalent but NOT bitwise identical to the jnp path — disable when
     # auditing bitwise parity on TPU.
     "FLAGS_serving_paged_kernel": True,
+    # -- self-healing serving (serving/engine.py + serving/supervisor.py) ---
+    # Engine-snapshot cadence: with a CheckpointManager attached
+    # (Engine.attach_checkpoint), every N step boundaries the FULL engine
+    # state (KV pool, slot table, PRNG streams, queue, results, metrics)
+    # is checkpointed through the hardened CRC/rename-aside path — a cold
+    # restart resumes every in-flight request bitwise mid-decode. 0 keeps
+    # only the SIGTERM boundary flush.
+    "FLAGS_serving_snapshot_every": 32,
+    # Per-replica respawn budget for the ServingSupervisor; past it the
+    # replica stays down and its unacknowledged requests are replayed on
+    # the surviving replicas.
+    "FLAGS_serving_max_restarts": 3,
+    # Heartbeat staleness threshold (seconds) past which the supervisor
+    # declares a replica frozen and fails it over.
+    "FLAGS_serving_heartbeat_timeout": 10.0,
     # Ring-decomposed compute/communication overlap on the mp axis: the
     # pre-QKV/FFN all-gather splits into mp-1 ppermute hops with each
     # chunk's GEMM issued on arrival, and the RowParallel GEMM emits

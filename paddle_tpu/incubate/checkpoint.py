@@ -104,7 +104,14 @@ def _tree_checksums(snap):
 
 class CheckpointManager:
     def __init__(self, directory, keep_last_n=3, async_save=True,
-                 retries=3, retry_backoff=0.05, verify=True):
+                 retries=3, retry_backoff=0.05, verify=True,
+                 site="ckpt_write"):
+        # ``site`` names this manager's writes to the fault-injection
+        # harness: serving engines pass "serving_snapshot" so snapshot
+        # chaos (FaultPlan.io_error_on_snapshots) can be scheduled
+        # independently of training-checkpoint chaos while sharing the
+        # whole hardened write/verify/quarantine path below.
+        self.site = site
         self.directory = os.fspath(directory)
         self.keep_last_n = int(keep_last_n)
         self.async_save = bool(async_save)
@@ -241,7 +248,7 @@ class CheckpointManager:
         _count("saves")
 
     def _write_once(self, step, snap):
-        _fi.maybe_fail_write("ckpt_write")
+        _fi.maybe_fail_write(self.site)
         final = self._step_dir(step)
         tmp = final + ".tmp"
         if os.path.exists(tmp):
@@ -389,7 +396,14 @@ class CheckpointManager:
         compiled steps — the immediate handler runs between arbitrary
         bytecodes, where a state_fn snapshot can catch weights mid-rebind
         (deleted donated buffers) or weights/position from different steps.
+
+        Installing RE-ARMS the manager: a ``preempted`` flag left over
+        from a previously-handled preemption is cleared, so a warm
+        restart that reuses the same manager (serving engines restore
+        from its snapshot dir and attach it again) does not insta-drain
+        on a preemption that was already flushed and unwound.
         """
+        self.preempted = False
         def handler(signum, frame):
             self.preempted = True
             if defer:

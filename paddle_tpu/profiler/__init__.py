@@ -324,11 +324,16 @@ def fault_summary():
 # paged layout's fused step and CoW page copy) are the no-recompile audit
 # trail: each jitted body counts only when actually traced, so after warmup
 # the counts freeze — joins, evicts, chunked admissions, CoW remaps and
-# sampling-param changes must not move them. TTFT/token-latency
-# percentiles, tokens/s, slot occupancy and queue depth are the serving
-# SLO surface; the paged layout adds page occupancy, prefix-cache hit
-# rate / tokens reused, chunk-interleave counters and per-prefill
-# padded-token waste.
+# sampling-param changes must not move them (and an Engine RESTORED from a
+# snapshot re-dispatches the warm executables, so a restore must not move
+# them either). TTFT/token-latency percentiles, tokens/s, slot occupancy
+# and queue depth are the serving SLO surface; the paged layout adds page
+# occupancy, prefix-cache hit rate / tokens reused, chunk-interleave
+# counters and per-prefill padded-token waste. The self-healing runtime
+# (engine snapshots + ServingSupervisor) adds the recovery ledger:
+# snapshots/snapshot_restores, preempt_drains, requeued/replayed,
+# respawns, stale_failovers, rolling_restarts — and "dropped", which must
+# stay 0 through any kill/preemption/rolling-restart story.
 
 
 def serving_counters():
@@ -351,6 +356,18 @@ def serving_summary():
     """One-line human-readable serving report."""
     from ..serving import metrics
     return metrics.serving_summary()
+
+
+def recovery_counters():
+    """Self-healing subset of the serving ledger: engine snapshots taken /
+    restored, preemption drains, requests requeued / replayed, replica
+    respawns, stale-heartbeat failovers, rolling restarts, and dropped
+    (the invariant: 0)."""
+    c = serving_counters()
+    return {k: c[k] for k in
+            ("snapshots", "snapshot_restores", "preempt_drains", "requeued",
+             "replayed", "respawns", "stale_failovers", "rolling_restarts",
+             "dropped")}
 
 
 def benchmark():

@@ -7,14 +7,25 @@ the decode step); the PR 5 pooled ``[L, B, Smax, nh, d]`` layout remains
 available as the bitwise parity baseline (``kv_layout="pooled"``). See
 engine.py for the design; `profiler.serving_counters()` /
 `serving_summary()` for observability.
+
+Self-healing (engine.py + supervisor.py): `Engine.state_dict()` /
+`load_state_dict()` snapshot the FULL engine (KV, slot table, PRNG
+streams, queue, results, metrics) through the hardened checkpoint path —
+a cold restart resumes every in-flight request bitwise mid-decode;
+`Engine.run()` installs a SIGTERM boundary drain that flushes a snapshot
+and requeues in-flight requests instead of dropping them; and
+`ServingSupervisor` runs N replicas behind a least-loaded router with
+heartbeat failure detection, snapshot respawn and exact request replay
+(zero requests dropped across replica death / rolling restarts).
 """
 from .request import (  # noqa: F401
     Request, GenerationResult,
-    QUEUED, RUNNING, FINISHED, STOP, LENGTH, EXPIRED, CANCELLED,
+    QUEUED, RUNNING, FINISHED, STOP, LENGTH, EXPIRED, CANCELLED, DROPPED,
 )
 from .scheduler import Scheduler, QueueFullError  # noqa: F401
 from .paged_kv import PagedKVPool, PagePoolExhausted, pages_for  # noqa: F401
-from .engine import Engine  # noqa: F401
+from .engine import Engine, EngineStoppedError  # noqa: F401
+from .supervisor import ServingSupervisor  # noqa: F401
 from .metrics import (  # noqa: F401
     serving_counters, reset_serving_counters, serving_summary,
 )

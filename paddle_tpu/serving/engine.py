@@ -51,6 +51,7 @@ device-resident (donated back into the next step's executable off-CPU).
 """
 from __future__ import annotations
 
+import threading
 import time
 from functools import lru_cache
 
@@ -59,6 +60,7 @@ import jax
 import jax.numpy as jnp
 
 from ..flags import get_flags
+from ..utils import fault_injection as _fi
 from ..models.generation import (
     _cfg_key, _cfg_view, _collect_params, _forward_cached,
     _forward_decode_slots, _logical_qkv, _mask_logits,
@@ -71,6 +73,19 @@ from .request import (
     GenerationResult, Request,
 )
 from .scheduler import QueueFullError, Scheduler
+
+
+class EngineStoppedError(RuntimeError):
+    """submit() on a drained/stopped engine. Carries the work the drain
+    handed back so a router can act instead of guessing: ``queue_depth``
+    (requests the drain requeued and still unclaimed) and ``requeued``
+    (their request ids — resubmit them, or this new request, to a live
+    replica or to an engine restored from this one's last snapshot)."""
+
+    def __init__(self, message, queue_depth=0, requeued=()):
+        super().__init__(message)
+        self.queue_depth = int(queue_depth)
+        self.requeued = tuple(requeued)
 
 
 # Both builders are memoized on (cfg, top_k, donate): every Engine with the
@@ -197,7 +212,8 @@ class Engine:
     def __init__(self, model=None, *, params=None, config=None,
                  num_slots=None, max_seq_len=None, prefill_buckets=None,
                  max_queue=None, top_k=None, kv_layout=None, page_size=None,
-                 num_pages=None, prefill_chunk=None, prefix_cache=None):
+                 num_pages=None, prefill_chunk=None, prefix_cache=None,
+                 tag=None):
         if model is not None:
             params = _collect_params(model)
             config = model.config
@@ -300,12 +316,36 @@ class Engine:
         self._admit_count = 0
         self._results = {}                # request_id -> GenerationResult
 
+        # self-healing state: step counter (snapshot cadence + chaos
+        # hooks), attached snapshot manager, drain/stop latch
+        self.tag = "engine" if tag is None else str(tag)
+        self._step_count = 0
+        self._stopped = False
+        self._ckpt = None
+        self._snapshot_every = 0
+        self._drained = []                # requests the last drain() handed back
+
     # -- submission ----------------------------------------------------------
+    def _check_stopped(self):
+        if self._stopped:
+            pending = [r for r in self._drained
+                       if r.state not in (FINISHED,)]
+            raise EngineStoppedError(
+                f"engine {self.tag!r} is stopped (drained"
+                f"{' after preemption' if self._ckpt is not None and self._ckpt.preempted else ''}); "
+                f"resubmit to a live replica or to an engine restored from "
+                f"its last snapshot ({len(pending)} drained requests are "
+                f"waiting to be requeued)",
+                queue_depth=len(pending),
+                requeued=[r.request_id for r in pending])
+
     def submit(self, request):
         """Queue a request (FCFS). Raises QueueFullError past max_queue,
-        ValueError for requests the pool can never hold."""
+        EngineStoppedError after drain()/preemption, ValueError for
+        requests the pool can never hold."""
         if not isinstance(request, Request):
             request = Request(request)
+        self._check_stopped()
         if request.state != QUEUED:
             # single-use: the max_new_tokens==0 fast path below must not
             # re-resolve (and re-ledger) an already-finished request
@@ -370,20 +410,57 @@ class Engine:
             raise
         return request
 
-    def cancel(self, request):
+    def requeue(self, request):
+        """Re-admit a drained/preempted request (the replay path): unlike
+        ``submit`` it bypasses the ``max_queue`` bound (the request was
+        already accepted once — dropping it now would break the zero-drop
+        drain guarantee), inserts at the request's ORIGINAL arrival
+        position (global FCFS survives a drain) and keeps its original
+        ``submit_t``/deadline. Returns True unless the request was
+        cancelled while in flight between drain and requeue.
+
+        (Not counted in the ``requeued`` ledger — that counter means
+        "in-flight requests reset to queue state by a drain", bumped
+        exactly once in ``drain()``; cross-replica re-insertion is the
+        supervisor's ``replayed``.)"""
+        self._check_stopped()
+        return self.scheduler.requeue(request)
+
+    def cancel(self, request, *, count="cancelled"):
         """Abort a queued or running request; its slot (if any) is recycled
-        at the next step boundary."""
-        if request.state == QUEUED and self.scheduler.cancel(request):
-            self._resolve(request, CANCELLED, count="cancelled")
+        at the next step boundary. Race-safe against a concurrent drain: a
+        request cancelled while it sits BETWEEN drain() and a requeue (in
+        neither the wait queue nor a slot) resolves as cancelled here, and
+        ``Scheduler.requeue``/``admit`` skip already-resolved requests.
+
+        ``count=None`` skips the ledger bump — for internal hygiene
+        cancels (a supervisor pruning a stale snapshot's duplicates) that
+        are not user cancellations and must not skew the SLO counters."""
+        if request.state == QUEUED:
+            in_queue = self.scheduler.cancel(request)
+            if in_queue or request in self._drained:
+                self._resolve(request, CANCELLED, count=count)
         elif request.state == RUNNING:
-            self._free_slot(request.slot)
-            self._resolve(request, CANCELLED, count="cancelled")
+            b = request.slot
+            if b is not None and 0 <= b < self.num_slots \
+                    and self._slots[b] is request:
+                self._free_slot(b)
+                self._resolve(request, CANCELLED, count=count)
+            # else: a RUNNING handle this engine does not host (e.g. a
+            # stale snapshot copy whose live twin moved to another
+            # replica) — freeing request.slot here would evict whatever
+            # unrelated request occupies that slot. Not ours: no-op.
 
     # -- one engine iteration ------------------------------------------------
     def step(self):
         """One scheduling boundary + one decode iteration: evict expired,
         admit (prefill) into free slots, decode one token for every active
         slot. Returns True while any work remains."""
+        if self._stopped:
+            return False
+        # chaos hook: simulated ABRUPT engine death (no flush) — recovery
+        # must come from the last periodic snapshot or request replay
+        _fi.maybe_kill_serving(self.tag, self._step_count)
         now = time.perf_counter()
 
         # 1) evict running requests whose deadline passed
@@ -420,6 +497,12 @@ class Engine:
                 self._iterate_paged()
         elif active.any():
             self._iterate_pooled(active)
+
+        self._step_count += 1
+        if self._ckpt is not None and self._snapshot_every > 0 \
+                and self._step_count % self._snapshot_every == 0 \
+                and any(r is not None for r in self._slots):
+            self.save_snapshot()
 
         return self.scheduler.qsize() > 0 or \
             any(r is not None for r in self._slots)
@@ -570,10 +653,15 @@ class Engine:
             self._chunk_off[b] = off + v
 
     def _emit_token(self, req, b, tok, first):
+        # a requeued/replayed request keeps its original first_token_t (the
+        # user already saw a token) — only a genuinely-first emission may
+        # contribute a TTFT sample, or every recovery round trip would
+        # duplicate its entry in the histogram
+        fresh_first = req.first_token_t is None
         req._emit(tok)
         metrics.bump("tokens_out")
         self._tok[b] = tok
-        if first:
+        if first and fresh_first:
             metrics.observe_ttft(req.first_token_t - req.submit_t)
         if req.stop_token_ids and tok in req.stop_token_ids:
             self._free_slot(b)
@@ -673,9 +761,11 @@ class Engine:
 
         req.state = RUNNING
         req.slot = b
+        fresh_first = req.first_token_t is None  # replays don't re-observe
         req._emit(tok)
         metrics.bump("tokens_out")
-        metrics.observe_ttft(req.first_token_t - req.submit_t)
+        if fresh_first:
+            metrics.observe_ttft(req.first_token_t - req.submit_t)
         if req.stop_token_ids and tok in req.stop_token_ids:
             self._resolve(req, STOP)
             return
@@ -723,9 +813,235 @@ class Engine:
             req._finish(reason)
         req.slot = None
         self._results[req.request_id] = req.result()
-        metrics.bump(count)
+        if count is not None:
+            metrics.bump(count)
         if reason in (STOP, LENGTH):
             metrics.bump(f"finished_{reason}")
+
+    # -- self-healing: snapshot / restore / drain ----------------------------
+    def attach_checkpoint(self, mgr, every=None):
+        """Attach a hardened ``CheckpointManager`` as this engine's
+        snapshot sink: every ``every`` step boundaries (default
+        ``FLAGS_serving_snapshot_every``; 0 disables the cadence) the full
+        engine state is saved through the CRC/rename-aside/retry path, and
+        ``run()`` installs the manager's SIGTERM hook in ``defer`` mode —
+        on preemption the loop finishes the in-flight fused step, flushes
+        a consistent snapshot at the boundary, requeues in-flight requests
+        and unwinds with ``Preempted``. Returns self."""
+        self._ckpt = mgr
+        if every is None:
+            every = get_flags().get("FLAGS_serving_snapshot_every", 32) or 0
+        self._snapshot_every = max(0, int(every))
+        # keep snapshot step ids MONOTONIC per manager: a fresh engine
+        # reattached to a directory with history (e.g. a supervisor respawn
+        # after a drain) must not write snapshots that sort BELOW the stale
+        # ones — _prune would delete the new snapshot immediately and
+        # restore(None) would keep resurrecting pre-restart state. (A
+        # subsequent load_state_dict overwrites _step_count with the
+        # restored snapshot's own step, which is >= every step it leaves
+        # on disk.)
+        latest = mgr.latest_step()
+        if latest is not None:
+            self._step_count = max(self._step_count, int(latest))
+        return self
+
+    def save_snapshot(self, blocking=None):
+        """Checkpoint the full engine state at the current step count
+        through the attached manager (satellite of the PR 4 hardened path:
+        per-array CRC manifest, rename-aside publish, OSError retry /
+        quarantine). Returns the snapshot's step id."""
+        if self._ckpt is None:
+            raise RuntimeError(
+                "no CheckpointManager attached; call attach_checkpoint()")
+        self._ckpt.save(self._step_count, self.state_dict(),
+                        blocking=blocking)
+        metrics.bump("snapshots")
+        return self._step_count
+
+    def _snapshot_meta(self):
+        meta = {"kv_layout": self.kv_layout, "num_slots": self.num_slots,
+                "max_seq_len": self.max_seq_len, "top_k": self.top_k,
+                "cfg": _cfg_key(self.config)}
+        if self.kv_layout == "paged":
+            meta.update(page_size=self.page_size,
+                        prefill_chunk=self.prefill_chunk,
+                        num_pages=self.pool.num_pages)
+        else:
+            meta["buckets"] = tuple(self.scheduler.buckets)
+        return meta
+
+    @staticmethod
+    def _result_state(res):
+        return {"request_id": int(res.request_id),
+                "prompt": np.asarray(res.prompt).copy(),
+                "tokens": list(res.tokens),
+                "finish_reason": res.finish_reason,
+                "ttft": res.ttft, "latency": res.latency,
+                # exceptions may not pickle; the repr is enough postmortem
+                "callback_error": (None if res.callback_error is None
+                                   else repr(res.callback_error))}
+
+    def state_dict(self):
+        """Snapshot the FULL engine as host numpy / plain python: device
+        KV (both layouts — for paged including the slot->page table,
+        refcounted allocator and prefix-cache entries via
+        ``PagedKVPool.state_dict``), the host slot table (last token,
+        write position, per-slot threefry streams, sampling params, chunk
+        progress, admission sequence), every in-flight and queued request
+        (``Request.to_state``; ``on_token`` callbacks are not captured),
+        unpopped results, and the serving metrics ledger. Safe for
+        ``CheckpointManager``/``framework.io`` round trips; pair with
+        ``load_state_dict`` for bitwise mid-decode resume."""
+        state = {
+            "meta": self._snapshot_meta(),
+            "kc": np.asarray(jax.device_get(self._kc)),
+            "vc": np.asarray(jax.device_get(self._vc)),
+            "pos": self._pos.copy(), "tok": self._tok.copy(),
+            "keys": self._keys.copy(), "temp": self._temp.copy(),
+            "top_p": self._top_p.copy(),
+            "do_sample": self._do_sample.copy(),
+            "chunk_off": self._chunk_off.copy(),
+            "admit_seq": self._admit_seq.copy(),
+            "admit_count": int(self._admit_count),
+            "step_count": int(self._step_count),
+            "slots": [None if r is None else r.to_state()
+                      for r in self._slots],
+            "queue": self.scheduler.queue_state(),
+            "results": [self._result_state(r)
+                        for r in self._results.values()],
+            "metrics": metrics.export_state(),
+            # both clocks: perf_counter anchors the request timestamps
+            # (same-boot restores compare directly), wall time measures
+            # the outage when the perf origin changed (other host/boot)
+            "snapshot_t": time.perf_counter(),
+            "snapshot_wall": time.time(),
+        }
+        if self.kv_layout == "paged":
+            state["pool"] = self.pool.state_dict()
+        return state
+
+    def load_state_dict(self, state, restore_metrics=False):
+        """Restore a ``state_dict()`` snapshot into this (compatibly
+        configured) engine and resume exactly: mid-decode slots continue
+        token-for-token bitwise identically to an uninterrupted run,
+        greedy and sampled, on both layouts. No retracing happens — the
+        executable builders are memoized per config, so a restored engine
+        over warm shapes re-dispatches the already-compiled fused step
+        (trace counters do not move; gated in tests).
+
+        ``restore_metrics=True`` additionally replaces the process-global
+        serving ledger with the snapshot's (for a cold cross-process
+        restart); leave it False when other engines share the process.
+
+        Timestamps: ``submit_t``/deadlines are ``perf_counter`` values
+        whose origin is per-boot-arbitrary, so they are re-anchored onto
+        the local clock using the snapshot's WALL-clock companion: the
+        outage is measured as wall time elapsed since the save (NTP-level
+        accuracy is plenty for second-scale deadlines), and every request
+        timestamp shifts so the snapshot instant maps to ``now - outage``.
+        Deadlines therefore keep ticking through the outage on any host;
+        a same-process restore shifts by ~0."""
+        meta = state["meta"]
+        mine = self._snapshot_meta()
+        if meta != mine:
+            raise ValueError(
+                f"engine snapshot meta {meta} does not match this engine "
+                f"{mine}; build the restoring Engine with the same config")
+        compute = self._kc.dtype
+        self._kc = jnp.asarray(np.asarray(state["kc"]), compute)
+        self._vc = jnp.asarray(np.asarray(state["vc"]), compute)
+        self._pos = np.asarray(state["pos"], np.int32).copy()
+        self._tok = np.asarray(state["tok"], np.int32).copy()
+        self._keys = np.asarray(state["keys"], np.uint32).copy()
+        self._temp = np.asarray(state["temp"], np.float32).copy()
+        self._top_p = np.asarray(state["top_p"], np.float32).copy()
+        self._do_sample = np.asarray(state["do_sample"], bool).copy()
+        self._chunk_off = np.asarray(state["chunk_off"], np.int32).copy()
+        self._admit_seq = np.asarray(state["admit_seq"], np.int64).copy()
+        self._admit_count = int(state["admit_count"])
+        self._step_count = int(state["step_count"])
+        if self.kv_layout == "paged":
+            self.pool.load_state_dict(state["pool"])
+        self._slots = [None if s is None else Request.from_state(s)
+                       for s in state["slots"]]
+        queue = [Request.from_state(s) for s in state["queue"]]
+        self.scheduler.restore_queue(queue)
+        outage = max(0.0, time.time() - float(state["snapshot_wall"]))
+        shift = (time.perf_counter() - outage) - float(state["snapshot_t"])
+        live = [r for r in self._slots if r is not None] + queue
+        for r in live:
+            for attr in ("submit_t", "first_token_t", "finish_t"):
+                v = getattr(r, attr)
+                if v is not None:
+                    setattr(r, attr, v + shift)
+        self._results = {
+            d["request_id"]: GenerationResult(
+                request_id=d["request_id"], prompt=d["prompt"],
+                tokens=list(d["tokens"]), finish_reason=d["finish_reason"],
+                ttft=d["ttft"], latency=d["latency"],
+                callback_error=d["callback_error"])
+            for d in state["results"]}
+        if restore_metrics:
+            metrics.import_state(state["metrics"])
+        metrics.bump("snapshot_restores")
+        self._stopped = False
+        self._drained = []
+        return self
+
+    def drain(self):
+        """Stop the engine and hand back every incomplete request, oldest
+        arrival first: running slots are freed (pages released, prefix
+        pages published) and their requests reset for requeue — original
+        ``submit_t``/deadline kept, progress cleared so a replay re-emits
+        the same tokens deterministically — and the wait queue is emptied
+        untouched. The engine is left STOPPED: ``submit()`` raises
+        ``EngineStoppedError`` (carrying these requests as the requeue
+        hint) and ``step()`` returns False. Completed results remain
+        available via ``pop_results()``."""
+        drained = []
+        for b, req in enumerate(self._slots):
+            if req is None:
+                continue
+            self._free_slot(b)
+            req._requeue()
+            metrics.bump("requeued")
+            drained.append(req)
+        drained.extend(self.scheduler.drain_queue())
+        drained.sort(key=lambda r: (
+            r.submit_t if r.submit_t is not None else float("inf"),
+            r.request_id))
+        self._stopped = True
+        self._drained = drained
+        return list(drained)
+
+    def preempt_drain(self):
+        """Graceful preemption at a step boundary (the serving mirror of
+        ``CheckpointManager``'s ``defer=True`` flush; ``run()`` calls this
+        between fused steps once the SIGTERM handler marks the manager
+        preempted, so the snapshot is never torn mid-dispatch). Order
+        matters: snapshot FIRST with slots intact — a cold restart resumes
+        every mid-decode request bitwise — THEN requeue in-flight requests
+        (the replay hint for a router when the snapshot is stale or
+        unreachable), then unwind with ``Preempted``."""
+        metrics.bump("preempt_drains")
+        step = self._step_count
+        state = self.state_dict()
+        self.drain()
+        if self._ckpt is not None:
+            self._ckpt.flush_preempted(state, step=step)  # raises Preempted
+        from ..incubate.checkpoint import Preempted
+        raise Preempted("engine preempted; in-flight requests requeued")
+
+    def live_requests(self):
+        """Every incomplete request this engine owns: running slots (slot
+        order) then the wait queue (FCFS)."""
+        out = [r for r in self._slots if r is not None]
+        out.extend(r for r in self.scheduler._q if r.state != FINISHED)
+        return out
+
+    @property
+    def stopped(self):
+        return self._stopped
 
     # -- draining ------------------------------------------------------------
     def pop_results(self):
@@ -739,12 +1055,41 @@ class Engine:
     def run(self, requests=None):
         """Submit ``requests`` (optional) and step until queue and slots are
         empty. Returns {request_id: GenerationResult} for everything that
-        resolved during this call (including earlier submissions)."""
+        resolved during this call (including earlier submissions).
+
+        With a checkpoint manager attached, the manager's SIGTERM hook is
+        installed in ``defer`` mode for the duration of the loop: a
+        preemption notice only marks the manager, the loop finishes the
+        current fused step, then ``preempt_drain()`` flushes a consistent
+        boundary snapshot, requeues in-flight requests and unwinds with
+        ``Preempted`` (BaseException — a preempted server must exit, not
+        retry)."""
         if requests is not None:
             for r in requests:
                 self.submit(r)
-        while self.step():
-            pass
+        installed = False
+        if self._ckpt is not None and \
+                threading.current_thread() is threading.main_thread():
+            try:  # signals are main-thread-only; elsewhere rely on cadence
+                self._ckpt.install_preemption_hook(None, defer=True)
+                installed = True
+            except ValueError:
+                pass
+        try:
+            while True:
+                if self._ckpt is not None and self._ckpt.preempted:
+                    self.preempt_drain()         # raises Preempted
+                if not self.step():
+                    break
+            if self._ckpt is not None and self._ckpt.preempted:
+                # the notice landed DURING the final step: still flush and
+                # unwind with Preempted — returning normally would let the
+                # caller submit more work and the next hook install re-arm
+                # (erase) the pending preemption
+                self.preempt_drain()
+        finally:
+            if installed:
+                self._ckpt.remove_preemption_hook()
         return self.pop_results()
 
     def generate(self, prompts, **kw):

@@ -41,6 +41,12 @@ def _zero():
         # n_chunks*chunk - prefilled_tokens (paged; < chunk per request)
         "prefill_padded_tokens": 0, "prefill_padded_reqs": 0,
         "prefill_padded_max": 0,
+        # self-healing: engine snapshots + drain/replay recovery ledger.
+        # "dropped" must stay 0 through any preemption/kill/rolling-restart
+        # story — every in-flight request either completes or is replayed.
+        "snapshots": 0, "snapshot_restores": 0, "preempt_drains": 0,
+        "requeued": 0, "replayed": 0, "respawns": 0,
+        "stale_failovers": 0, "rolling_restarts": 0, "dropped": 0,
         # tokens / time
         "tokens_out": 0,
         "decode_time_s": 0.0, "prefill_time_s": 0.0,
@@ -142,6 +148,30 @@ def reset_serving_counters():
         _tok_lat.clear()
 
 
+def export_state():
+    """Serializable snapshot of the raw ledger (counters + latency ring
+    buffers) for ``Engine.state_dict()`` — a restored engine can carry its
+    SLO history across a restart instead of reporting from zero."""
+    with _lock:
+        return {"counters": dict(_C), "ttft": list(_ttft),
+                "token_latency": list(_tok_lat)}
+
+
+def import_state(state):
+    """Replace the ledger with an ``export_state()`` snapshot. Unknown
+    keys from older snapshots are dropped; keys added since are zeroed."""
+    global _C
+    with _lock:
+        _C = _zero()
+        for k, v in state.get("counters", {}).items():
+            if k in _C:
+                _C[k] = v
+        _ttft.clear()
+        _ttft.extend(state.get("ttft", ()))
+        _tok_lat.clear()
+        _tok_lat.extend(state.get("token_latency", ()))
+
+
 def serving_summary():
     """One-line human-readable serving report."""
     c = serving_counters()
@@ -160,6 +190,17 @@ def serving_summary():
     if c["prefill_padded_reqs"]:
         waste = (f"  prefill-waste: {c['prefill_waste_mean']:.1f} "
                  f"avg/{c['prefill_padded_max']} max pad tok")
+    heal = ""
+    if any(c[k] for k in ("snapshots", "snapshot_restores", "preempt_drains",
+                          "requeued", "replayed", "respawns",
+                          "stale_failovers", "rolling_restarts", "dropped")):
+        heal = (f"  self-heal: {c['snapshots']} snap / "
+                f"{c['snapshot_restores']} restore  "
+                f"drains: {c['preempt_drains']}  "
+                f"requeued/replayed: {c['requeued']}/{c['replayed']}  "
+                f"respawns: {c['respawns']} "
+                f"({c['stale_failovers']} stale-hb)  "
+                f"dropped: {c['dropped']}")
     return (f"requests: {c['submitted']} submitted / {c['completed']} done "
             f"({c['expired']} expired, {c['rejected']} rejected)  "
             f"tokens: {c['tokens_out']}  tokens/s: {c['tokens_per_s']:.1f}  "
@@ -167,4 +208,4 @@ def serving_summary():
             f"queue: {c['queue_depth_mean']:.1f} avg/{c['queue_depth_max']} max  "
             f"executables: {c['prefill_traces']} prefill + "
             f"{c['decode_traces']} decode + {c['paged_traces']} paged"
-            f"{paged}{waste}")
+            f"{paged}{waste}{heal}")

@@ -231,6 +231,49 @@ class PagedKVPool:
     def cache_entries(self):
         return len(self._cache)
 
+    # -- snapshot ------------------------------------------------------------
+    def _meta(self):
+        return {"page_size": self.page_size,
+                "num_pages": self.num_pages,
+                "num_slots": self.num_slots,
+                "slot_pages": self.slot_pages,
+                "prefix_cache": self.prefix_cache_enabled}
+
+    def state_dict(self):
+        """Serializable snapshot of the WHOLE allocator: slot->page table,
+        refcounts, free list, CoW spares, prefix-cache entries (in LRU
+        order) and the leak-audit counters. Paired with the engine's device
+        KV arrays this reconstructs the paged pool exactly."""
+        return {
+            "meta": self._meta(),
+            "table": self.table.copy(),
+            "ref": self.ref.copy(),
+            "free": list(self._free),
+            "spare": list(self._spare),
+            "cache": [(k, v) for k, v in self._cache.items()],
+            "allocated": int(self.allocated),
+            "freed": int(self.freed),
+        }
+
+    def load_state_dict(self, state):
+        """Restore a ``state_dict()`` snapshot. The pool geometry must
+        match — a snapshot indexes PHYSICAL pages, so restoring into a
+        differently-sized pool would alias them."""
+        meta = state["meta"]
+        mine = self._meta()
+        if meta != mine:
+            raise ValueError(
+                f"paged-pool snapshot geometry {meta} does not match this "
+                f"pool {mine}")
+        self.table = np.asarray(state["table"], np.int32).copy()
+        self.ref = np.asarray(state["ref"], np.int64).copy()
+        self._free = [int(p) for p in state["free"]]
+        self._spare = [None if s is None else int(s) for s in state["spare"]]
+        self._cache = OrderedDict(
+            (tuple(k), v) for k, v in state["cache"])
+        self.allocated = int(state["allocated"])
+        self.freed = int(state["freed"])
+
     # -- audit ---------------------------------------------------------------
     def balance(self):
         """Allocator conservation snapshot for the leak gate: free + in-use

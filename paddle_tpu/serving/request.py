@@ -29,6 +29,7 @@ STOP = "stop"          # produced a stop token
 LENGTH = "length"      # hit max_new_tokens
 EXPIRED = "expired"    # deadline passed before/while running
 CANCELLED = "cancelled"
+DROPPED = "dropped"    # supervisor had no live replica left to replay on
 
 
 @dataclass(eq=False)  # identity equality: deque.remove/cancel compare BY
@@ -61,6 +62,7 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
     finish_t: float | None = field(default=None)
     finish_reason: str | None = field(default=None)
     callback_error: object = field(default=None)  # first on_token exception
+    requeue_count: int = field(default=0)         # drain/replay round trips
 
     def __post_init__(self):
         self.prompt = np.asarray(
@@ -123,6 +125,93 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
         self.state = FINISHED
         self.finish_reason = reason
         self.finish_t = time.perf_counter()
+
+    # -- drain / replay ------------------------------------------------------
+    def _requeue(self):
+        """Reset generation progress for a drain/preemption requeue. The
+        ORIGINAL ``submit_t`` (arrival) is kept, so the deadline keeps
+        ticking from first submission and TTFT never restarts; emitted
+        tokens are cleared — a replay recomputes them deterministically
+        (same seed / per-slot stream), so streaming is at-least-once but the
+        final token list is bitwise what an uninterrupted run produces.
+        ``first_token_t`` survives when a token was already streamed (the
+        user saw it); otherwise TTFT spans the recovery gap too."""
+        self.state = QUEUED
+        self.slot = None
+        self.tokens = []
+        self.finish_t = None
+        self.finish_reason = None
+        self.requeue_count += 1
+
+    def replay_copy(self):
+        """Fresh QUEUED copy for replaying on ANOTHER engine after its
+        owner died: same ``request_id``, prompt, sampling params, seed,
+        ``on_token`` callback and — critically — the ORIGINAL ``submit_t``
+        and relative deadline (a replayed request must not be granted a
+        fresh deadline, and its TTFT counts from first submission)."""
+        r = Request(self.prompt.copy(), max_new_tokens=self.max_new_tokens,
+                    do_sample=self.do_sample, temperature=self.temperature,
+                    top_p=self.top_p, top_k=self.top_k,
+                    stop_token_ids=self.stop_token_ids, seed=self.seed,
+                    deadline_s=self.deadline_s, on_token=self.on_token)
+        r.request_id = self.request_id
+        r.submit_t = self.submit_t
+        r.first_token_t = self.first_token_t
+        r.requeue_count = self.requeue_count + 1
+        return r
+
+    # -- snapshot ------------------------------------------------------------
+    def to_state(self):
+        """Serializable snapshot of the request (engine state_dict leaf).
+        ``on_token`` callbacks are NOT serialized (arbitrary closures don't
+        survive a process boundary); a restored request finishes without
+        streaming — its result still carries every token."""
+        return {
+            "prompt": self.prompt.copy(),
+            "max_new_tokens": int(self.max_new_tokens),
+            "do_sample": bool(self.do_sample),
+            "temperature": float(self.temperature),
+            "top_p": None if self.top_p is None else float(self.top_p),
+            "top_k": None if self.top_k is None else int(self.top_k),
+            "stop_token_ids": tuple(self.stop_token_ids or ()),
+            "seed": int(self.seed),
+            "deadline_s": (None if self.deadline_s is None
+                           else float(self.deadline_s)),
+            "request_id": int(self.request_id),
+            "state": self.state,
+            "tokens": list(self.tokens),
+            "slot": None if self.slot is None else int(self.slot),
+            "submit_t": self.submit_t,
+            "first_token_t": self.first_token_t,
+            "finish_t": self.finish_t,
+            "finish_reason": self.finish_reason,
+            "requeue_count": int(self.requeue_count),
+        }
+
+    @classmethod
+    def from_state(cls, state):
+        """Rebuild a request from ``to_state()`` output. Bumps the global
+        request-id counter past the restored id so requests created AFTER a
+        cross-process restore can never collide with restored ones."""
+        r = cls(state["prompt"], max_new_tokens=state["max_new_tokens"],
+                do_sample=state["do_sample"], temperature=state["temperature"],
+                top_p=state["top_p"], top_k=state["top_k"],
+                stop_token_ids=state["stop_token_ids"], seed=state["seed"],
+                deadline_s=state["deadline_s"])
+        r.request_id = int(state["request_id"])
+        global _req_ids
+        floor = next(_req_ids)
+        if floor <= r.request_id:
+            _req_ids = itertools.count(r.request_id + 1)
+        r.state = state["state"]
+        r.tokens = list(state["tokens"])
+        r.slot = state["slot"]
+        r.submit_t = state["submit_t"]
+        r.first_token_t = state["first_token_t"]
+        r.finish_t = state["finish_t"]
+        r.finish_reason = state["finish_reason"]
+        r.requeue_count = int(state.get("requeue_count", 0))
+        return r
 
     def result(self):
         if self.state != FINISHED:

@@ -14,11 +14,19 @@ from __future__ import annotations
 import time
 from collections import deque
 
-from .request import EXPIRED, QUEUED
+from .request import EXPIRED, FINISHED, QUEUED
 
 
 class QueueFullError(RuntimeError):
-    """Raised by submit() when the wait queue is at max_queue."""
+    """Raised by submit() when the wait queue is at max_queue. Carries
+    ``qsize`` (waiting requests at rejection time) and ``max_queue`` so a
+    router can back off proportionally (retry-after ~ qsize/max_queue)
+    instead of blind-retrying."""
+
+    def __init__(self, message, qsize=None, max_queue=None):
+        super().__init__(message)
+        self.qsize = qsize
+        self.max_queue = max_queue
 
 
 class Scheduler:
@@ -34,12 +42,40 @@ class Scheduler:
     def submit(self, req):
         if len(self._q) >= self.max_queue:
             raise QueueFullError(
-                f"serving queue full ({self.max_queue} waiting); retry later")
+                f"serving queue full ({self.max_queue} waiting); retry later",
+                qsize=len(self._q), max_queue=self.max_queue)
         if req.state != QUEUED:
             raise ValueError(f"request {req.request_id} already "
                              f"{req.state}; requests are single-use")
-        req.submit_t = time.perf_counter()
+        if req.submit_t is None:
+            # first submission stamps the arrival clock; a drained/replayed
+            # request keeps its ORIGINAL submit_t (and therefore deadline —
+            # preemption must not grant a fresh one, and TTFT counts from
+            # first submission)
+            req.submit_t = time.perf_counter()
         self._q.append(req)
+
+    def requeue(self, req):
+        """Return a previously-admitted (drained/preempted) request to the
+        wait queue at its ARRIVAL position: inserted before any request
+        that was submitted later, so global FCFS order is preserved across
+        a drain. ``max_queue`` is intentionally bypassed — the request was
+        already accepted once and dropping it now would break the
+        zero-requests-dropped drain guarantee. Race-safe against cancel: a
+        request resolved while it was in flight between ``drain`` and this
+        call is skipped (returns False)."""
+        if req.state == FINISHED:
+            return False              # cancelled mid-requeue: nothing to do
+        req.state = QUEUED
+        req.slot = None
+        t = req.submit_t if req.submit_t is not None else float("-inf")
+        idx = len(self._q)
+        for i, other in enumerate(self._q):
+            if other.submit_t is not None and other.submit_t > t:
+                idx = i
+                break
+        self._q.insert(idx, req)
+        return True
 
     def cancel(self, req):
         """Remove a still-queued request; returns True if it was waiting."""
@@ -69,8 +105,8 @@ class Scheduler:
         entries never inflate qsize()/backpressure while all slots are busy.
         Returned requests are already marked EXPIRED."""
         now = time.perf_counter() if now is None else now
-        expired = [r for r in self._q
-                   if r.deadline is not None and now > r.deadline]
+        expired = [r for r in self._q if r.state != FINISHED
+                   and r.deadline is not None and now > r.deadline]
         for req in expired:
             self._q.remove(req)
             req._finish(EXPIRED)
@@ -92,6 +128,11 @@ class Scheduler:
         admitted, expired = [], []
         while self._q and len(admitted) < free_slots:
             req = self._q[0]
+            if req.state == FINISHED:
+                # cancelled while queued (e.g. mid-requeue race where the
+                # cancel lost the deque.remove): already resolved, skip
+                self._q.popleft()
+                continue
             dl = req.deadline
             if dl is not None and now > dl:
                 self._q.popleft()
@@ -103,3 +144,20 @@ class Scheduler:
             self._q.popleft()
             admitted.append(req)
         return admitted, expired
+
+    # -- snapshot ------------------------------------------------------------
+    def drain_queue(self):
+        """Pop and return every waiting request (engine drain/shutdown
+        path); their ``submit_t`` is untouched so a resubmission elsewhere
+        keeps the original arrival clock."""
+        out = [r for r in self._q if r.state != FINISHED]
+        self._q.clear()
+        return out
+
+    def queue_state(self):
+        """Serializable snapshot of the wait queue (FCFS order)."""
+        return [r.to_state() for r in self._q if r.state != FINISHED]
+
+    def restore_queue(self, reqs):
+        """Replace the wait queue with ``reqs`` (engine restore path)."""
+        self._q = deque(reqs)

@@ -1,0 +1,411 @@
+"""Elastic serving supervisor: N engine replicas behind a least-loaded
+router, with heartbeat failure detection, snapshot respawn and request
+replay (the serving mirror of ``distributed.elastic.ElasticAgent``).
+
+The supervisor owns the self-healing contract the engine alone cannot
+provide: ZERO requests dropped across replica death. Every submitted
+request is tracked until its result is delivered; when a replica dies —
+engine exception, simulated kill (``FaultPlan.kill_at_decode_step``), or a
+stale heartbeat (frozen process) — the supervisor first tries to respawn
+the replica from its last engine snapshot (``Engine.load_state_dict``;
+mid-decode requests resume bitwise), cancels whatever the restored engine
+would recompute that was already delivered, and REPLAYS on a surviving
+replica anything the snapshot predates or — when the snapshot is stale,
+corrupt or missing — everything the dead replica still owed. Replays are
+*exactly* equivalent: the engine's bitwise-parity guarantee (any admission
+order, greedy and sampled, both KV layouts) means a replayed request's
+token stream is identical to the one the dead replica would have produced.
+
+Replicas here are in-process ``Engine`` objects driven round-robin — the
+deterministic CPU harness the chaos ladder needs. A multi-host deployment
+runs one engine per TPU VM with the same CheckpointManager/Heartbeat
+wiring (``Engine.run()`` installs the SIGTERM drain per process); the
+supervisor logic is identical because every primitive it consumes
+(snapshot dirs, heartbeat files) already lives on shared storage.
+
+Rolling restart (``rolling_restart()``) drains one replica at a time —
+in-flight requests requeued with their ORIGINAL arrival time and deadline
+onto the surviving replicas — so the fleet upgrades with zero drops and
+bounded queue-depth spill.
+"""
+from __future__ import annotations
+
+import os
+
+from ..flags import get_flags
+from ..incubate.checkpoint import CheckpointManager, Preempted
+from ..distributed.elastic import Heartbeat, HeartbeatMonitor
+from ..utils.fault_injection import Preemption
+from . import metrics
+from .engine import EngineStoppedError
+from .request import CANCELLED, DROPPED, FINISHED, Request
+from .scheduler import QueueFullError
+
+
+class _Replica:
+    """One supervised engine slot: the engine itself is replaceable (it
+    dies and respawns), the snapshot manager and heartbeat are not."""
+
+    def __init__(self, idx, mgr, hb):
+        self.idx = idx
+        self.mgr = mgr              # persistent CheckpointManager or None
+        self.hb = hb                # persistent Heartbeat or None
+        self.engine = None
+        self.state = "down"         # "up" | "down"
+        self.restarts = 0
+        self.last_error = None
+
+    @property
+    def load(self):
+        return self.engine.queue_depth + self.engine.active_slots
+
+
+class ServingSupervisor:
+    """Run ``num_replicas`` engines from ``engine_factory`` (a zero-arg
+    callable returning a fresh, identically-configured ``Engine``) behind
+    a least-queue-depth router::
+
+        sup = ServingSupervisor(lambda: Engine(params=p, config=cfg),
+                                num_replicas=2, snapshot_dir=tmp)
+        for r in requests:
+            sup.submit(r)
+        results = sup.run()        # {request_id: GenerationResult}
+
+    ``snapshot_dir`` enables per-replica engine snapshots through the
+    hardened checkpoint path (cadence ``snapshot_every`` /
+    ``FLAGS_serving_snapshot_every``); ``heartbeat_dir`` enables
+    liveness monitoring (a replica whose file goes stale past
+    ``heartbeat_timeout`` is failed over even though its process never
+    raised). ``max_restarts`` bounds respawns per replica; past it the
+    replica stays down and its work is replayed on the survivors.
+    """
+
+    def __init__(self, engine_factory, num_replicas=2, *, snapshot_dir=None,
+                 snapshot_every=None, max_restarts=None, heartbeat_dir=None,
+                 heartbeat_timeout=None):
+        flags = get_flags()
+        self.engine_factory = engine_factory
+        self.snapshot_every = snapshot_every
+        self.max_restarts = int(
+            max_restarts if max_restarts is not None
+            else flags.get("FLAGS_serving_max_restarts", 3))
+        self._requests = {}          # request_id -> latest live Request
+        self._owner = {}             # request_id -> replica idx
+        self._results = {}           # request_id -> GenerationResult (1st wins)
+        self._delivered = set()      # popped rids: dedup survives pop_results
+        self._replicas = []
+        for i in range(int(num_replicas)):
+            mgr = None
+            if snapshot_dir is not None:
+                mgr = CheckpointManager(
+                    os.path.join(os.fspath(snapshot_dir), f"replica_{i}"),
+                    async_save=False, site="serving_snapshot")
+            hb = None
+            if heartbeat_dir is not None:
+                hb = Heartbeat(heartbeat_dir, rank=i)
+            rep = _Replica(i, mgr, hb)
+            rep.engine = self._spawn_engine(rep)
+            rep.state = "up"
+            if hb is not None:
+                hb.beat()
+            self._replicas.append(rep)
+        self.monitor = None
+        if heartbeat_dir is not None:
+            timeout = (heartbeat_timeout if heartbeat_timeout is not None
+                       else flags.get("FLAGS_serving_heartbeat_timeout", 10.0))
+            self.monitor = HeartbeatMonitor(heartbeat_dir,
+                                            world_size=int(num_replicas),
+                                            timeout=float(timeout))
+
+    def _spawn_engine(self, rep):
+        eng = self.engine_factory()
+        eng.tag = f"replica{rep.idx}"
+        if rep.mgr is not None:
+            eng.attach_checkpoint(rep.mgr, every=self.snapshot_every)
+        return eng
+
+    # -- routing -------------------------------------------------------------
+    def _up(self):
+        return [r for r in self._replicas if r.state == "up"]
+
+    def _pick(self, exclude=None):
+        ups = [r for r in self._up() if r is not exclude]
+        if not ups:
+            return None
+        return min(ups, key=lambda r: (r.load, r.idx))
+
+    def submit(self, request):
+        """Route a request to the least-loaded live replica (spilling to
+        the next when its queue is full; ``QueueFullError`` — with its
+        ``qsize``/``max_queue`` back-off hints — only once EVERY replica
+        is saturated). Raises ``EngineStoppedError`` when no replica is
+        up."""
+        if not isinstance(request, Request):
+            request = Request(request)
+        ups = sorted(self._up(), key=lambda r: (r.load, r.idx))
+        if not ups:
+            raise EngineStoppedError(
+                "no live serving replica", queue_depth=0, requeued=())
+        for rep in ups:
+            # saturation probe, not a trial submit: a failed Engine.submit
+            # bumps the global submitted/rejected ledger, so spilling by
+            # try/except would count one logical request once per full
+            # replica and skew the SLO surface
+            if rep.engine.queue_depth < rep.engine.scheduler.max_queue:
+                rep.engine.submit(request)
+                break
+        else:
+            full = ups[0].engine
+            raise QueueFullError(
+                f"all {len(ups)} replica queues full "
+                f"({full.scheduler.max_queue} each); retry later",
+                qsize=full.queue_depth, max_queue=full.scheduler.max_queue)
+        self._requests[request.request_id] = request
+        self._owner[request.request_id] = rep.idx
+        return request
+
+    def _acked(self, rid):
+        return rid in self._results or rid in self._delivered
+
+    def cancel(self, request):
+        """Cancel wherever the request currently lives (race-safe against
+        drain/replay: a request caught between the two resolves as
+        cancelled here — delivering its result immediately — and is
+        skipped by any later requeue)."""
+        rid = request.request_id
+        if self._acked(rid):
+            return
+        live = self._requests.get(rid, request)
+        owner = self._owner.get(rid)
+        if owner is not None and self._replicas[owner].state == "up":
+            self._replicas[owner].engine.cancel(live)
+        elif live.state != FINISHED:
+            # owner down / mid-replay: resolve directly so pending() drains
+            live._finish(CANCELLED)
+            metrics.bump("cancelled")
+            self._results[rid] = live.result()
+
+    # -- the supervision loop ------------------------------------------------
+    def step(self):
+        """One supervision round: step every live replica one engine
+        iteration (heartbeating it), fail over replicas that died or went
+        stale, collect results. Returns True while undelivered requests
+        remain."""
+        for rep in self._replicas:
+            if rep.state != "up":
+                continue
+            try:
+                rep.engine.step()
+            except (Preemption, Preempted, Exception) as e:  # noqa: BLE001
+                # abrupt death: results resolved DURING the dying step are
+                # lost with the process (never read from a dead engine) —
+                # recovery recomputes them from snapshot/replay
+                self._on_failure(rep, e)
+            else:
+                self._collect(rep)
+                if rep.hb is not None:
+                    try:
+                        rep.hb.beat(step=rep.engine._step_count)
+                    except OSError:
+                        # transient heartbeat-file IO is NOT engine death:
+                        # the file just ages, and only the monitor's
+                        # staleness timeout may eventually fail this
+                        # replica over — don't burn its restart budget
+                        pass
+        if self.monitor is not None:
+            for rank in self.monitor.failed_ranks():
+                rep = self._replicas[rank]
+                if rep.state == "up":
+                    metrics.bump("stale_failovers")
+                    self._on_failure(rep, RuntimeError(
+                        f"stale heartbeat (replica {rank})"))
+        return self.pending() > 0
+
+    def _collect(self, rep):
+        for rid, res in rep.engine.pop_results().items():
+            # first result wins: a snapshot-respawned replica recomputes
+            # work that was already delivered — recomputation is
+            # deterministic, so dropping the duplicate loses nothing
+            if not self._acked(rid):
+                self._results[rid] = res
+
+    def _on_failure(self, rep, err):
+        """Replica death: respawn from its last snapshot when one exists
+        (mid-decode requests resume bitwise; anything newer than the
+        snapshot is replayed), otherwise replay everything it still owed
+        on the surviving replicas. Past ``max_restarts`` the replica stays
+        down permanently."""
+        rep.state = "down"
+        rep.last_error = err
+        rep.engine = None
+        unacked = [rid for rid, owner in self._owner.items()
+                   if owner == rep.idx and not self._acked(rid)]
+        snap = None
+        if rep.mgr is not None:
+            try:
+                snap = rep.mgr.restore(None)   # quarantines corrupt steps
+            except Exception:
+                snap = None
+        rep.restarts += 1
+        if rep.restarts > self.max_restarts:
+            self._replay(unacked)
+            return
+        eng = self._spawn_engine(rep)
+        restored = False
+        if snap is not None:
+            try:
+                eng.load_state_dict(snap)
+                restored = True
+            except Exception:      # incompatible/stale-format snapshot
+                restored = False
+        rep.engine = eng
+        rep.state = "up"
+        metrics.bump("respawns")
+        if rep.hb is not None:
+            rep.hb.beat(status="running")
+        if restored:
+            # the snapshot may predate request movement: anything already
+            # delivered, or since reassigned to ANOTHER replica (e.g. by a
+            # rolling-restart drain), must not be recomputed here — cancel
+            # is neighbor-stable, so the resumed slots stay bitwise intact
+            for req in list(eng.live_requests()):
+                rid = req.request_id
+                if self._acked(rid) or self._owner.get(rid) != rep.idx:
+                    # hygiene, not a user cancellation: skip the ledger
+                    eng.cancel(req, count=None)
+                else:
+                    self._requests[rid] = req   # live handle for cancel()
+            # and purge stale results for moved/delivered requests (the
+            # cancels above just minted CANCELLED results; a snapshot can
+            # also carry pre-save ones): _collect must never deliver them
+            # ahead of — or instead of — the real owner's stream
+            for rid in list(eng._results):
+                if self._acked(rid) or self._owner.get(rid) != rep.idx:
+                    del eng._results[rid]
+            recomputes = {r.request_id for r in eng.live_requests()}
+            recomputes.update(eng._results)
+            self._replay([rid for rid in unacked if rid not in recomputes],
+                         prefer=rep)
+        else:
+            self._replay(unacked)
+
+    def _replay(self, rids, prefer=None):
+        """Resubmit lost requests as fresh copies — same request_id, seed,
+        sampling params and ORIGINAL submit_t/deadline — on the preferred
+        or least-loaded live replica. Exactness rides on the engine parity
+        guarantee: the replayed stream is bitwise the one the dead replica
+        would have produced."""
+        for rid in rids:
+            src = self._requests.get(rid)
+            if src is None or self._acked(rid):
+                continue
+            if src.state == FINISHED:
+                if src.finish_reason == CANCELLED:
+                    # cancelled while in flight: its CANCELLED result may
+                    # have died with the engine before a collect — deliver
+                    # the outcome from the handle so pending() drains
+                    self._results[rid] = src.result()
+                    continue
+                # else: it FINISHED on the dying replica in the very step
+                # that crashed (result lost, never collected) — fall
+                # through and recompute an exact copy on a survivor
+            target = prefer if (prefer is not None and prefer.state == "up") \
+                else self._pick()
+            if target is None:
+                # the whole fleet is gone: resolve terminally so callers
+                # driving pending()/run() converge to a visible failure
+                # instead of spinning on an undeliverable request
+                metrics.bump("dropped")
+                src._finish(DROPPED)
+                self._results[rid] = src.result()
+                continue
+            copy = src.replay_copy()
+            target.engine.requeue(copy)
+            self._requests[rid] = copy
+            self._owner[rid] = target.idx
+            metrics.bump("replayed")
+
+    # -- lifecycle -----------------------------------------------------------
+    def rolling_restart(self, absorb_steps=2):
+        """Restart the fleet one replica at a time with zero drops: drain
+        a replica (in-flight requeued, original arrival kept), hand its
+        work to the survivors, respawn it FRESH, then run a few
+        supervision rounds so the fleet absorbs before the next drain."""
+        metrics.bump("rolling_restarts")
+        for rep in list(self._replicas):
+            if rep.state != "up":
+                continue
+            drained = rep.engine.drain()
+            self._collect(rep)
+            rep.engine = self._spawn_engine(rep)
+            rep.restarts = 0           # a planned restart is not a failure
+            metrics.bump("respawns")
+            if rep.hb is not None:
+                rep.hb.beat(status="running")
+            for req in drained:
+                if req.state == FINISHED:
+                    continue           # cancelled mid-requeue: already done
+                target = self._pick(exclude=rep) or rep
+                target.engine.requeue(req)
+                self._owner[req.request_id] = target.idx
+            for _ in range(max(0, int(absorb_steps))):
+                self.step()
+
+    def pending(self):
+        """Requests submitted but not yet delivered."""
+        return sum(1 for rid in self._requests if not self._acked(rid))
+
+    def pop_results(self):
+        """Drain resolved requests and forget their tracking state (the
+        supervisor-level mirror of ``Engine.pop_results`` — an undrained
+        long-running supervisor would retain every prompt and token list
+        forever). Delivered ids stay in a lightweight seen-set, so a
+        replica respawned from a stale snapshot can never re-deliver a
+        duplicate after the heavy state is dropped."""
+        out, self._results = self._results, {}
+        for rid in out:
+            self._delivered.add(rid)
+            self._requests.pop(rid, None)
+            self._owner.pop(rid, None)
+        return out
+
+    def run(self, requests=None, max_steps=100000):
+        """Submit ``requests`` (optional) and supervise until every tracked
+        request has a result, then drain: returns {request_id:
+        GenerationResult} for everything resolved since the last drain
+        (check ``finish_reason`` — a dead-fleet terminal failure surfaces
+        as ``DROPPED`` rather than an infinite wait)."""
+        if requests is not None:
+            for r in requests:
+                self.submit(r)
+        steps = 0
+        while self.pending():
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"supervisor did not converge in {max_steps} rounds "
+                    f"({self.pending()} requests still pending)")
+        return self.pop_results()
+
+    def shutdown(self):
+        """Drain every live replica; returns still-incomplete requests
+        (original arrival kept) for hand-off to another fleet."""
+        leftovers = []
+        for rep in self._replicas:
+            if rep.state == "up" and rep.engine is not None:
+                leftovers.extend(rep.engine.drain())
+                self._collect(rep)
+                if rep.hb is not None:
+                    rep.hb.beat(status="stopped")
+                rep.state = "down"
+        return leftovers
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def alive_replicas(self):
+        return len(self._up())
+
+    def results(self):
+        """Resolved-but-not-yet-popped results (non-draining peek)."""
+        return dict(self._results)

@@ -23,6 +23,26 @@ Faults:
                           (transient-IO / flaky-NFS simulation)
   * ``preempt_at_step`` — raise ``Preemption`` before dispatching that step
                           (SIGTERM-preemption simulation without signals)
+
+Serving chaos (the self-healing serving ladder):
+  * ``kill_at_decode_step`` — raise ``Preemption`` at the START of that
+                          serving step boundary (0-based engine step count),
+                          BEFORE any snapshot flush — an ABRUPT engine death
+                          (vs the SIGTERM drain, which flushes). Fires
+                          once; optionally only on the engine whose ``tag``
+                          matches ``kill_engine_tag`` (so a supervisor test
+                          kills exactly one of N replicas).
+  * ``io_error_on_snapshots`` — the nth ENGINE-SNAPSHOT write (1-based,
+                          counted only at the ``serving_snapshot`` site)
+                          raises OSError, independent of the global
+                          ``io_error_on_writes`` schedule.
+  * ``stale_heartbeat_ranks`` — those ranks' ``Heartbeat.beat()`` calls are
+                          silently dropped (frozen-process simulation): the
+                          process looks alive, its heartbeat file goes
+                          stale, and the monitor must report it failed.
+
+All hooks are host-side and zero-cost when no plan is active (one
+attribute check), and never touch a compiled executable.
 """
 from __future__ import annotations
 
@@ -40,19 +60,38 @@ class FaultPlan:
     """Deterministic schedule of injected faults."""
 
     def __init__(self, nan_at_steps=(), io_error_on_writes=(),
-                 preempt_at_step=None):
+                 preempt_at_step=None, kill_at_decode_step=None,
+                 kill_engine_tag=None, io_error_on_snapshots=(),
+                 stale_heartbeat_ranks=()):
         self.nan_at_steps = frozenset(int(s) for s in nan_at_steps)
         self.io_error_on_writes = frozenset(int(n) for n in io_error_on_writes)
         self.preempt_at_step = (None if preempt_at_step is None
                                 else int(preempt_at_step))
+        # serving chaos
+        self.kill_at_decode_step = (None if kill_at_decode_step is None
+                                    else int(kill_at_decode_step))
+        self.kill_engine_tag = kill_engine_tag
+        self.io_error_on_snapshots = frozenset(
+            int(n) for n in io_error_on_snapshots)
+        self.stale_heartbeat_ranks = frozenset(
+            int(r) for r in stale_heartbeat_ranks)
+        # one-shot: a respawned/replayed engine re-walks the same step
+        # indices — re-firing the kill would loop the recovery forever
+        self._kill_fired = False
         # observability: what actually fired
         self.stats = {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
-                      "writes_seen": 0}
+                      "writes_seen": 0, "serving_kills": 0,
+                      "snapshot_writes_seen": 0, "snapshot_io_errors": 0,
+                      "heartbeats_dropped": 0}
 
     def __repr__(self):
         return (f"FaultPlan(nan_at_steps={sorted(self.nan_at_steps)}, "
                 f"io_error_on_writes={sorted(self.io_error_on_writes)}, "
-                f"preempt_at_step={self.preempt_at_step})")
+                f"preempt_at_step={self.preempt_at_step}, "
+                f"kill_at_decode_step={self.kill_at_decode_step}, "
+                f"kill_engine_tag={self.kill_engine_tag!r}, "
+                f"io_error_on_snapshots={sorted(self.io_error_on_snapshots)}, "
+                f"stale_heartbeat_ranks={sorted(self.stale_heartbeat_ranks)})")
 
 
 _plan: FaultPlan | None = None
@@ -121,7 +160,10 @@ def maybe_preempt(step):
 def maybe_fail_write(site="ckpt_write"):
     """Called by CheckpointManager before each on-disk write attempt; the
     nth call (1-based, counted across all managers) raises OSError when the
-    plan schedules it."""
+    plan schedules it. Serving-snapshot managers call with
+    ``site="serving_snapshot"``, which additionally walks the separate
+    ``io_error_on_snapshots`` schedule (so snapshot chaos composes with —
+    and is countable independently of — training checkpoint chaos)."""
     if _plan is None:
         return
     _plan.stats["writes_seen"] += 1
@@ -130,6 +172,40 @@ def maybe_fail_write(site="ckpt_write"):
         raise OSError(
             f"injected I/O error on checkpoint write "
             f"#{_plan.stats['writes_seen']} ({site})")
+    if site == "serving_snapshot":
+        _plan.stats["snapshot_writes_seen"] += 1
+        if _plan.stats["snapshot_writes_seen"] in _plan.io_error_on_snapshots:
+            _plan.stats["snapshot_io_errors"] += 1
+            raise OSError(
+                f"injected I/O error on engine snapshot write "
+                f"#{_plan.stats['snapshot_writes_seen']}")
+
+
+def maybe_kill_serving(tag, decode_step):
+    """Called by Engine.step() at every boundary: raises ``Preemption`` the
+    first time the plan's ``kill_at_decode_step`` is reached by an engine
+    whose tag matches (or by any engine when ``kill_engine_tag`` is None).
+    Abrupt by design — nothing is flushed; recovery must come from the
+    last periodic snapshot or from request replay."""
+    if _plan is None or _plan.kill_at_decode_step is None \
+            or _plan._kill_fired:
+        return
+    if _plan.kill_engine_tag is not None and tag != _plan.kill_engine_tag:
+        return
+    if int(decode_step) >= _plan.kill_at_decode_step:
+        _plan._kill_fired = True
+        _plan.stats["serving_kills"] += 1
+        raise Preemption(
+            f"simulated engine kill ({tag}) at decode step {decode_step}")
+
+
+def maybe_drop_heartbeat(rank):
+    """Called by ``Heartbeat.beat()``: True when the plan freezes this
+    rank's heartbeats (the beat is silently skipped, the file goes stale)."""
+    if _plan is None or int(rank) not in _plan.stale_heartbeat_ranks:
+        return False
+    _plan.stats["heartbeats_dropped"] += 1
+    return True
 
 
 def stats():
@@ -137,5 +213,7 @@ def stats():
     plan = _plan or _last_plan
     if plan is None:
         return {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
-                "writes_seen": 0}
+                "writes_seen": 0, "serving_kills": 0,
+                "snapshot_writes_seen": 0, "snapshot_io_errors": 0,
+                "heartbeats_dropped": 0}
     return dict(plan.stats)

@@ -21,7 +21,25 @@ Legs (each seeded, deterministic):
                        corrupt the latest checkpoint on disk; assert saves
                        retried and restore quarantined + fell back
 
+Serving chaos ladder (run_serving_ladder; the self-healing serving legs):
+
+  6. serve-kill-resume     — abrupt engine kill mid-decode (FaultPlan.
+                             kill_at_decode_step, nothing flushed); restore
+                             from the last CADENCE snapshot, finish, assert
+                             every request's tokens BITWISE equal the
+                             uninterrupted run; reports p99 recovery latency
+  7. serve-rolling-restart — ServingSupervisor drains+restarts each replica
+                             mid-traffic; zero requests dropped, bitwise
+  8. serve-snapshot-io     — OSError injected into the snapshot write
+                             (retried through the hardened path) + rot the
+                             newest snapshot on disk (quarantine + fallback
+                             to the previous good one, still bitwise)
+  9. serve-stale-heartbeat — one replica's heartbeats suppressed (frozen
+                             process); the supervisor fails it over; zero
+                             requests dropped, bitwise
+
   python tools_fault_smoke.py [--steps N] [--kill-step K] [--seed S]
+                              [--skip-serving]
 
 Prints, machine-greppable:
 
@@ -196,12 +214,265 @@ def leg_io_chaos(paddle, fi, args):
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
+# -- serving chaos ladder -----------------------------------------------------
+
+_SERVING = None
+
+
+def _serving_fixture():
+    """Tiny GPT + helpers, built once (executables are memoized per config,
+    so every leg reuses the same compiled fused step)."""
+    global _SERVING
+    if _SERVING is not None:
+        return _SERVING
+    import jax as _jax
+
+    from paddle_tpu import serving
+    from paddle_tpu.models.generation import generate_from_params
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models.gpt_hybrid import init_gpt_params
+
+    cfg = GPTConfig(vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+                    max_seq_len=128, dropout=0.0, use_flash=False,
+                    compute_dtype="float32", remat=False)
+    params = init_gpt_params(cfg, _jax.random.key(0))
+
+    def factory():
+        return serving.Engine(params=params, config=cfg, num_slots=3,
+                              max_seq_len=96, page_size=8, prefill_chunk=8,
+                              kv_layout="paged")
+
+    def ref(prompt, n, **kw):
+        out = np.asarray(generate_from_params(
+            params, np.asarray(prompt)[None], cfg, max_new_tokens=n,
+            **kw)._data)
+        return out[0, len(prompt):].tolist()
+
+    def traffic(n, seed):
+        rng = np.random.default_rng(seed)
+        reqs = []
+        for i in range(n):
+            kw = ({"do_sample": True, "temperature": 0.7 + 0.1 * i,
+                   "top_p": 0.85, "seed": 11 + i} if i % 2 else {})
+            reqs.append(serving.Request(rng.integers(0, 97, 5 + 2 * (i % 4)),
+                                        max_new_tokens=4 + (i % 3), **kw))
+        return reqs
+
+    def golden(reqs):
+        out = {}
+        for r in reqs:
+            kw = ({"do_sample": True, "temperature": r.temperature,
+                   "top_p": r.top_p, "seed": r.seed} if r.do_sample else {})
+            out[r.request_id] = ref(r.prompt, r.max_new_tokens, **kw)
+        return out
+
+    _SERVING = (serving, factory, ref, traffic, golden)
+    return _SERVING
+
+
+def _check_bitwise(results, reqs, golden):
+    missing = [r.request_id for r in reqs if r.request_id not in results]
+    wrong = [r.request_id for r in reqs if r.request_id in results
+             and results[r.request_id].tokens != golden[r.request_id]]
+    return len(missing), not (missing or wrong)
+
+
+def leg_serve_kill_resume(trials, n_reqs, seed):
+    """Abrupt kill mid-decode; recover from the last cadence snapshot."""
+    import time
+
+    from paddle_tpu.incubate.checkpoint import CheckpointManager
+    from paddle_tpu.utils import fault_injection as fi
+
+    serving, factory, _, traffic, golden = _serving_fixture()
+    dropped, bitwise, lat = 0, True, []
+    for t in range(trials):
+        reqs = traffic(n_reqs, seed + t)
+        gold = golden(reqs)
+        d = tempfile.mkdtemp(prefix="serve_chaos_")
+        try:
+            mgr = CheckpointManager(d, async_save=False,
+                                    site="serving_snapshot")
+            eng = factory().attach_checkpoint(mgr, every=2)
+            results = {}
+            with fi.inject(fi.FaultPlan(kill_at_decode_step=4 + t)):
+                for r in reqs:
+                    eng.submit(r)
+                try:
+                    while eng.step():
+                        results.update(eng.pop_results())
+                    raise AssertionError("kill did not fire")
+                except fi.Preemption:
+                    t_kill = time.perf_counter()
+                del eng                         # the process is gone
+                eng2 = factory().attach_checkpoint(mgr, every=0)
+                eng2.load_state_dict(mgr.restore())
+                eng2.step()                     # serving again
+                lat.append(time.perf_counter() - t_kill)
+                results.update(eng2.run())
+            miss, ok = _check_bitwise(results, reqs, gold)
+            dropped += miss
+            bitwise &= ok
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    p99 = float(np.percentile(lat, 99)) if lat else 0.0
+    return {"bitwise": bitwise, "dropped": dropped, "recovery_p99_s": p99,
+            "trials": trials}
+
+
+def leg_serve_rolling_restart(n_reqs, seed):
+    from paddle_tpu.serving.supervisor import ServingSupervisor
+
+    serving, factory, _, traffic, golden = _serving_fixture()
+    reqs = traffic(n_reqs, seed)
+    gold = golden(reqs)
+    d = tempfile.mkdtemp(prefix="serve_chaos_")
+    try:
+        sup = ServingSupervisor(factory, num_replicas=2, snapshot_dir=d)
+        for r in reqs:
+            sup.submit(r)
+        for _ in range(2):
+            sup.step()
+        sup.rolling_restart()
+        results = sup.run()
+        miss, ok = _check_bitwise(results, reqs, gold)
+        return {"bitwise": ok, "dropped": miss,
+                "alive": sup.alive_replicas}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def leg_serve_snapshot_io(seed):
+    """Snapshot write chaos + on-disk rot: retry, quarantine, fall back."""
+    from paddle_tpu.incubate.checkpoint import CheckpointManager, ckpt_counters
+    from paddle_tpu.utils import fault_injection as fi
+
+    serving, factory, ref, traffic, golden = _serving_fixture()
+    reqs = traffic(3, seed)
+    gold = golden(reqs)
+    d = tempfile.mkdtemp(prefix="serve_chaos_")
+    try:
+        before = ckpt_counters()
+        mgr = CheckpointManager(d, async_save=False, retries=2,
+                                retry_backoff=0.01, site="serving_snapshot")
+        eng = factory().attach_checkpoint(mgr, every=0)
+        for r in reqs:
+            eng.submit(r)
+        with fi.inject(fi.FaultPlan(io_error_on_snapshots=[1])):
+            for _ in range(3):
+                eng.step()
+            eng.save_snapshot()         # injected OSError -> retried
+            for _ in range(2):
+                eng.step()
+            eng.save_snapshot()
+        retries = ckpt_counters()["save_retries"] - before["save_retries"]
+        newest = mgr.latest_step()
+        with open(os.path.join(d, f"step_{newest}", "state.pdckpt"),
+                  "r+b") as f:
+            f.seek(-8, 2)
+            f.write(b"\x00" * 8)        # rot the newest snapshot
+        results = dict(eng.pop_results())
+        eng2 = factory()
+        eng2.load_state_dict(mgr.restore())   # quarantines + falls back
+        quarantined = ckpt_counters()["quarantined"] - before["quarantined"]
+        results.update(eng2.run())
+        miss, ok = _check_bitwise(results, reqs, gold)
+        return {"recovered": ok and quarantined == 1 and retries == 1,
+                "dropped": miss, "retries": retries,
+                "quarantined": quarantined,
+                "fell_back_to": mgr.last_restored_step}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def leg_serve_stale_heartbeat(seed):
+    import time
+
+    from paddle_tpu.serving.supervisor import ServingSupervisor
+    from paddle_tpu.utils import fault_injection as fi
+
+    serving, factory, _, traffic, golden = _serving_fixture()
+    reqs = traffic(4, seed)
+    gold = golden(reqs)
+    d = tempfile.mkdtemp(prefix="serve_chaos_")
+    try:
+        sup = ServingSupervisor(
+            factory, num_replicas=2, snapshot_dir=os.path.join(d, "snap"),
+            snapshot_every=2, heartbeat_dir=os.path.join(d, "hb"),
+            heartbeat_timeout=0.05)
+        with fi.inject(fi.FaultPlan(stale_heartbeat_ranks=[1])):
+            for r in reqs:
+                sup.submit(r)
+            for _ in range(3):
+                sup.step()
+            time.sleep(0.1)             # replica1's heartbeat file rots
+            results = sup.run()
+        miss, ok = _check_bitwise(results, reqs, gold)
+        return {"bitwise": ok, "dropped": miss,
+                "heartbeats_dropped": fi.stats()["heartbeats_dropped"]}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def run_serving_ladder(quick=True, deterministic=False, seed=7):
+    """The serving chaos ladder. ``deterministic=True`` is the fast tier-1
+    sub-rung: kill-resume + rolling-restart only, tiny traffic, no
+    wall-clock reporting. The full ladder adds snapshot-IO chaos,
+    stale-heartbeat failover and p99 recovery latency over several kill
+    trials. Returns a machine-readable dict; total requests_dropped must
+    be 0."""
+    from paddle_tpu import profiler
+
+    profiler.reset_serving_counters()
+    if deterministic:
+        kr = leg_serve_kill_resume(trials=1, n_reqs=4, seed=seed)
+        rr = leg_serve_rolling_restart(n_reqs=4, seed=seed + 50)
+        out = {"kill_resume": kr, "rolling_restart": rr,
+               "requests_dropped": kr["dropped"] + rr["dropped"]}
+        out["recovery"] = profiler.recovery_counters()
+        return out
+    trials = 3 if quick else 5
+    kr = leg_serve_kill_resume(trials=trials, n_reqs=6, seed=seed)
+    print(f"FAULT_SMOKE serve-kill-resume: "
+          f"{'OK' if kr['bitwise'] and not kr['dropped'] else 'FAIL'}  "
+          f"trials={kr['trials']} dropped={kr['dropped']} "
+          f"recovery-p99={kr['recovery_p99_s'] * 1e3:.0f}ms bitwise-equal")
+    rr = leg_serve_rolling_restart(n_reqs=6, seed=seed + 50)
+    print(f"FAULT_SMOKE serve-rolling-restart: "
+          f"{'OK' if rr['bitwise'] and not rr['dropped'] else 'FAIL'}  "
+          f"dropped={rr['dropped']} alive={rr['alive']}/2 bitwise-equal")
+    io = leg_serve_snapshot_io(seed=seed + 100)
+    print(f"FAULT_SMOKE serve-snapshot-io: "
+          f"{'OK' if io['recovered'] and not io['dropped'] else 'FAIL'}  "
+          f"retries={io['retries']} quarantined={io['quarantined']} "
+          f"fell-back-to=step_{io['fell_back_to']} dropped={io['dropped']}")
+    hb = leg_serve_stale_heartbeat(seed=seed + 150)
+    print(f"FAULT_SMOKE serve-stale-heartbeat: "
+          f"{'OK' if hb['bitwise'] and not hb['dropped'] else 'FAIL'}  "
+          f"beats-suppressed={hb['heartbeats_dropped']} "
+          f"dropped={hb['dropped']} bitwise-equal")
+    out = {"kill_resume": kr, "rolling_restart": rr, "snapshot_io": io,
+           "stale_heartbeat": hb,
+           "requests_dropped": (kr["dropped"] + rr["dropped"]
+                                + io["dropped"] + hb["dropped"]),
+           "recovery_p99_s": kr["recovery_p99_s"]}
+    out["recovery"] = profiler.recovery_counters()
+    print(f"FAULT_SMOKE serving-ladder: "
+          f"{'OK' if out['requests_dropped'] == 0 else 'FAIL'}  "
+          f"requests-dropped={out['requests_dropped']} "
+          f"recovery-p99={out['recovery_p99_s'] * 1e3:.0f}ms  "
+          f"{out['recovery']}")
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--kill-step", type=int, default=0,
                     help="fixed kill point (default: seeded random)")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--skip-serving", action="store_true",
+                    help="skip the serving chaos ladder")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -220,6 +491,9 @@ def main():
     leg_nan_rollback(paddle, nn, fi, args)
     leg_io_chaos(paddle, fi, args)
     paddle.set_flags(dict(DEFAULT_FLAGS))
+    if not args.skip_serving:
+        out = run_serving_ladder(quick=False, seed=args.seed)
+        assert out["requests_dropped"] == 0, out
     print("FAULT_SMOKE all: OK")
 
 
