@@ -1,0 +1,97 @@
+"""Per-axis communication-schedule backend selection (FLAGS_comm_backend).
+
+The collective schedule of each mesh axis is a pluggable backend:
+
+  * ``gspmd`` — whole collectives, emitted by the SPMD partitioner (the
+    seed's schedule; bitwise-untouched default);
+  * ``ring``  — scheduling-level decomposition: the collective splits into
+    per-chunk ``ppermute`` hops with compute issued on chunk arrival
+    (``tp_overlap.ring_ag_gemm``/``gemm_ring_rs`` on the mp axis,
+    ``grad_comm``'s explicit bucketed RS/AG schedule on the dp axis);
+  * ``fused`` — kernel-level fusion: Pallas kernels where each grid step
+    DMAs the next remote chunk while the current chunk's tile GEMM runs,
+    and the reduce-scatter epilogue accumulates partial tiles directly
+    into the scatter destination (``ops/pallas_kernels/fused_collectives``)
+    — no intermediate full-size HBM buffer is ever materialized.
+
+``FLAGS_comm_backend`` is a comma-separated ``axis=backend`` list (e.g.
+``"mp=fused,dp=ring"``); a bare backend name applies to every axis. The
+empty default hands control to the legacy flags (``FLAGS_mp_overlap`` ->
+``mp=ring``; ``FLAGS_grad_comm``/``FLAGS_weight_update_sharding`` ->
+``dp=ring``) so existing configurations are untouched. ``resolve``-time
+eligibility checks degrade an ineligible selection one rung (``fused`` ->
+``ring`` -> ``gspmd``) with a once-per-reason warning that names the exact
+flag setting that would fix the bail.
+"""
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+BACKENDS = ("gspmd", "ring", "fused")
+
+_warned = set()
+
+
+def _warn_once(key, msg):
+    if key not in _warned:
+        _warned.add(key)
+        logger.warning(msg)
+
+
+def _flags():
+    from .. import flags as _f
+    return _f._FLAGS
+
+
+def parse(spec):
+    """``"mp=fused,dp=ring"`` | ``"fused"`` | dict -> {axis: backend}.
+
+    Unknown backends/garbage entries warn once and are dropped (the axis
+    falls back to its legacy-flag default) — scripts written against a
+    newer flag vocabulary must not crash the step."""
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        items = list(spec.items())
+    else:
+        items = []
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" in part:
+                axis, _, backend = part.partition("=")
+                items.append((axis.strip(), backend.strip()))
+            else:
+                items.append((None, part))  # bare backend: every axis
+    out = {}
+    for axis, backend in items:
+        if backend not in BACKENDS:
+            _warn_once(("backend", axis, backend),
+                       f"FLAGS_comm_backend names unknown backend "
+                       f"{backend!r} for axis {axis or '*'}; valid backends "
+                       f"are {'/'.join(BACKENDS)} — entry ignored")
+            continue
+        if axis is None:
+            for a in ("dp", "mp"):
+                out[a] = backend
+        else:
+            out[axis] = backend
+    return out
+
+
+def requested(axis):
+    """The backend FLAGS_comm_backend names for ``axis``, or None when the
+    flag leaves this axis to the legacy flags."""
+    return parse(_flags().get("FLAGS_comm_backend", "")).get(axis)
+
+
+def fused_mesh_ok(mesh):
+    """Interpret-mode remote DMA (jax<0.5 discharge rule) supports exactly
+    ONE named mesh axis; on a real TPU the kernels compute flat logical
+    device ids themselves and any full-manual mesh works. (Convenience
+    alias of ops.pallas_kernels.fused_collectives.supported.)"""
+    from ..ops.pallas_kernels import fused_collectives as _fc
+    return _fc.supported(mesh)[0]

@@ -90,6 +90,22 @@ def create_hybrid_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, ep=1,
     return mesh
 
 
+def create_single_axis_mesh(axis, n=None, devices=None):
+    """Mesh with exactly ONE named axis (e.g. ('mp',) or ('dp',)) — the
+    layout interpret-mode fused GEMM+collective kernels require (jax<0.5's
+    remote-DMA discharge rule supports a single named axis; see
+    comm_backend.fused_mesh_ok). On a real TPU create_hybrid_mesh works
+    for the fused backend too."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices) if n is None else int(n)
+    assert n <= len(devices), (f"create_single_axis_mesh({axis!r}, {n}) "
+                               f"needs {n} devices, only "
+                               f"{len(devices)} available")
+    mesh = Mesh(np.array(devices[:n]), (axis,))
+    set_mesh(mesh)
+    return mesh
+
+
 def replicated_sharding(mesh=None):
     mesh = mesh or _global_mesh
     return NamedSharding(mesh, PartitionSpec())

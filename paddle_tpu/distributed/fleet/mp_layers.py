@@ -93,10 +93,10 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         from .. import tp_overlap as _tp
         mesh = env.get_mesh()
-        if (_tp.layer_schedule(mesh) == "explicit"
+        if (_tp.layer_schedule(mesh) in ("explicit", "fused")
                 and _tp.layer_shapes_ok(x, self.weight, mesh, column=True)):
-            # ring-decomposed all-gather+GEMM (seq-sharded input arrives
-            # from the previous RowParallel's reduce-scatter)
+            # ring-decomposed (or Pallas-fused) all-gather+GEMM (seq-sharded
+            # input arrives from the previous RowParallel's reduce-scatter)
             gather = self.gather_output
             if self.bias is not None:
                 return _apply(
@@ -137,10 +137,10 @@ class RowParallelLinear(Layer):
         from .. import tp_overlap as _tp
         mesh = env.get_mesh()
         mode = _tp.layer_schedule(mesh)
-        if (mode == "explicit"
+        if (mode in ("explicit", "fused")
                 and _tp.layer_shapes_ok(x, self.weight, mesh, column=False)):
-            # GEMM streaming partial products into a pipelined ring
-            # reduce-scatter; output lands seq-sharded for the next block
+            # GEMM streaming partial products into a pipelined ring (or
+            # in-kernel) reduce-scatter; output lands seq-sharded
             if self.bias is not None:
                 return _apply(
                     lambda xd, wd, bd: _tp.row_linear(xd, wd, bd, mesh),

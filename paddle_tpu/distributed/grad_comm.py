@@ -132,11 +132,13 @@ class BucketPlan:
                    jnp.dtype(wire_dtype or b.dtype).itemsize
                    for b in self.buckets)
 
-    def reduce_record(self, wire_dtype, two_sided=False):
+    def reduce_record(self, wire_dtype, two_sided=False, fixed16=False):
         """Wire bytes + collective count of one reduce pass. A ring
         reduce-scatter moves (n-1)/n of the buffer per device; the explicit
         all-reduce schedule (two_sided=True) is RS + grad all-gather and
-        moves twice that — which is exactly ring all-reduce's cost."""
+        moves twice that — which is exactly ring all-reduce's cost.
+        `fixed16` (the composed fused backend's bf16-width wire) counts
+        int16 scatter rows plus the fp32 scale all-reduce."""
         n = self.n
         frac = (n - 1) / n
         by_dtype, coll = {}, 0
@@ -146,8 +148,16 @@ class BucketPlan:
             eff = jnp.dtype(wd or b.dtype)
             cols = b.cols
             key = str(eff)
-            if wd is jnp.int8:
-                _, nch, cols = _int8_chunking(b.cols)  # chunk-padded wire rows
+            if fixed16 and wd is jnp.bfloat16:
+                _, nch, cols = _int8_chunking(b.cols)
+                key, eff = "int16", jnp.dtype(jnp.int16)
+                # the shared-scale bound is a psum (ring AR: 2(n-1)/n) of
+                # the (n, nch) fp32 absmaxes
+                by_dtype["float32"] = by_dtype.get("float32", 0) + int(
+                    2 * frac * n * nch * 4)
+                coll += 1
+            elif wd is jnp.int8:
+                _, nch, cols = _int8_chunking(b.cols)  # chunk-padded rows
                 by_dtype["float32"] = by_dtype.get("float32", 0) + int(
                     n * nch * 4 * frac)          # per-chunk scales
                 coll += 1                        # extra scale all_to_all
@@ -196,6 +206,36 @@ def _split_row(plan, bucket, row):
     return out
 
 
+def _fixed16_reduce_row(x, axis, idx):
+    """(n, cols) local bucket -> this replica's reduced row (cols,) fp32
+    over an int16 fixed-point wire — the partial-manual-safe realization
+    of the fused backend's compressed (bf16-width, 0.5x fp32 bytes) wire
+    for the dp x mp composed step, where jax 0.4.x can partition neither
+    `all_to_all` nor an in-kernel remote DMA.
+
+    Per INT8_CHUNK-element chunk the psum of per-replica absmaxes bounds
+    the accumulated magnitude, so with scale = bound / (32767 - n) the
+    int16 `psum_scatter` accumulation cannot overflow even with per-value
+    rounding of up to 0.5 — integer accumulation is EXACT, and the single
+    fp32 dequantization at the destination is the only lossy step
+    (>= 12-bit effective mantissa at n <= 8 vs bf16's 8). `idx` is the
+    replica index operand (lax.axis_index aborts the partitioner here)."""
+    n, cols = x.shape
+    chunk, nch, padded = _int8_chunking(cols)
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (0, padded - cols)))
+    xc = xp.reshape(n, nch, chunk)
+    absmax = jnp.max(jnp.abs(xc), axis=-1)           # (n, nch)
+    bound = lax.psum(absmax, axis)                   # identical on replicas
+    scale = bound / float(32767 - n)
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    q = jnp.round(xc * inv[..., None]).astype(jnp.int16)
+    qs = lax.psum_scatter(q.reshape(n, padded), axis,
+                          scatter_dimension=0, tiled=True).reshape(-1)
+    srow = lax.dynamic_index_in_dim(scale, idx, keepdims=False)   # (nch,)
+    deq = qs.reshape(nch, chunk).astype(jnp.float32) * srow[:, None]
+    return deq.reshape(padded)[:cols]
+
+
 def _quantized_reduce_row(x, axis, wire_dtype):
     """(n, cols) local bucket -> this replica's reduced row (cols,) fp32.
 
@@ -219,17 +259,31 @@ def _quantized_reduce_row(x, axis, wire_dtype):
     return y.astype(jnp.float32).sum(axis=0)
 
 
-def reduce_scatter_grads(plan, grads, axis, wire_dtype, denom=1):
+def reduce_scatter_grads(plan, grads, axis, wire_dtype, denom=1, meta=None,
+                         fixed16=False, idx=None):
     """Local per-replica grads -> this replica's flat shard of the MEAN
-    gradient, {name: (cols,)}. Uses psum_scatter at full precision and the
-    quantized all_to_all exchange otherwise (non-float buckets always go
-    full precision)."""
+    gradient, {name: (cols,)}. Routes, per bucket:
+      * `meta` set (fused backend, single-axis mesh): the Pallas ring-RS
+        kernel (`fused_rs_bucket`) whose epilogue compresses each hop's
+        traveling accumulator to the bf16 wire and accumulates fp32 —
+        fp32 and bf16 wires; the int8 wire keeps the all_to_all exchange;
+      * `fixed16` (fused backend, dp x mp composed step, bf16 wire): the
+        int16 fixed-point psum_scatter (`_fixed16_reduce_row`, needs the
+        `idx` replica-index operand);
+      * otherwise psum_scatter at full precision / quantized all_to_all
+        exchange (non-float buckets always full precision)."""
     shards = {}
     for b in plan.buckets:
         x = _pack_bucket(plan, b, grads)
         wd = wire_dtype if (wire_dtype is not None and
                             jnp.issubdtype(b.dtype, jnp.floating)) else None
-        if wd is None:
+        is_float = jnp.issubdtype(b.dtype, jnp.floating)
+        if meta is not None and is_float and wd is not jnp.int8:
+            from ..ops.pallas_kernels import fused_collectives as _fc
+            row = _fc.fused_rs_bucket(meta, x, wd)
+        elif fixed16 and is_float and wd is jnp.bfloat16:
+            row = _fixed16_reduce_row(x, axis, idx)
+        elif wd is None:
             row = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True
                                    ).reshape(-1)
         else:
@@ -242,9 +296,10 @@ def reduce_scatter_grads(plan, grads, axis, wire_dtype, denom=1):
     return shards
 
 
-def all_gather_shards(plan, shards, axis, idx=None):
+def all_gather_shards(plan, shards, axis, idx=None, meta=None):
     """Per-replica flat shards -> full arrays, {name: shape/dtype of plan}.
-    Bucketed: one all_gather per bucket. With `idx` given (the mp-composed
+    Bucketed: one all_gather per bucket — the Pallas ring-AG kernel under
+    the fused backend (`meta` set). With `idx` given (the mp-composed
     partial-manual region, where jax 0.4.x cannot partition `all_gather`),
     the gather is emulated as placement-into-zeros + psum — same result,
     2x the wire bytes of a ring all-gather (the ledger accounts for it)."""
@@ -252,7 +307,10 @@ def all_gather_shards(plan, shards, axis, idx=None):
     for b in plan.buckets:
         row = jnp.concatenate([shards[name] for name in b.names]) \
             if len(b.names) > 1 else shards[b.names[0]]
-        if idx is None:
+        if meta is not None and idx is None:
+            from ..ops.pallas_kernels import fused_collectives as _fc
+            full = _fc.fused_ag_bucket(meta, row)              # (n, cols)
+        elif idx is None:
             full = lax.all_gather(row, axis, tiled=False)      # (n, cols)
         else:
             full = jnp.zeros((plan.n,) + row.shape, row.dtype)
@@ -388,6 +446,19 @@ class GradCommConfig:
     # schedule binds only its own axis manually and the model's mp
     # collectives/constraints keep working inside
     auto_axes: tuple = ()
+    # dp-axis comm backend ('ring' = the explicit lax-collective schedule,
+    # 'fused' = Pallas in-kernel rings where eligible) and whether the
+    # fused kernels actually run (False on the composed dp x mp step,
+    # where only the fixed-point wire realization applies)
+    backend: str = "ring"
+    fused_kernels: bool = False
+
+    @property
+    def fixed16(self):
+        """Whether the composed step's bf16 wire rides the int16
+        fixed-point psum_scatter (see _fixed16_reduce_row)."""
+        return (self.backend == "fused" and bool(self.auto_axes)
+                and self.wire_dtype is jnp.bfloat16)
 
 
 _warned = set()
@@ -413,9 +484,17 @@ def resolve(mesh, optimizer, opt_state=None, params=None, offload=False,
         schedule (the shipped default: everything off, path unchanged).
     """
     from .. import flags as _flags
+    from . import comm_backend
     F = _flags._FLAGS
+    req = comm_backend.requested("dp")
     mode = F.get("FLAGS_grad_comm", "auto")
     if mode is False or mode in ("off", "0"):
+        if req in ("ring", "fused"):
+            _warn_once(("dp-off", req),
+                       f"FLAGS_comm_backend='dp={req}' ignored because "
+                       f"FLAGS_grad_comm is off — set FLAGS_grad_comm="
+                       f"'auto' (or 'on') to activate the explicit dp "
+                       f"schedule")
         return None
     wus = bool(F.get("FLAGS_weight_update_sharding", False))
     raw = F.get("FLAGS_allreduce_dtype", "float32")
@@ -424,11 +503,21 @@ def resolve(mesh, optimizer, opt_state=None, params=None, offload=False,
                    f"FLAGS_allreduce_dtype={raw!r} unknown; using float32")
         raw = "float32"
     wire = _WIRE_DTYPES[raw]
-    explicit = mode in (True, "on", "1")
+    if req == "gspmd":
+        if wus or wire is not None:
+            _warn_once("dp-gspmd",
+                       "FLAGS_comm_backend='dp=gspmd' keeps the GSPMD "
+                       "all-reduce schedule, so FLAGS_weight_update_sharding"
+                       "/FLAGS_allreduce_dtype are ignored — set "
+                       "FLAGS_comm_backend='dp=ring' (or 'dp=fused') to "
+                       "activate them")
+        return None
+    explicit = mode in (True, "on", "1") or req in ("ring", "fused")
     if not explicit and not (wus or wire is not None):
         return None
     if mesh is None:
         return None
+    backend = req or "ring"
 
     def bail(key, msg):
         _warn_once(key, msg + " — falling back to the GSPMD schedule")
@@ -443,19 +532,52 @@ def resolve(mesh, optimizer, opt_state=None, params=None, offload=False,
         return bail(("axes", tuple(active)),
                     f"grad_comm needs a single active dp/sharding axis "
                     f"(plus at most a tensor-parallel 'mp' axis), "
-                    f"mesh has {active}")
+                    f"mesh has {active} — set the other axes to 1")
     # mp composition: the step compiles PARTIAL-manual — only the dp axis
     # is bound, mp stays GSPMD-auto so the model's tensor-parallel
     # constraints/collectives keep working inside the region
     auto_axes = ("mp",) if others else ()
+    fused_kernels = False
+    if backend == "fused":
+        if auto_axes:
+            # the partitioner cannot partition an opaque pallas_call over
+            # the auto mp axis, so the composed step keeps the lax
+            # collectives; only the wire format picks up the fused
+            # epilogue's fixed-point realization (below)
+            pass
+        else:
+            from ..ops.pallas_kernels import fused_collectives as _fc
+            ok, why = _fc.supported(mesh, why="dp axis")
+            if ok:
+                fused_kernels = True
+            else:
+                _warn_once(("fused-dp", tuple(mesh.axis_names)),
+                           f"fused dp backend unavailable: {why} — falling "
+                           f"back to FLAGS_comm_backend='dp=ring'")
+                backend = "ring"
     if auto_axes and wire is not None:
-        return bail(("mp-wire", raw),
-                    f"compressed FLAGS_allreduce_dtype={raw!r} uses "
-                    f"all_to_all, which jax 0.4.x cannot partition inside "
-                    f"a partial-manual region (active mp axis)")
+        if backend == "fused" and wire is jnp.bfloat16:
+            pass  # int16 fixed-point wire, exact accumulation (0.5x bytes)
+        elif backend == "fused":
+            return bail(
+                ("mp-wire-int8", raw),
+                f"compressed FLAGS_allreduce_dtype={raw!r} with an active "
+                f"mp axis is only available at bf16 width — set "
+                f"FLAGS_allreduce_dtype='bfloat16' (keeping "
+                f"FLAGS_comm_backend='dp=fused')")
+        else:
+            return bail(
+                ("mp-wire", raw),
+                f"compressed FLAGS_allreduce_dtype={raw!r} uses all_to_all, "
+                f"which jax 0.4.x cannot partition inside a partial-manual "
+                f"region (active mp axis) — set FLAGS_comm_backend="
+                f"'dp=fused' to route the reduction through the fused RS "
+                f"epilogue's quantized wire instead")
     if offload:
         return bail("offload", "grad_comm does not compose with host "
-                    "offload of optimizer states yet")
+                    "offload of optimizer states yet — set "
+                    "HybridTrainStep(offload=False) / drop the offloading "
+                    "optimizer to use the explicit dp schedule")
     axis = dp_like[0]
     n = int(mesh.shape[axis])
     if param_specs:
@@ -508,7 +630,8 @@ def resolve(mesh, optimizer, opt_state=None, params=None, offload=False,
                           weight_update_sharding=wus, wire_dtype=wire,
                           bucket_bytes=int(F.get("FLAGS_grad_bucket_bytes",
                                                  16 * 2 ** 20)),
-                          auto_axes=auto_axes)
+                          auto_axes=auto_axes, backend=backend,
+                          fused_kernels=fused_kernels)
 
 
 # ---------------------------------------------------------------------------
@@ -521,7 +644,8 @@ _lock = threading.Lock()
 def _zero_counters():
     return {"steps": 0, "collectives": 0, "reduce_bytes": 0,
             "reduce_bytes_by_dtype": {}, "gather_bytes": 0, "buckets": 0,
-            "payload_bytes": 0, "padded_bytes": 0}
+            "payload_bytes": 0, "padded_bytes": 0, "fused_dispatches": 0,
+            "backend": {}}
 
 
 _counters = _zero_counters()
@@ -536,19 +660,32 @@ class StepComm:
     buckets: int = 0
     payload_bytes: int = 0
     padded_bytes: int = 0
+    fused_dispatches: int = 0     # Pallas kernel launches (fused backend)
+    backend: str = "ring"
 
 
 def make_step_record(plan, wire_dtype, weight_update_sharding,
-                     with_update=True, emulated_gather=False):
+                     with_update=True, emulated_gather=False,
+                     backend="ring", fused_kernels=False, fixed16=False):
     """Byte/collective ledger for one executed step of this plan. The
     explicit all-reduce baseline (weight_update_sharding=False) counts
     RS+grad-AG as reduce bytes (= ring all-reduce); the sharded-update
     schedule counts RS as reduce and the param all-gather as gather.
     `emulated_gather` (mp-composed partial-manual steps) doubles the
-    gather-side bytes — see all_gather_shards."""
+    gather-side bytes — see all_gather_shards. Under the fused backend
+    (`fused_kernels`) each eligible bucket's RS/AG is one Pallas kernel
+    launch, counted in `fused_dispatches`."""
     rec = StepComm()
+    rec.backend = backend
     by_dtype, coll = plan.reduce_record(
-        wire_dtype, two_sided=not weight_update_sharding)
+        wire_dtype, two_sided=not weight_update_sharding, fixed16=fixed16)
+    if fused_kernels:
+        rs_k = sum(1 for b in plan.buckets
+                   if jnp.issubdtype(b.dtype, jnp.floating)
+                   and wire_dtype is not jnp.int8)
+        ag_k = len(plan.buckets) if (not weight_update_sharding
+                                     or with_update) else 0
+        rec.fused_dispatches = rs_k + ag_k
     if not weight_update_sharding and emulated_gather:
         # the grad-AG half of the explicit all-reduce is emulated too
         for b in plan.buckets:
@@ -578,6 +715,8 @@ def record_step(rec):
         _counters["buckets"] += rec.buckets
         _counters["payload_bytes"] += rec.payload_bytes
         _counters["padded_bytes"] += rec.padded_bytes
+        _counters["fused_dispatches"] += rec.fused_dispatches
+        _counters["backend"]["dp"] = rec.backend
         for k, v in rec.reduce_bytes_by_dtype.items():
             _counters["reduce_bytes"] += v
             d = _counters["reduce_bytes_by_dtype"]
@@ -588,6 +727,7 @@ def comm_counters():
     with _lock:
         out = dict(_counters)
         out["reduce_bytes_by_dtype"] = dict(out["reduce_bytes_by_dtype"])
+        out["backend"] = dict(out["backend"])
     out["bucket_fill"] = (out["payload_bytes"] / out["padded_bytes"]
                           if out["padded_bytes"] else 0.0)
     return out

@@ -73,6 +73,28 @@ def mp_overlap_requested():
     return bool(_flags().get("FLAGS_mp_overlap", False))
 
 
+def mp_backend_requested():
+    """The mp-axis comm backend, resolved across FLAGS_comm_backend and the
+    legacy flags: None (pure GSPMD, seed path), 'rsag' (sequence-parallel
+    layout, whole RS/AG collectives), 'ring' (ppermute decomposition,
+    PR 3's overlap), 'fused' (Pallas kernels). Naming mp=ring/fused in
+    FLAGS_comm_backend implies the sequence-parallel layout."""
+    from . import comm_backend
+    req = comm_backend.requested("mp")
+    if req is None:
+        if not sequence_parallel_requested():
+            return None
+        return "ring" if mp_overlap_requested() else "rsag"
+    if req == "gspmd":
+        return "rsag" if sequence_parallel_requested() else None
+    return req
+
+
+def explicit_mp_requested():
+    """Whether any flag asks for the explicit (shard_map) mp schedule."""
+    return mp_backend_requested() is not None
+
+
 # ---------------------------------------------------------------------------
 # shard-space primitives (called inside a full-manual shard_map; `axis` is
 # the bound mp axis name, `n` its static size)
@@ -138,21 +160,29 @@ def gemm_ring_rs(y, w, axis, n):
     return acc
 
 
-def column_parallel(x, w, b, axis, n, overlap):
+def column_parallel(x, w, b, axis, n, backend, meta=None):
     """Seq-sharded input -> full-seq, feature-sharded output (the all-gather
-    'before ColumnParallel'). b is the per-device bias shard (or None)."""
-    if overlap:
+    'before ColumnParallel'). b is the per-device bias shard (or None).
+    backend: 'rsag' (whole collectives), 'ring' (ppermute hops), 'fused'
+    (Pallas AG+GEMM kernel — meta is its static RingMeta)."""
+    if backend == "fused":
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        out = _fc.fused_ag_gemm(meta, x, w)
+    elif backend == "ring":
         out = ring_ag_gemm(x, w, axis, n)
     else:
         out = seq_all_gather(x, axis, n) @ w
     return out if b is None else out + b
 
 
-def row_parallel(y, w, b, axis, n, overlap):
+def row_parallel(y, w, b, axis, n, backend, meta=None):
     """Full-seq, feature-sharded input -> seq-sharded reduced output (the
     reduce-scatter 'after RowParallel'). b is the FULL bias, added once
     after the cross-device reduction."""
-    if overlap:
+    if backend == "fused":
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        out = _fc.fused_gemm_rs(meta, y, w)
+    elif backend == "ring":
         out = gemm_ring_rs(y, w, axis, n)
     else:
         out = seq_reduce_scatter(y @ w, axis, n)
@@ -189,13 +219,15 @@ def to_qkv_head_major(blocks, H, nh):
     return out
 
 
-def sp_block_fn(config, n, axis="mp", overlap=False):
+def sp_block_fn(config, n, axis="mp", backend="rsag", meta=None):
     """Pure (params, x) block on PER-DEVICE shards: x [B, S/mp, H]; matmul
     weights arrive mp-sharded (qkv_w [H, 3H/mp] head-major, out_w [H/mp, H],
     up_w [H, I/mp], down_w [I/mp, H]); norms/biases-of-row replicated.
     Attention runs heads-parallel (nh/mp heads, full sequence) exactly like
     the GSPMD schedule — only the inter-matmul activation layout changes.
-    Requires config.qkv_head_major storage (resolve_gpt gates on it)."""
+    Requires config.qkv_head_major storage (resolve_gpt gates on it).
+    backend selects the collective decomposition ('rsag' | 'ring' |
+    'fused' — see FLAGS_comm_backend)."""
     from ..models.gpt import ln_fp32, _attention
 
     nh = config.num_heads
@@ -207,7 +239,8 @@ def sp_block_fn(config, n, axis="mp", overlap=False):
         d = H // nh
         h1 = ln_fp32(x, p["ln1_g"], p["ln1_b"], eps)
         qkv = column_parallel(h1, p["qkv_w"].astype(x.dtype),
-                              p["qkv_b"].astype(x.dtype), axis, n, overlap)
+                              p["qkv_b"].astype(x.dtype), axis, n, backend,
+                              meta)
         S = qkv.shape[1]
         qkv4 = qkv.reshape(B, S, nh_l, 3, d)  # head-major local columns
         q, k, v = qkv4[..., 0, :], qkv4[..., 1, :], qkv4[..., 2, :]
@@ -218,14 +251,17 @@ def sp_block_fn(config, n, axis="mp", overlap=False):
         ctx = checkpoint_name(ctx, "attn_ctx")
         attn_out = row_parallel(ctx.reshape(B, S, nh_l * d),
                                 p["out_w"].astype(x.dtype),
-                                p["out_b"].astype(x.dtype), axis, n, overlap)
+                                p["out_b"].astype(x.dtype), axis, n, backend,
+                                meta)
         x = x + attn_out
         h2 = ln_fp32(x, p["ln2_g"], p["ln2_b"], eps)
         up = column_parallel(h2, p["up_w"].astype(x.dtype),
-                             p["up_b"].astype(x.dtype), axis, n, overlap)
+                             p["up_b"].astype(x.dtype), axis, n, backend,
+                             meta)
         up = jax.nn.gelu(up, approximate=True)
         down = row_parallel(up, p["down_w"].astype(x.dtype),
-                            p["down_b"].astype(x.dtype), axis, n, overlap)
+                            p["down_b"].astype(x.dtype), axis, n, backend,
+                            meta)
         return x + down
 
     return block
@@ -252,7 +288,8 @@ def make_sp_block(config, mesh, cfg):
     axis (see module docstring for why partial-manual is not an option on
     jax 0.4.x); axes other than dp/mp are size-1 by `resolve_gpt` gating."""
     from .env import shard_map_compat
-    block = sp_block_fn(config, cfg.n, axis=cfg.axis, overlap=cfg.overlap)
+    block = sp_block_fn(config, cfg.n, axis=cfg.axis, backend=cfg.backend,
+                        meta=cfg.kernel_meta(mesh))
     x_spec = sp_activation_spec(cfg.batch_axis)
     return shard_map_compat(
         block, mesh,
@@ -268,20 +305,37 @@ def make_sp_block(config, mesh, cfg):
 class SPConfig:
     axis: str          # mp axis name
     n: int             # mp size
-    overlap: bool
-    batch_axis: str = "dp"
+    backend: str       # 'rsag' | 'ring' | 'fused'
+    batch_axis: str = "dp"     # None on a mesh without a dp axis
+
+    @property
+    def overlap(self):
+        """PR 3 compatibility: whether the ring (ppermute) decomposition
+        runs. The fused backend overlaps too, but in-kernel."""
+        return self.backend == "ring"
+
+    def kernel_meta(self, mesh):
+        if self.backend != "fused":
+            return None
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        return _fc.meta_for(mesh, self.axis)
 
 
 def resolve_gpt(config, mesh, batch=None, seq=None):
     """Decide whether the explicit sequence-parallel schedule applies to a
     gpt_hybrid step. Returns SPConfig or None (None = GSPMD schedule,
-    byte-identical to the seed). Every bail warns once with the reason —
-    the fallback rules documented in README."""
-    if not sequence_parallel_requested():
+    byte-identical to the seed). Every bail warns once with the reason AND
+    the exact flag setting that would fix it — the fallback rules
+    documented in README ("Communication backends")."""
+    backend = mp_backend_requested()
+    if backend is None:
         if mp_overlap_requested():
             _warn_once("overlap-needs-sp",
                        "FLAGS_mp_overlap requires FLAGS_sequence_parallel; "
-                       "ignoring (GSPMD schedule kept)")
+                       "ignoring (GSPMD schedule kept) — set "
+                       "FLAGS_sequence_parallel=True (or "
+                       "FLAGS_comm_backend='mp=ring') to enable the "
+                       "explicit schedule")
         return None
     if mesh is None:
         return None
@@ -298,12 +352,14 @@ def resolve_gpt(config, mesh, batch=None, seq=None):
     if extra:
         return bail(("axes", tuple(extra)),
                     f"sequence parallelism binds the whole mesh manually; "
-                    f"axes {extra} must be size 1")
+                    f"axes {extra} must be size 1 (set them to 1 in "
+                    f"create_hybrid_mesh, or drop the explicit schedule "
+                    f"with FLAGS_comm_backend='mp=gspmd')")
     H = config.hidden_size
     if H % mp or config.num_heads % mp or (config.ffn_mult * H) % mp:
         return bail(("dims", H, config.num_heads, mp),
                     f"hidden {H}/heads {config.num_heads}/ffn not divisible "
-                    f"by mp={mp}")
+                    f"by mp={mp} (choose an mp degree dividing all three)")
     if not getattr(config, "qkv_head_major", False):
         # the sp block reads a contiguous qkv column shard as nh/mp whole
         # heads, which is only true of head-major storage; HybridTrainStep
@@ -313,24 +369,40 @@ def resolve_gpt(config, mesh, batch=None, seq=None):
                     "sequence parallelism needs head-major qkv storage "
                     "(config.qkv_head_major; HybridTrainStep sets it up)")
     if seq is not None and seq % mp:
-        return bail(("seq", seq, mp), f"sequence {seq} not divisible by "
-                                      f"mp={mp}")
+        return bail(("seq", seq, mp),
+                    f"sequence {seq} not divisible by mp={mp} (pad the "
+                    f"sequence or lower the mp degree)")
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
     dp = mesh.shape.get("dp", 1)
     if batch is not None and dp > 1 and batch % dp:
-        return bail(("batch", batch, dp), f"batch {batch} not divisible by "
-                                          f"dp={dp}")
-    overlap = mp_overlap_requested()
-    if overlap and jax.default_backend() == "cpu" and \
+        return bail(("batch", batch, dp),
+                    f"batch {batch} not divisible by dp={dp} (adjust the "
+                    f"global batch or the dp degree)")
+    if backend == "fused":
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        # lane dims the Mosaic kernels see: hidden (chunk/GEMM lane), the
+        # qkv and ffn weight-shard widths
+        ok, why = _fc.supported(
+            mesh, shapes=(H, 3 * H // mp, config.ffn_mult * H // mp),
+            why="mp axis")
+        if not ok:
+            _warn_once(("fused-mp", tuple(mesh.axis_names)),
+                       f"fused mp backend unavailable: {why} — falling back "
+                       f"to FLAGS_comm_backend='mp=ring'")
+            backend = "ring"
+    if backend == "ring" and jax.default_backend() == "cpu" and \
             jnp.dtype(config.compute_dtype or "float32") == jnp.bfloat16:
         # same XLA CPU abort as the bf16 ppermute pipeline (gpt_hidden's
         # pp>1 guard); plain RS/AG sequence parallelism is unaffected
         _warn_once("cpu-bf16-overlap",
                    "mp overlap uses ppermute, which the XLA CPU backend "
                    "cannot partition in bf16 — running sequence parallelism "
-                   "without overlap on CPU")
-        overlap = False
-    return SPConfig(axis="mp", n=int(mp), overlap=overlap,
-                    batch_axis="dp")
+                   "without overlap on CPU (use compute_dtype='float32' on "
+                   "CPU, or FLAGS_comm_backend='mp=fused' on a single-axis "
+                   "mesh)")
+        backend = "rsag"
+    return SPConfig(axis="mp", n=int(mp), backend=backend,
+                    batch_axis=batch_axis)
 
 
 # ---------------------------------------------------------------------------
@@ -341,14 +413,16 @@ def layer_schedule(mesh):
     """What the mp layers should do under the current flags/mesh:
     'gspmd' — seed behavior; 'seq' — GSPMD with seq-sharded constraints
     (RS+AG emitted by the partitioner); 'explicit' — route the matmul
-    through the shard_map ring kernels. Inside an existing SPMD manual
+    through the shard_map ring kernels; 'fused' — route it through the
+    Pallas fused GEMM+collective kernels. Inside an existing SPMD manual
     region (grad_comm's dp step, the pipeline) shard_map cannot nest, so
-    the explicit path degrades to 'seq' there."""
+    the explicit paths degrade to 'seq' there."""
     if mesh is None or mesh.shape.get("mp", 1) <= 1:
         return "gspmd"
-    if not sequence_parallel_requested():
+    backend = mp_backend_requested()
+    if backend is None:
         return "gspmd"
-    if not mp_overlap_requested():
+    if backend == "rsag":
         return "seq"
     from .collective import _in_spmd
     if any(_in_spmd(a) for a in mesh.axis_names):
@@ -357,6 +431,16 @@ def layer_schedule(mesh):
              if a not in ("dp", "mp") and mesh.shape.get(a, 1) > 1]
     if extra:
         return "seq"
+    if backend == "fused":
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        ok, why = _fc.supported(mesh, shapes=(), why="mp layers")
+        if not ok:
+            _warn_once(("fused-layers", tuple(mesh.axis_names)),
+                       f"fused mp backend unavailable for the mp layers: "
+                       f"{why} — falling back to "
+                       f"FLAGS_comm_backend='mp=ring'")
+            return "explicit"
+        return "fused"
     return "explicit"
 
 
@@ -375,6 +459,15 @@ def layer_shapes_ok(x, w, mesh, column):
     return shard_dim % mp == 0
 
 
+def _layer_backend(mesh):
+    """Backend + kernel meta for the mp-layer wrappers ('explicit' mode ->
+    ring, 'fused' mode -> Pallas kernels)."""
+    if layer_schedule(mesh) == "fused":
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        return "fused", _fc.meta_for(mesh, "mp")
+    return "ring", None
+
+
 def column_linear(x, w, b, mesh, gather_output):
     """Logical-shape ColumnParallelLinear forward on the explicit schedule:
     x [B,S,H] seq-sharded between blocks, w [H, F] mp-sharded on F. The
@@ -384,9 +477,10 @@ def column_linear(x, w, b, mesh, gather_output):
     mp = int(mesh.shape.get("mp", 1))
     batch_axis = "dp" if mesh.shape.get("dp", 1) > 1 else None
     x_spec = P(batch_axis, "mp", None)
+    backend, meta = _layer_backend(mesh)
 
     def f(xs, ws):
-        return column_parallel(xs, ws, None, "mp", mp, overlap=True)
+        return column_parallel(xs, ws, None, "mp", mp, backend, meta)
 
     mapped = shard_map_compat(
         f, mesh, in_specs=(x_spec, P(None, "mp")),
@@ -408,9 +502,10 @@ def row_linear(x, w, b, mesh):
     from .env import shard_map_compat
     mp = int(mesh.shape.get("mp", 1))
     batch_axis = "dp" if mesh.shape.get("dp", 1) > 1 else None
+    backend, meta = _layer_backend(mesh)
 
     def f(xs, ws):
-        return row_parallel(xs, ws, None, "mp", mp, overlap=True)
+        return row_parallel(xs, ws, None, "mp", mp, backend, meta)
 
     mapped = shard_map_compat(
         f, mesh, in_specs=(P(batch_axis, None, "mp"), P("mp", None)),
@@ -429,7 +524,9 @@ class MpStepRecord:
     schedule (the backward mirrors it: the transpose of a seq all-gather is
     a seq reduce-scatter and vice versa)."""
     collectives: int = 0          # RS/AG issued (ring counts its hop group)
-    ppermute_hops: int = 0        # individual ring hops (overlap only)
+    ppermute_hops: int = 0        # individual ring hops (ring backend only)
+    fused_dispatches: int = 0     # Pallas kernel launches (fused backend)
+    backend: str = "gspmd"        # the mp-axis backend that produced this
     rs_bytes: int = 0
     ag_bytes: int = 0
     bytes_by_kind: dict = field(default_factory=dict)
@@ -439,7 +536,10 @@ class MpStepRecord:
 def gpt_step_record(config, cfg: SPConfig, batch, seq):
     """Ledger of the explicit schedule for one gpt_hybrid step: per block
     an AG before QKV, an RS after the attention output projection, an AG
-    before the FFN up-projection, an RS after the down-projection."""
+    before the FFN up-projection, an RS after the down-projection. Under
+    the fused backend the same four positions are Pallas kernel launches
+    (fused_dispatches) moving the same wire bytes with ZERO XLA-level
+    ppermute hops and no HBM-materialized gather buffer."""
     n = cfg.n
     item = jnp.dtype(config.compute_dtype or "float32").itemsize
     s = seq // n
@@ -450,8 +550,11 @@ def gpt_step_record(config, cfg: SPConfig, batch, seq):
     rec.rs_bytes = 2 * L * per_coll
     rec.ag_bytes = 2 * L * per_coll
     rec.collectives = 4 * L
-    if cfg.overlap:
+    rec.backend = cfg.backend
+    if cfg.backend == "ring":
         rec.ppermute_hops = 4 * L * (n - 1)
+    elif cfg.backend == "fused":
+        rec.fused_dispatches = 4 * L
     rec.bytes_by_kind = {"reduce_scatter": rec.rs_bytes,
                          "all_gather": rec.ag_bytes}
     rec.activation_bytes = chunk
@@ -480,6 +583,7 @@ _lock = threading.Lock()
 
 def _zero_counters():
     return {"steps": 0, "collectives": 0, "ppermute_hops": 0,
+            "fused_dispatches": 0, "backend": {},
             "rs_bytes": 0, "ag_bytes": 0, "bytes_by_kind": {},
             "activation_bytes": 0}
 
@@ -494,6 +598,8 @@ def record_step(rec: MpStepRecord | None):
         _counters["steps"] += 1
         _counters["collectives"] += rec.collectives
         _counters["ppermute_hops"] += rec.ppermute_hops
+        _counters["fused_dispatches"] += rec.fused_dispatches
+        _counters["backend"]["mp"] = rec.backend
         _counters["rs_bytes"] += rec.rs_bytes
         _counters["ag_bytes"] += rec.ag_bytes
         _counters["activation_bytes"] = rec.activation_bytes
@@ -506,6 +612,7 @@ def mp_counters():
     with _lock:
         out = dict(_counters)
         out["bytes_by_kind"] = dict(out["bytes_by_kind"])
+        out["backend"] = dict(out["backend"])
     out["wire_bytes"] = sum(out["bytes_by_kind"].values())
     return out
 

@@ -128,6 +128,27 @@ _FLAGS = {
     # (T3 / fused computation-collective style). Requires
     # FLAGS_sequence_parallel; default OFF.
     "FLAGS_mp_overlap": False,
+    # -- per-axis communication-schedule backend ----------------------------
+    # Pluggable collective decomposition per mesh axis, e.g. "mp=fused" or
+    # "mp=fused,dp=ring" (distributed/comm_backend.py). Backends:
+    #   gspmd — the partitioner emits whole collectives (seed behavior);
+    #   ring  — scheduling-level overlap: mp-1 ppermute hops with chunk
+    #           GEMMs on arrival (PR 3's ring_ag_gemm/gemm_ring_rs for mp;
+    #           grad_comm's explicit bucketed RS/AG schedule for dp);
+    #   fused — kernel-level fusion: Pallas kernels whose grid steps DMA
+    #           the next remote chunk while the current chunk's tile GEMM
+    #           runs, and whose reduce-scatter epilogue accumulates partial
+    #           tiles directly into the scatter destination — no
+    #           intermediate full-size buffer is ever materialized
+    #           (ops/pallas_kernels/fused_collectives.py).
+    # Naming mp=ring/fused implies the sequence-parallel activation layout;
+    # naming dp=ring/fused implies the explicit grad-comm schedule. The
+    # empty default keeps the legacy flags in charge (FLAGS_mp_overlap ->
+    # mp=ring, FLAGS_grad_comm/FLAGS_weight_update_sharding -> dp=ring) and
+    # the flags-off program byte-identical to the seed. Ineligible
+    # selections fall back one rung (fused -> ring -> gspmd) with a
+    # once-per-reason warning naming the exact flag that would fix it.
+    "FLAGS_comm_backend": "",
 }
 
 

@@ -411,14 +411,22 @@ class TrainStep:
         # composed mode; the sharded-update path hands its param gather to
         # GSPMD outside the manual region (native all-gather bytes)
         emu = composed and not wus
+        # fused backend: bucket RS/AG ride the Pallas in-kernel rings
+        # (single-axis meshes); the composed step's bf16 wire rides the
+        # int16 fixed-point psum_scatter (grad_comm._fixed16_reduce_row)
+        fused_meta = None
+        if cfg.fused_kernels:
+            from ..ops.pallas_kernels import fused_collectives as _fc
+            fused_meta = _fc.meta_for(mesh, axis)
+        fixed16 = cfg.fixed16
 
+        rec_kw = dict(emulated_gather=emu, backend=cfg.backend,
+                      fused_kernels=cfg.fused_kernels, fixed16=fixed16)
         self._comm_records = {
-            "step": _gc.make_step_record(plan, wire, wus,
-                                         emulated_gather=emu),
+            "step": _gc.make_step_record(plan, wire, wus, **rec_kw),
             "micro": _gc.make_step_record(plan, wire, wus, with_update=False,
-                                          emulated_gather=emu),
-            "fire": _gc.make_step_record(plan, wire, wus,
-                                         emulated_gather=emu),
+                                          **rec_kw),
+            "fire": _gc.make_step_record(plan, wire, wus, **rec_kw),
         }
         self._gc_extra = (jnp.arange(n, dtype=jnp.int32),) if composed \
             else ()
@@ -429,7 +437,8 @@ class TrainStep:
 
         def gather_full(shards, idx):
             return _gc.all_gather_shards(
-                plan, shards, axis, idx=idx if composed else None)
+                plan, shards, axis, idx=idx if composed else None,
+                meta=fused_meta)
 
         def local_loss_grads(params, buffers, key, inputs, labels, idx):
             # decorrelate per-replica dropout: the replicas see different
@@ -489,8 +498,10 @@ class TrainStep:
                     e.shape).astype(e.dtype)
             return out
 
-        def reduce_mean_shards(grads):
-            return _gc.reduce_scatter_grads(plan, grads, axis, wire, denom=n)
+        def reduce_mean_shards(grads, idx):
+            return _gc.reduce_scatter_grads(plan, grads, axis, wire, denom=n,
+                                            meta=fused_meta, fixed16=fixed16,
+                                            idx=idx)
 
         # anomaly guard in shard space: each replica checks its own local
         # loss and its 1/n reduced grad shards (the shards already contain
@@ -547,7 +558,7 @@ class TrainStep:
                 idx = replica_idx(ridx)
                 loss, new_buffers, grads = local_loss_grads(
                     params, buffers, key, inputs, labels, idx)
-                gshards = reduce_mean_shards(grads)
+                gshards = reduce_mean_shards(grads, idx)
                 ok = shard_ok(loss, gshards) if guard else None
                 if grad_clip is not None:
                     gshards = _gc.clip_shards(grad_clip, gshards, axis)
@@ -617,7 +628,7 @@ class TrainStep:
             idx = replica_idx(ridx)
             loss, new_buffers, grads = local_loss_grads(
                 params, buffers, key, inputs, labels, idx)
-            gshards = reduce_mean_shards(grads)
+            gshards = reduce_mean_shards(grads, idx)
             ok = shard_ok(loss, gshards) if guard else None
             if wus:
                 cand = {nm: gacc[nm] +
@@ -647,7 +658,7 @@ class TrainStep:
             idx = replica_idx(ridx)
             loss, new_buffers, grads = local_loss_grads(
                 params, buffers, key, inputs, labels, idx)
-            gshards = reduce_mean_shards(grads)
+            gshards = reduce_mean_shards(grads, idx)
             ok = shard_ok(loss, gshards) if guard else None
             if wus:
                 flat_acc = {nm: gacc[nm].reshape(-1) for nm in names}

@@ -130,9 +130,12 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
     if mesh is not None:
         # seq-parallel entry: the vocab-sharded embedding's psum lands
         # directly in the seq-sharded layout (a reduce-scatter, GSPMD-emitted
-        # from this constraint) instead of replicating [B,S,H]
-        x_spec = _tp.sp_activation_spec() if sp is not None \
-            else P("dp", None, None)
+        # from this constraint) instead of replicating [B,S,H]. Meshes
+        # without a dp axis (the single-axis mp meshes interpret-mode fused
+        # kernels need) replicate the batch dim.
+        batch_axis = "dp" if "dp" in mesh.axis_names else None
+        x_spec = _tp.sp_activation_spec(sp.batch_axis) if sp is not None \
+            else P(batch_axis, None, None)
         x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, x_spec))
     block = gpt_block_fn(config)
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
@@ -242,7 +245,7 @@ class HybridTrainStep:
             self.config.vpp_stage_major = True
         mp = self.mesh.shape.get("mp", 1) if self.mesh is not None else 1
         from ..distributed import tp_overlap as _tp
-        if (_tp.sequence_parallel_requested() and mp > 1 and pp == 1
+        if (_tp.explicit_mp_requested() and mp > 1 and pp == 1
                 and self.config.hidden_size % mp == 0
                 and self.config.num_heads % mp == 0):
             # head-major qkv storage so a contiguous 1/mp column shard is
@@ -402,7 +405,8 @@ class HybridTrainStep:
 
         jit_kwargs = dict(donate_argnums=(0, 1))
         if mesh is not None:
-            data_sh = NamedSharding(mesh, P("dp", None))
+            batch_axis = "dp" if "dp" in mesh.axis_names else None
+            data_sh = NamedSharding(mesh, P(batch_axis, None))
             rep = NamedSharding(mesh, P())
             jit_kwargs["in_shardings"] = (None, None, data_sh, rep)
         return jax.jit(step_fn, **jit_kwargs)

@@ -222,7 +222,10 @@ def dispatch_cache_summary():
 
 def comm_counters():
     """Snapshot of the gradient-communication counters: reduce_bytes (+ by
-    dtype), gather_bytes, collectives, buckets, bucket_fill, steps."""
+    dtype), gather_bytes, collectives, buckets, bucket_fill, steps — plus
+    the per-axis `backend` label ({'dp': 'ring'|'fused'}) and
+    `fused_dispatches` (Pallas kernel launches of the fused backend), so
+    counter gates can assert which backend actually ran."""
     from ..distributed import grad_comm
     return grad_comm.comm_counters()
 
@@ -237,10 +240,14 @@ def comm_summary():
     c = comm_counters()
     by = " ".join(f"{k}:{v / 1e6:.2f}MB"
                   for k, v in sorted(c["reduce_bytes_by_dtype"].items()))
-    return (f"steps: {c['steps']}  collectives: {c['collectives']}  "
+    backend = ",".join(f"{a}={b}" for a, b in sorted(c["backend"].items())) \
+        or "gspmd"
+    return (f"steps: {c['steps']}  backend: {backend}  "
+            f"collectives: {c['collectives']}  "
             f"reduce: {c['reduce_bytes'] / 1e6:.2f}MB ({by})  "
             f"gather: {c['gather_bytes'] / 1e6:.2f}MB  "
-            f"buckets: {c['buckets']}  fill: {c['bucket_fill'] * 100:.1f}%")
+            f"buckets: {c['buckets']}  fill: {c['bucket_fill'] * 100:.1f}%  "
+            f"fused-dispatches: {c['fused_dispatches']}")
 
 
 # -- tensor-parallel (mp-axis) communication counters ------------------------
@@ -254,7 +261,11 @@ def comm_summary():
 
 def mp_comm_counters():
     """Snapshot of the mp-axis schedule counters: rs_bytes, ag_bytes,
-    wire_bytes, collectives, ppermute_hops, activation_bytes, steps."""
+    wire_bytes, collectives, ppermute_hops, activation_bytes, steps — plus
+    the per-axis `backend` label ({'mp': 'rsag'|'ring'|'fused'}) and
+    `fused_dispatches` (Pallas GEMM+collective kernel launches per the
+    static forward schedule), so counter gates can assert which backend
+    actually ran."""
     from ..distributed import tp_overlap
     return tp_overlap.mp_counters()
 
@@ -267,10 +278,14 @@ def reset_mp_comm_counters():
 def mp_comm_summary():
     """One-line human-readable mp-axis communication report."""
     c = mp_comm_counters()
-    return (f"steps: {c['steps']}  collectives: {c['collectives']}  "
+    backend = ",".join(f"{a}={b}" for a, b in sorted(c["backend"].items())) \
+        or "gspmd"
+    return (f"steps: {c['steps']}  backend: {backend}  "
+            f"collectives: {c['collectives']}  "
             f"rs: {c['rs_bytes'] / 1e6:.2f}MB  "
             f"ag: {c['ag_bytes'] / 1e6:.2f}MB  "
             f"ppermute-hops: {c['ppermute_hops']}  "
+            f"fused-dispatches: {c['fused_dispatches']}  "
             f"act/block: {c['activation_bytes'] / 1e6:.3f}MB")
 
 

@@ -7,6 +7,12 @@ results stream as they finish, and the script never kills a TPU claim.
 
   python tools_mfu_sweep.py resnet   # layout x dtype x batch sweep
   python tools_mfu_sweep.py bert     # seq/batch sweep with flash attn
+  python tools_mfu_sweep.py flash    # pallas flash-attn tile sweep (GPT)
+  python tools_mfu_sweep.py tp       # mp comm-schedule ladder, gpt3-1.3B
+  python tools_mfu_sweep.py tp67 [B] # same ladder on gpt3-6.7B (ROADMAP
+                                     # MFU rung; sweeps FLAGS_comm_backend
+                                     # gspmd/ring/fused alongside the tp
+                                     # flags)
 """
 from __future__ import annotations
 
@@ -165,9 +171,11 @@ def gpt_flash_tiles(model_name="gpt3-1.3B", batch=8, seq=2048, steps=8):
 
 def gpt_tp_schedules(model_name="gpt3-1.3B", batch=8, seq=2048, steps=8,
                      mp=None):
-    """Sweep the tensor-parallel schedule flags (FLAGS_sequence_parallel /
-    FLAGS_mp_overlap) on a multi-chip mp mesh — the GSPMD-vs-explicit
-    ladder of tools_tp_smoke.py at real-chip scale, reported as MFU."""
+    """Sweep the tensor-parallel schedule (FLAGS_sequence_parallel /
+    FLAGS_mp_overlap / FLAGS_comm_backend) on a multi-chip mp mesh — the
+    GSPMD-vs-explicit-vs-fused ladder of tools_tp_smoke.py at real-chip
+    scale, reported as MFU. `tp67` runs it on the gpt3-6.7B config (the
+    ROADMAP MFU rung: target >=45% at 6.7B)."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -180,11 +188,16 @@ def gpt_tp_schedules(model_name="gpt3-1.3B", batch=8, seq=2048, steps=8,
     ladder = (("gspmd", {}),
               ("seqpar", {"FLAGS_sequence_parallel": True}),
               ("seqpar+overlap", {"FLAGS_sequence_parallel": True,
-                                  "FLAGS_mp_overlap": True}))
+                                  "FLAGS_mp_overlap": True}),
+              ("ring-backend", {"FLAGS_comm_backend": "mp=ring"}),
+              ("fused-backend", {"FLAGS_comm_backend": "mp=fused"}),
+              ("fused-mp+ring-dp", {"FLAGS_comm_backend":
+                                    "mp=fused,dp=ring"}))
     for name, flags in ladder:
         try:
             paddle.set_flags({"FLAGS_sequence_parallel": False,
-                              "FLAGS_mp_overlap": False})
+                              "FLAGS_mp_overlap": False,
+                              "FLAGS_comm_backend": ""})
             paddle.set_flags(flags)
             profiler.reset_mp_comm_counters()
             mesh = dist_env.create_hybrid_mesh(dp=-1, mp=mp)
@@ -226,6 +239,13 @@ def main():
         return
     if which == "tp":
         gpt_tp_schedules()
+        return
+    if which == "tp67":
+        # the ROADMAP 6.7B MFU rung: gspmd/ring/fused comm-backend ladder
+        # on the flagship config (batch trimmed for the per-chip memory of
+        # an mp-sharded 6.7B; bump with argv[2] on bigger slices)
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+        gpt_tp_schedules("gpt3-6.7B", batch=batch, seq=2048)
         return
     if which == "resnet":
         # big batches first: ~10-15 ms/step of the 62 ms bs128 step is RPC

@@ -4,8 +4,17 @@ mesh (mp-dominant), one line per schedule rung.
 
 Ladder: GSPMD baseline (two blocking all-reduces per block, replicated
 activations) vs sequence parallelism (RS+AG, 1/mp activations between
-blocks) vs sequence parallelism + ring overlap (mp-1 ppermute hops per
-collective, chunk GEMMs issued on arrival) — distributed/tp_overlap.py.
+blocks) vs the ring backend (mp-1 ppermute hops per collective, chunk
+GEMMs issued on arrival) vs the fused backend (Pallas GEMM+collective
+kernels: in-kernel remote DMA, no HBM gather buffer, zero XLA-level
+ppermute) — distributed/tp_overlap.py + ops/pallas_kernels/
+fused_collectives.py, selected via FLAGS_comm_backend.
+
+NOTE the fused rung needs a single-named-axis mesh on CPU (interpret-mode
+remote DMA); with --dp 1 (the default) the script builds one, so the whole
+gspmd/ring/fused ladder runs. On CPU the fused rung's kernels execute in
+interpret mode — its ms/step is a correctness rung there, not a perf
+number; real-TPU timing comes from tools_mfu_sweep.py tp.
 
   python tools_tp_smoke.py [--iters N] [--warmup W] [--layers L] \
       [--hidden H] [--heads NH] [--batch B] [--seq S] [--mp MP] [--dp DP]
@@ -43,6 +52,7 @@ LADDER = [
     ("seq-parallel", {"FLAGS_sequence_parallel": True}),
     ("seq-parallel+overlap", {"FLAGS_sequence_parallel": True,
                               "FLAGS_mp_overlap": True}),
+    ("fused-kernels", {"FLAGS_comm_backend": "mp=fused"}),
 ]
 
 
@@ -55,10 +65,16 @@ def run_rung(name, flags, args):
     from paddle_tpu.models.gpt_hybrid import HybridTrainStep
 
     paddle.set_flags({"FLAGS_sequence_parallel": False,
-                      "FLAGS_mp_overlap": False})
+                      "FLAGS_mp_overlap": False,
+                      "FLAGS_comm_backend": ""})
     paddle.set_flags(flags)
     profiler.reset_mp_comm_counters()
-    mesh = dist_env.create_hybrid_mesh(dp=args.dp, mp=args.mp)
+    if args.dp == 1:
+        # single-named-axis mesh: what the fused rung's interpret-mode
+        # kernels need on CPU (and harmless for the other rungs)
+        mesh = dist_env.create_single_axis_mesh("mp", args.mp)
+    else:
+        mesh = dist_env.create_hybrid_mesh(dp=args.dp, mp=args.mp)
     cfg = GPTConfig(vocab_size=512, hidden_size=args.hidden,
                     num_layers=args.layers, num_heads=args.heads,
                     max_seq_len=args.seq, compute_dtype="float32",
@@ -83,15 +99,19 @@ def run_rung(name, flags, args):
         per = lambda k: c[k] / c["steps"]  # noqa: E731
         wire = per("rs_bytes") + per("ag_bytes")
         coll, hops = per("collectives"), per("ppermute_hops")
+        fused = per("fused_dispatches")
         act = c["activation_bytes"]
+        backend = c["backend"].get("mp", "gspmd")
     else:  # GSPMD baseline: static ledger of the partitioner's schedule
         base = tp.gspmd_baseline_record(cfg, args.mp, args.batch, args.seq)
         wire = sum(base.bytes_by_kind.values())
-        coll, hops = base.collectives, 0
+        coll, hops, fused = base.collectives, 0, 0
         act = base.activation_bytes
-    print(f"TP_SMOKE {name}: {dt * 1e3:.1f}ms/step  "
+        backend = "gspmd"
+    print(f"TP_SMOKE {name}: {dt * 1e3:.1f}ms/step  backend {backend}  "
           f"mp-wire {wire / 1e6:.2f}MB  collectives {coll:.0f}  "
-          f"hops {hops:.0f}  act-between-blocks {act / 1e6:.3f}MB  "
+          f"hops {hops:.0f}  fused-dispatches {fused:.0f}  "
+          f"act-between-blocks {act / 1e6:.3f}MB  "
           f"loss {float(np.asarray(jax.device_get(loss))):.4f}",
           flush=True)
     dist_env.set_mesh(None)
