@@ -41,24 +41,21 @@ def _log(msg):
     sys.stderr.flush()
 
 
+# FLOP/MFU estimators live in paddle_tpu/observability/flops.py — the
+# SINGLE source shared with tools_mfu_sweep.py and the live step
+# telemetry, so the bench trajectory and in-run MFU can never diverge.
+# Delegated lazily: the parent process must stay import-light (importing
+# paddle_tpu pulls jax), and only the children call these.
+
 def peak_flops_bf16(device_kind: str) -> float:
-    dk = device_kind.lower()
-    table = {
-        "v6": 918e12, "v5p": 459e12, "v5 lite": 197e12, "v5e": 197e12,
-        "v4": 275e12, "v3": 123e12, "v2": 45e12,
-    }
-    for k, v in table.items():
-        if k in dk:
-            return v
-    return 197e12  # conservative default
+    from paddle_tpu.observability.flops import peak_flops_bf16 as f
+    return f(device_kind)
 
 
 def model_flops_per_token(cfg, seq_len):
     """6N matmul + attention term (per training token, fwd+bwd)."""
-    H, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
-    n_params = 12 * L * H * H + V * H * 2 + cfg.max_seq_len * H
-    attn = 12 * L * H * seq_len  # 2*2*S*H per layer fwd, x3 with bwd
-    return 6 * n_params + attn, n_params
+    from paddle_tpu.observability.flops import model_flops_per_token as f
+    return f(cfg, seq_len)
 
 
 # --------------------------------------------------------------------------

@@ -55,6 +55,7 @@ from . import metric  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import observability  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401

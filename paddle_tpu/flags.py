@@ -128,6 +128,39 @@ _FLAGS = {
     # (T3 / fused computation-collective style). Requires
     # FLAGS_sequence_parallel; default OFF.
     "FLAGS_mp_overlap": False,
+    # -- unified telemetry (paddle_tpu/observability) ------------------------
+    # Prometheus /metrics endpoint port (stdlib http.server daemon thread
+    # over the registry snapshot — observability/prometheus.py). 0 = OFF
+    # (the default): nothing binds, nothing is scraped. Set it non-zero
+    # BEFORE constructing a serving.Engine or a TrainStep — both bring
+    # the endpoint up on construction — or call
+    # observability.start_metrics_server(port) directly.
+    "FLAGS_metrics_port": 0,
+    # Per-request span tracing in the serving engine: every Request
+    # records queue-wait, each prefill chunk, decode steps, CoW/prefix
+    # events and self-healing hops, survivable through engine snapshots,
+    # exportable as Perfetto JSON / JSONL (observability/tracing.py).
+    # Host-side only — executables, traced operands and trace counters are
+    # untouched either way. Default OFF: untraced requests pay one
+    # attribute check.
+    "FLAGS_serving_trace": False,
+    # Ring-buffer bound on retained finished-request traces.
+    "FLAGS_trace_buffer": 4096,
+    # Live training-step telemetry (observability/step_telemetry.py):
+    # sampled per-step records with dispatch/host-sync wall split,
+    # achieved MFU from the static FLOP estimator, wire bytes from the
+    # static comm schedules, and device-memory watermarks. Default OFF
+    # (one dict lookup per step).
+    "FLAGS_step_telemetry": False,
+    # Sample every Nth step when step telemetry is on. Sampling blocks on
+    # that step's result; the recorded wall time averages over the window
+    # since the previous sample, so the number stays honest while
+    # unsampled steps keep their async dispatch overlap.
+    "FLAGS_step_telemetry_every": 8,
+    # EWMA regression sentinel: log a warning when a sampled step's wall
+    # time drifts more than this percentage above the rolling baseline.
+    # 0 disables the sentinel.
+    "FLAGS_step_time_drift_pct": 25.0,
     # -- per-axis communication-schedule backend ----------------------------
     # Pluggable collective decomposition per mesh axis, e.g. "mp=fused" or
     # "mp=fused,dp=ring" (distributed/comm_backend.py). Backends:

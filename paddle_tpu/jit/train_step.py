@@ -131,6 +131,16 @@ class TrainStep:
         self._attached_loader = None
         self._attached_scaler = None
         self._on_rollback = None
+        # live step telemetry (observability/step_telemetry.py;
+        # FLAGS_step_telemetry): sampled host-side records — dispatch/sync
+        # wall split, memory watermark, wire bytes from the static
+        # grad-comm record, and MFU once flops_per_step is set (e.g. via
+        # observability.train_step_flops). Off by default: one dict
+        # lookup per step, never a traced operand or a retrace.
+        from ..observability.step_telemetry import StepSampler
+        self._tel = StepSampler("jit.TrainStep")
+        self.flops_per_step = None
+        self.tokens_per_step = None
 
     # -- sharding helpers ----------------------------------------------------
     def _sharding_for(self, spec):
@@ -819,6 +829,7 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         guard = self._anomaly is not None
         ok = None
+        t_tel = self._tel.begin(self._step)
         if self.accumulate_steps > 1:
             if isinstance(self._jitted, dict):
                 # grad_comm pair: the boundary is host-deterministic, so the
@@ -851,6 +862,15 @@ class TrainStep:
         if rec is not None:
             from ..distributed import grad_comm as _gc
             _gc.record_step(rec)
+        if t_tel is not None:
+            wire = None
+            if rec is not None:
+                wire = int(sum(getattr(rec, "reduce_bytes_by_dtype",
+                                       {}).values())
+                           + getattr(rec, "gather_bytes", 0))
+            self._tel.end(t_tel, self._step, loss,
+                          tokens=self.tokens_per_step,
+                          flops=self.flops_per_step, wire_bytes=wire)
         if offload_out:
             self._opt_state = self._move_opt(self._opt_state,
                                              self._opt_host_shardings())

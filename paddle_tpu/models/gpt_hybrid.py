@@ -275,6 +275,11 @@ class HybridTrainStep:
                                             self._opt_host_shardings())
         self._jitted = None
         self._step_count = 0
+        # live step telemetry (FLAGS_step_telemetry): flops/tokens derive
+        # from the config and batch shape at call time — live MFU uses the
+        # SAME estimator as bench.py (observability/flops.py)
+        from ..observability.step_telemetry import StepSampler
+        self._tel = StepSampler("HybridTrainStep")
 
     # -- host offload helpers (mirror jit/train_step.py) ---------------------
     def _opt_dev_shardings(self):
@@ -439,8 +444,17 @@ class HybridTrainStep:
         if offload_out:  # backend without in-jit memory transfers (CPU)
             self.opt_state = self._move_opt(self.opt_state,
                                             self._opt_dev_shardings())
+        t_tel = self._tel.begin(self._step_count)
         loss, flat_params, self.opt_state = self._jitted(
             flat_params, self.opt_state, ids, lr)
+        if t_tel is not None:
+            from ..observability.flops import train_step_flops
+            B, S = ids.shape
+            flops, _ = train_step_flops(self.config, B, S)
+            rec = recs[shape_key]
+            wire = None if rec is None else int(rec.rs_bytes + rec.ag_bytes)
+            self._tel.end(t_tel, self._step_count, loss, tokens=B * S,
+                          flops=flops, wire_bytes=wire)
         if offload_out:
             self.opt_state = self._move_opt(self.opt_state,
                                             self._opt_host_shardings())

@@ -1,0 +1,76 @@
+"""Unified telemetry for paddle_tpu (ROADMAP: the live instrument layer).
+
+One registry, three signal kinds, two exports:
+
+* **registry** (registry.py) — typed counters/gauges/histograms with
+  namespaced keys and snapshot/delta semantics; the six pre-existing
+  counter families (dispatch / comm / mp_comm / fault / serving /
+  recovery) register as lazy collectors, and ``profiler.*_counters()``
+  are thin views over them.
+* **span tracing** (tracing.py) — per-request serving spans
+  (queue → prefill chunks → decode → deliver, plus CoW/prefix and
+  self-healing hops), survivable through engine snapshots, exported as
+  Perfetto/Chrome-trace JSON or a JSONL sink. ``FLAGS_serving_trace``.
+* **step telemetry** (step_telemetry.py) — sampled live training-step
+  records (dispatch/sync wall split, achieved MFU from the static FLOP
+  estimator in flops.py — the same one bench.py uses — wire bytes from
+  the static comm schedules, memory watermarks) with an EWMA step-time
+  regression sentinel. ``FLAGS_step_telemetry``.
+* **Prometheus** (prometheus.py) — pull-based /metrics text exposition
+  over the registry snapshot (``FLAGS_metrics_port``, default off).
+
+Everything is host-side: no traced operands, no retraces, and when the
+flags are off the cost is one dict lookup per step / per request.
+"""
+from __future__ import annotations
+
+from .registry import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry
+from .families import register_default_families, register_supervisor
+from .tracing import (
+    JsonlTraceSink, RequestTrace, add_sink, chrome_events, export_perfetto,
+    remove_sink, traces,
+)
+from .step_telemetry import (
+    StepSampler, default_peak_flops, reset_step_telemetry, step_counters,
+    step_summary,
+)
+from .flops import (
+    dense_flops_per_token, mfu, model_flops_per_token, peak_flops_bf16,
+    train_step_flops,
+)
+from .prometheus import (
+    MetricsServer, render, start_from_flags, start_metrics_server,
+    stop_metrics_server,
+)
+
+register_default_families()
+
+
+def collect(family):
+    """Current dict of one counter family (the profiler thin-view hook)."""
+    return REGISTRY.collect(family)
+
+
+def snapshot():
+    """Flat {"family.metric": value} snapshot of everything."""
+    return REGISTRY.snapshot()
+
+
+def delta(prev, cur=None):
+    """Numeric difference between two snapshots."""
+    return REGISTRY.delta(prev, cur)
+
+
+__all__ = [
+    "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "register_default_families", "register_supervisor",
+    "RequestTrace", "JsonlTraceSink", "add_sink", "remove_sink",
+    "chrome_events", "export_perfetto", "traces",
+    "StepSampler", "default_peak_flops", "reset_step_telemetry",
+    "step_counters", "step_summary",
+    "model_flops_per_token", "dense_flops_per_token", "train_step_flops",
+    "peak_flops_bf16", "mfu",
+    "MetricsServer", "render", "start_metrics_server",
+    "stop_metrics_server", "start_from_flags",
+    "collect", "snapshot", "delta",
+]

@@ -1,0 +1,91 @@
+"""The framework's counter families, registered into the metrics registry.
+
+Each collector returns EXACTLY what the pre-registry
+``profiler.*_counters()`` returned — profiler keeps those names as thin
+views over ``REGISTRY.collect(family)``, so existing callers are
+bitwise-compatible while every family is now reachable from one snapshot
+(and therefore from the Prometheus endpoint). Target modules are imported
+lazily inside each collector: registering costs nothing, and the hot
+paths keep their module-local zero-cost bumping.
+"""
+from __future__ import annotations
+
+from .registry import REGISTRY
+
+
+def _dispatch():
+    from ..dispatch import cache_stats, cache_size
+    stats = cache_stats()
+    out = stats.as_dict()
+    out["hit_rate"] = stats.hit_rate()
+    out["cache_entries"] = cache_size()
+    return out
+
+
+def _comm():
+    from ..distributed import grad_comm
+    return grad_comm.comm_counters()
+
+
+def _mp_comm():
+    from ..distributed import tp_overlap
+    return tp_overlap.mp_counters()
+
+
+def _fault():
+    from ..jit import train_step as _ts
+    from ..incubate import checkpoint as _ck
+    from ..utils import fault_injection as _fi
+    return {"anomaly": _ts.anomaly_counters(),
+            "checkpoint": _ck.ckpt_counters(),
+            "injected": _fi.stats()}
+
+
+def _serving():
+    from ..serving import metrics
+    return metrics.serving_counters()
+
+
+_RECOVERY_KEYS = ("snapshots", "snapshot_restores", "preempt_drains",
+                  "requeued", "replayed", "respawns", "stale_failovers",
+                  "rolling_restarts", "dropped")
+
+
+def _recovery():
+    c = _serving()
+    return {k: c[k] for k in _RECOVERY_KEYS}
+
+
+def _step():
+    from . import step_telemetry
+    return step_telemetry.step_counters()
+
+
+def register_default_families():
+    """Idempotent: (re-)register the framework families. Called at
+    observability import; safe to call again after a registry reset."""
+    REGISTRY.register_family("dispatch", _dispatch)
+    REGISTRY.register_family("comm", _comm)
+    REGISTRY.register_family("mp_comm", _mp_comm)
+    REGISTRY.register_family("fault", _fault)
+    REGISTRY.register_family("serving", _serving)
+    REGISTRY.register_family("recovery", _recovery)
+    REGISTRY.register_family("step", _step)
+
+
+def register_supervisor(sup):
+    """Expose a ServingSupervisor's live per-replica gauges as the
+    "supervisor" family. Weakly referenced: the family reports {} once the
+    supervisor is garbage-collected (a later supervisor simply replaces
+    the registration)."""
+    import weakref
+    ref = weakref.ref(sup)
+
+    def collect():
+        s = ref()
+        if s is None:
+            return {}
+        return s.telemetry()
+
+    REGISTRY.register_family("supervisor", collect)
+    return collect

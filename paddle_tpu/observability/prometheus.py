@@ -1,0 +1,157 @@
+"""Prometheus text exposition over the metrics registry.
+
+Pull-based: ``start_metrics_server(port)`` runs a stdlib ``http.server``
+in a daemon thread serving ``GET /metrics`` with the registry snapshot in
+text exposition format (version 0.0.4). Default OFF — the server starts
+only when asked, or via ``start_from_flags()`` when ``FLAGS_metrics_port``
+is non-zero. Rendering walks ``REGISTRY.snapshot()``: numeric entries
+become ``paddle_tpu_<family>_<metric>`` gauges, non-numeric entries
+(backend labels, finish reasons) are skipped. ``port=0`` binds an
+ephemeral port (tests; read it back from ``server.port``).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(key):
+    name = "paddle_tpu_" + _NAME_RE.sub("_", str(key))
+    if name[len("paddle_tpu_")].isdigit():
+        name = "paddle_tpu__" + name[len("paddle_tpu_"):]
+    return name
+
+
+def render(snapshot=None):
+    """Registry snapshot -> Prometheus text exposition (one gauge per
+    numeric entry; inf/nan rendered per the exposition spec)."""
+    if snapshot is None:
+        from .registry import REGISTRY
+        snapshot = REGISTRY.snapshot()
+    lines = []
+    for key in sorted(snapshot):
+        v = snapshot[key]
+        if isinstance(v, bool):
+            v = int(v)
+        if not isinstance(v, (int, float)):
+            continue
+        name = _metric_name(key)
+        lines.append(f"# TYPE {name} gauge")
+        if v != v:                       # NaN
+            val = "NaN"
+        elif v in (float("inf"), float("-inf")):
+            val = "+Inf" if v > 0 else "-Inf"
+        else:
+            val = repr(float(v)) if isinstance(v, float) else str(v)
+        lines.append(f"{name} {val}")
+    return "\n".join(lines) + "\n"
+
+
+def parse(text):
+    """Parse a text exposition page back to {name: float} — the smoke
+    tool's "the page actually parses" gate (comment/TYPE lines skipped,
+    malformed lines raise)."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2 or not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*",
+                                               parts[0]):
+            raise ValueError(f"malformed exposition line: {line!r}")
+        out[parts[0]] = float(parts[1])
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # a half-open scraper connection must neither wedge the endpoint
+    # (ThreadingHTTPServer below serves concurrently) nor leak its
+    # handler thread forever (read timeout)
+    timeout = 10
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path.split("?")[0] not in ("/metrics", "/"):
+            self.send_error(404)
+            return
+        try:
+            body = render().encode()
+        except Exception as e:  # noqa: BLE001 — scrape must not kill server
+            self.send_error(500, repr(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # silence per-scrape stderr noise
+        pass
+
+
+class MetricsServer:
+    """Daemon-thread HTTP server exposing /metrics. ``port=0`` binds an
+    ephemeral port (read ``server.port``)."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="paddle-tpu-metrics",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+_server = None
+_server_lock = threading.Lock()
+
+
+def start_metrics_server(port=0, host="127.0.0.1"):
+    """Start (or return the already-running) metrics endpoint."""
+    global _server
+    with _server_lock:
+        if _server is None:
+            _server = MetricsServer(port, host)
+        return _server
+
+
+def stop_metrics_server():
+    global _server
+    with _server_lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.stop()
+
+
+def start_from_flags():
+    """Honor FLAGS_metrics_port: start the endpoint when non-zero, else
+    return None (the default-off contract). Called from Engine/TrainStep
+    construction, so a bind failure (port taken by a sibling process)
+    degrades to a warning — telemetry must never kill the job."""
+    from ..flags import _FLAGS
+    port = int(_FLAGS.get("FLAGS_metrics_port", 0) or 0)
+    if port <= 0:
+        return None
+    try:
+        return start_metrics_server(port)
+    except OSError as e:
+        import warnings
+        warnings.warn(f"FLAGS_metrics_port={port}: metrics endpoint not "
+                      f"started ({e}); set a free port or use "
+                      f"start_metrics_server(0) for an ephemeral one")
+        return None

@@ -1,0 +1,236 @@
+"""Typed metrics registry — the single aggregation point for every ledger
+in the framework.
+
+Two kinds of members:
+
+* **typed metrics** — `Counter` / `Gauge` / `Histogram` objects created
+  through the registry with namespaced dotted keys (``serving.trace.spans``).
+  New telemetry (span tracing, step telemetry, supervisor gauges) uses
+  these directly.
+* **families** — the six pre-existing counter ledgers (dispatch, comm,
+  mp_comm, fault, serving, recovery) keep their zero-cost module-local
+  bumping on the hot paths and REGISTER here as lazy collectors; a
+  registry snapshot pulls them on demand. ``profiler.*_counters()`` are
+  thin views over these collectors (bitwise-compatible with the
+  pre-registry callers — the collector IS the old implementation).
+
+Snapshot/delta semantics: ``snapshot()`` returns one flat
+``{"family.metric": value}`` dict over every family and typed metric
+(nested dicts flattened with dotted keys); ``delta(prev)`` subtracts two
+snapshots' numeric entries — the per-window view a poll-based exporter
+needs. The Prometheus exposition (observability/prometheus.py) renders a
+snapshot; non-numeric entries (backend labels) are kept in the snapshot
+but skipped by the exposition.
+
+Thread-safety: one registry lock guards membership and typed-metric
+mutation; family collectors take their own module locks (the same
+discipline as ``profiler._events_lock``), so a snapshot taken while other
+threads bump is internally consistent per family.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import numpy as np
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is the only mutator."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name, lock):
+        self.name = name
+        self._v = 0
+        self._lock = lock
+
+    def inc(self, n=1):
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._v
+
+    def _collect(self, out):
+        out[self.name] = self.value
+
+
+class Gauge:
+    """Point-in-time value: ``set()`` a number, or back it with ``fn``
+    (evaluated lazily at snapshot time — live queue depths, pool sizes)."""
+
+    __slots__ = ("name", "_v", "_fn", "_lock")
+
+    def __init__(self, name, lock, fn=None):
+        self.name = name
+        self._v = 0.0
+        self._fn = fn
+        self._lock = lock
+
+    def set(self, v):
+        with self._lock:
+            self._v = v
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            try:
+                return self._fn()
+            except Exception:  # noqa: BLE001 — a dead gauge must not
+                return None    # poison the whole snapshot
+        with self._lock:
+            return self._v
+
+    def _collect(self, out):
+        out[self.name] = self.value
+
+
+class Histogram:
+    """Windowed distribution: a ring buffer of the LAST ``window``
+    samples (late regressions must surface — same rationale as the
+    serving TTFT ring), plus cumulative count/sum."""
+
+    __slots__ = ("name", "_samples", "_count", "_sum", "_lock")
+
+    def __init__(self, name, lock, window=65536):
+        self.name = name
+        self._samples = deque(maxlen=int(window))
+        self._count = 0
+        self._sum = 0.0
+        self._lock = lock
+
+    def observe(self, v):
+        with self._lock:
+            self._samples.append(float(v))
+            self._count += 1
+            self._sum += float(v)
+
+    def percentile(self, p):
+        with self._lock:
+            s = list(self._samples)
+        return float(np.percentile(s, p)) if s else None
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    def _collect(self, out):
+        with self._lock:
+            s = list(self._samples)
+            out[f"{self.name}.count"] = self._count
+            out[f"{self.name}.sum"] = self._sum
+        if s:
+            out[f"{self.name}.p50"] = float(np.percentile(s, 50))
+            out[f"{self.name}.p99"] = float(np.percentile(s, 99))
+
+
+def _flatten(prefix, obj, out):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+    else:
+        out[prefix] = obj
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics = {}       # name -> Counter|Gauge|Histogram
+        self._families = {}      # name -> zero-arg collector -> dict
+
+    # -- typed metrics -------------------------------------------------------
+    def _get_or_make(self, name, cls, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, self._lock, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name):
+        return self._get_or_make(name, Counter)
+
+    def gauge(self, name, fn=None):
+        g = self._get_or_make(name, Gauge)
+        if fn is not None:
+            g._fn = fn
+        return g
+
+    def histogram(self, name, window=65536):
+        return self._get_or_make(name, Histogram, window=window)
+
+    # -- families ------------------------------------------------------------
+    def register_family(self, name, collector):
+        """Register (or replace) a lazy counter family: ``collector`` is a
+        zero-arg callable returning the family's current dict."""
+        with self._lock:
+            self._families[name] = collector
+
+    def unregister_family(self, name):
+        with self._lock:
+            self._families.pop(name, None)
+
+    def families(self):
+        with self._lock:
+            return tuple(sorted(self._families))
+
+    def collect(self, family):
+        """The family's current dict, exactly as its owning module reports
+        it (the thin-view contract of ``profiler.*_counters()``)."""
+        with self._lock:
+            collector = self._families[family]
+        return collector()
+
+    # -- snapshot / delta ----------------------------------------------------
+    def snapshot(self):
+        """One flat {"family.metric": value} dict over every family and
+        typed metric. Nested family dicts flatten with dotted keys."""
+        out = {}
+        with self._lock:
+            fams = list(self._families.items())
+            metrics = list(self._metrics.values())
+        for name, collector in fams:
+            try:
+                _flatten(name, collector(), out)
+            except Exception as e:  # noqa: BLE001 — one broken family
+                out[f"{name}.collect_error"] = repr(e)  # must not hide rest
+        for m in metrics:
+            m._collect(out)
+        return out
+
+    def delta(self, prev, cur=None):
+        """Numeric difference ``cur - prev`` between two snapshots (``cur``
+        defaults to a fresh one). Keys missing from ``prev`` diff against
+        0; non-numeric entries are skipped."""
+        if cur is None:
+            cur = self.snapshot()
+        out = {}
+        for k, v in cur.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            p = prev.get(k, 0)
+            if isinstance(p, bool) or not isinstance(p, (int, float)):
+                p = 0
+            out[k] = v - p
+        return out
+
+    def reset_typed(self):
+        """Drop every typed metric (test hygiene). Families are owned by
+        their modules and keep their own reset entry points."""
+        with self._lock:
+            self._metrics.clear()
+
+
+REGISTRY = MetricsRegistry()

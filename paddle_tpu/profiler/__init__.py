@@ -54,15 +54,23 @@ def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
 
 _host_events = []
 _events_lock = threading.Lock()
+_nesting = threading.local()  # per-thread active RecordEvent depth
 
 
 class RecordEvent:
     """Context/annotation for a named host-side region; also forwards to
-    jax.profiler.TraceAnnotation so it appears in the xplane trace."""
+    jax.profiler.TraceAnnotation so it appears in the xplane trace.
+
+    Instances are RE-ENTERABLE: each ``begin()`` opens a fresh
+    TraceAnnotation onto a per-instance stack (the seed silently reused
+    one annotation, so ``begin(); begin()`` corrupted both regions), and
+    nested regions — same instance or different — export their per-thread
+    nesting depth in the chrome trace (``args.depth``). ``end()`` without
+    a matching ``begin()`` raises instead of emitting garbage."""
 
     def __init__(self, name, event_type=None):
         self.name = name
-        self._jax_ann = jax.profiler.TraceAnnotation(name)
+        self._stack = []        # (t0_ns, TraceAnnotation, depth)
 
     def __enter__(self):
         self.begin()
@@ -72,17 +80,32 @@ class RecordEvent:
         self.end()
 
     def begin(self):
-        self._t0 = time.perf_counter_ns()
-        self._jax_ann.__enter__()
+        ann = jax.profiler.TraceAnnotation(self.name)
+        ann.__enter__()
+        depth = getattr(_nesting, "depth", 0)
+        _nesting.depth = depth + 1
+        self._stack.append((time.perf_counter_ns(), ann, depth,
+                            threading.get_ident()))
 
     def end(self):
-        self._jax_ann.__exit__(None, None, None)
+        if not self._stack:
+            raise RuntimeError(
+                f"RecordEvent({self.name!r}).end() without a matching "
+                f"begin()")
+        t0, ann, depth, tid = self._stack.pop()
+        if threading.get_ident() == tid:
+            # only the beginning thread's nesting counter moves: an end()
+            # from another thread must not decrement that thread's depth
+            # (and the beginner's counter re-syncs at its next begin/end)
+            _nesting.depth = max(0, getattr(_nesting, "depth", 1) - 1)
+        ann.__exit__(None, None, None)
         with _events_lock:
             _host_events.append(
                 {"name": self.name, "ph": "X", "pid": os.getpid(),
                  "tid": threading.get_ident(),
-                 "ts": self._t0 / 1000.0,
-                 "dur": (time.perf_counter_ns() - self._t0) / 1000.0})
+                 "ts": t0 / 1000.0,
+                 "dur": (time.perf_counter_ns() - t0) / 1000.0,
+                 "args": {"depth": depth}})
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
@@ -189,13 +212,11 @@ class Profiler:
 
 def dispatch_counters():
     """Snapshot of the eager dispatch-cache counters as a dict, plus the
-    derived steady-state `hit_rate` and current `cache_entries`."""
-    from ..dispatch import cache_stats, cache_size
-    stats = cache_stats()
-    out = stats.as_dict()
-    out["hit_rate"] = stats.hit_rate()
-    out["cache_entries"] = cache_size()
-    return out
+    derived steady-state `hit_rate` and current `cache_entries`. (Thin
+    view over the observability registry's "dispatch" family — same dict,
+    also reachable via ``observability.snapshot()`` / Prometheus.)"""
+    from ..observability import collect
+    return collect("dispatch")
 
 
 def reset_dispatch_counters():
@@ -225,9 +246,10 @@ def comm_counters():
     dtype), gather_bytes, collectives, buckets, bucket_fill, steps — plus
     the per-axis `backend` label ({'dp': 'ring'|'fused'}) and
     `fused_dispatches` (Pallas kernel launches of the fused backend), so
-    counter gates can assert which backend actually ran."""
-    from ..distributed import grad_comm
-    return grad_comm.comm_counters()
+    counter gates can assert which backend actually ran. (Thin view over
+    the registry's "comm" family.)"""
+    from ..observability import collect
+    return collect("comm")
 
 
 def reset_comm_counters():
@@ -265,9 +287,9 @@ def mp_comm_counters():
     the per-axis `backend` label ({'mp': 'rsag'|'ring'|'fused'}) and
     `fused_dispatches` (Pallas GEMM+collective kernel launches per the
     static forward schedule), so counter gates can assert which backend
-    actually ran."""
-    from ..distributed import tp_overlap
-    return tp_overlap.mp_counters()
+    actually ran. (Thin view over the registry's "mp_comm" family.)"""
+    from ..observability import collect
+    return collect("mp_comm")
 
 
 def reset_mp_comm_counters():
@@ -304,14 +326,10 @@ def fault_counters():
     """Snapshot of the fault-tolerance counters: anomaly guard (steps,
     host_syncs, bad_steps, skipped_updates, rollbacks), checkpoint manager
     (saves, save_retries, quarantined, restore_fallbacks, preempt_saves)
-    and injected-fault stats."""
-    from ..jit import train_step as _ts
-    from ..incubate import checkpoint as _ck
-    from ..utils import fault_injection as _fi
-    out = {"anomaly": _ts.anomaly_counters(),
-           "checkpoint": _ck.ckpt_counters(),
-           "injected": _fi.stats()}
-    return out
+    and injected-fault stats. (Thin view over the registry's "fault"
+    family.)"""
+    from ..observability import collect
+    return collect("fault")
 
 
 def reset_fault_counters():
@@ -357,9 +375,10 @@ def serving_counters():
     traces, tokens_out, ttft_p50/p99, token_latency_p50, tokens_per_s,
     occupancy, queue depth — plus the paged-KV ledger (page_occupancy,
     prefix_hit_rate, prefix_tokens_reused, chunk_steps, cow_copies,
-    prefill_waste_mean)."""
-    from ..serving import metrics
-    return metrics.serving_counters()
+    prefill_waste_mean). (Thin view over the registry's "serving"
+    family.)"""
+    from ..observability import collect
+    return collect("serving")
 
 
 def reset_serving_counters():
@@ -377,12 +396,10 @@ def recovery_counters():
     """Self-healing subset of the serving ledger: engine snapshots taken /
     restored, preemption drains, requests requeued / replayed, replica
     respawns, stale-heartbeat failovers, rolling restarts, and dropped
-    (the invariant: 0)."""
-    c = serving_counters()
-    return {k: c[k] for k in
-            ("snapshots", "snapshot_restores", "preempt_drains", "requeued",
-             "replayed", "respawns", "stale_failovers", "rolling_restarts",
-             "dropped")}
+    (the invariant: 0). (Thin view over the registry's "recovery"
+    family.)"""
+    from ..observability import collect
+    return collect("recovery")
 
 
 def benchmark():

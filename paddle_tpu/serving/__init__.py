@@ -17,6 +17,11 @@ and requeues in-flight requests instead of dropping them; and
 `ServingSupervisor` runs N replicas behind a least-loaded router with
 heartbeat failure detection, snapshot respawn and exact request replay
 (zero requests dropped across replica death / rolling restarts).
+
+Telemetry: with ``FLAGS_serving_trace`` on, every Request carries a span
+trace (queue → prefill chunks → decode → deliver, plus CoW/prefix and
+self-healing hops) that survives engine snapshots and exports as
+Perfetto JSON / JSONL — see ``paddle_tpu.observability``.
 """
 from .request import (  # noqa: F401
     Request, GenerationResult,

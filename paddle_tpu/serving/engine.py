@@ -60,6 +60,7 @@ import jax
 import jax.numpy as jnp
 
 from ..flags import get_flags
+from ..observability import tracing as obs_tracing
 from ..utils import fault_injection as _fi
 from ..models.generation import (
     _cfg_key, _cfg_view, _collect_params, _forward_cached,
@@ -213,7 +214,7 @@ class Engine:
                  num_slots=None, max_seq_len=None, prefill_buckets=None,
                  max_queue=None, top_k=None, kv_layout=None, page_size=None,
                  num_pages=None, prefill_chunk=None, prefix_cache=None,
-                 tag=None):
+                 tag=None, trace=None):
         if model is not None:
             params = _collect_params(model)
             config = model.config
@@ -227,6 +228,16 @@ class Engine:
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
 
         flags = get_flags()
+        # per-request span tracing (observability/tracing.py): host-side
+        # only — recording sites are gated on `req.trace is not None`, so
+        # disabled tracing costs one attribute check and the executables /
+        # trace counters are identical either way
+        self.trace_enabled = (bool(flags.get("FLAGS_serving_trace", False))
+                              if trace is None else bool(trace))
+        # FLAGS_metrics_port: bring the Prometheus endpoint up with the
+        # serving runtime (no-op at the default 0; idempotent otherwise)
+        from ..observability import prometheus as _prom
+        _prom.start_from_flags()
         self.kv_layout = (kv_layout or
                           flags.get("FLAGS_serving_kv_layout", "paged"))
         if self.kv_layout not in ("paged", "pooled"):
@@ -351,6 +362,8 @@ class Engine:
             # re-resolve (and re-ledger) an already-finished request
             raise ValueError(f"request {request.request_id} already "
                              f"{request.state}; requests are single-use")
+        if self.trace_enabled and request.trace is None:
+            request.trace = obs_tracing.RequestTrace(request.request_id)
         metrics.bump("submitted")
         plen = request.prompt_len
         if plen + request.max_new_tokens > self.max_seq_len:
@@ -481,8 +494,9 @@ class Engine:
         admitted, admit_expired = self.scheduler.admit(len(free), now,
                                                        fits=fits)
         for req in expired + admit_expired:
-            self._results[req.request_id] = req.result()
-            metrics.bump("expired")
+            # already _finish(EXPIRED)ed by the scheduler; _resolve stores
+            # the result, bumps the ledger and closes the trace
+            self._resolve(req, EXPIRED, count="expired")
         for req, b in zip(admitted, free):
             self._admit(req, b)
 
@@ -520,13 +534,16 @@ class Engine:
         nxt = np.asarray(nxt)
         # copy: device_get views are read-only and _admit writes rows
         self._keys = np.array(keys)
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         metrics.bump("decode_steps")
         metrics.add_time("decode_time_s", dt)
         metrics.observe_token_latency(dt, 1)
         for b, req in enumerate(self._slots):
             if req is None:
                 continue
+            if req.trace is not None:
+                req.trace.span("decode_step", t0, t1, pos=int(self._pos[b]))
             tok = int(nxt[b])
             req._emit(tok)
             metrics.bump("tokens_out")
@@ -543,10 +560,16 @@ class Engine:
         """Copy-on-write guard: a slot may only WRITE pages it exclusively
         owns — split any shared page in [start, end) to a fresh physical
         page before the dispatch that writes the range."""
+        copied = 0
         for src, dst in self.pool.make_writable(b, start, end):
             self._kc, self._vc = self._page_copy(
                 self._kc, self._vc, jnp.int32(src), jnp.int32(dst))
             metrics.bump("cow_copies")
+            copied += 1
+        if copied:
+            req = self._slots[b]
+            if req is not None and req.trace is not None:
+                req.trace.instant("cow_copy", pages=copied)
 
     def _iterate_paged(self):
         """One paged iteration (Sarathi-style interleave): the FCFS-oldest
@@ -607,6 +630,11 @@ class Engine:
         metrics.observe_token_latency(now - t_boundary, 1)
         for b in decoding:
             req = self._slots[b]
+            if req.trace is not None:
+                # the span covers the whole boundary (chunks + CoW + the
+                # fused dispatch): that IS this stream's inter-token gap
+                req.trace.span("decode_step", t_boundary, now,
+                               pos=int(self._pos[b]))
             self._pos[b] += 1
             self._emit_token(req, b, int(nxt[b]), first=False)
 
@@ -637,10 +665,14 @@ class Engine:
             jnp.asarray(self._temp[b:b + 1]),
             jnp.asarray(self._top_p[b:b + 1]),
             jnp.asarray(self._keys[b:b + 1]))
+        t1 = time.perf_counter()
         metrics.bump("paged_steps")
         metrics.bump("chunk_steps")
         metrics.bump("prefill_chunks")
-        metrics.add_time("prefill_time_s", time.perf_counter() - t0)
+        metrics.add_time("prefill_time_s", t1 - t0)
+        if req.trace is not None:
+            req.trace.span("prefill_chunk", t0, t1, offset=off, tokens=v,
+                           chunk=C)
         self._keys[b] = np.asarray(keys)[0]
         if last:
             self._chunk_off[b] = plen
@@ -663,6 +695,10 @@ class Engine:
         self._tok[b] = tok
         if first and fresh_first:
             metrics.observe_ttft(req.first_token_t - req.submit_t)
+            if req.trace is not None:
+                # the exact timestamp the TTFT sample uses — the exported
+                # trace reconciles with the ledger to the float
+                req.trace.instant("first_token", req.first_token_t)
         if req.stop_token_ids and tok in req.stop_token_ids:
             self._free_slot(b)
             self._resolve(req, STOP)
@@ -707,6 +743,9 @@ class Engine:
         if n_shared:
             metrics.bump("prefix_hits")
             metrics.bump("prefix_tokens_reused", chunk_start)
+            if req.trace is not None:
+                req.trace.instant("prefix_hit", tokens=chunk_start,
+                                  pages=n_shared)
         return True
 
     def _admit(self, req, b):
@@ -722,6 +761,7 @@ class Engine:
         step, interleaved with every other slot's decode."""
         chunk_start, shared, private, spare = req._page_plan
         del req._page_plan
+        self._trace_queue_span(req, b)
         self.pool.map_slot(b, list(shared) + list(private), spare)
         req.state = RUNNING
         req.slot = b
@@ -742,6 +782,7 @@ class Engine:
         """Prefill req's prompt into slot b (prompt padded to its bucket);
         the prefill emits the request's FIRST token (TTFT stops here)."""
         plen = req.prompt_len
+        self._trace_queue_span(req, b)
         bucket = self.scheduler.bucket_for(plen)
         metrics.observe_prefill_waste(bucket - plen)
         ids = np.zeros(bucket, np.int32)
@@ -755,9 +796,12 @@ class Engine:
             jnp.float32(req.temperature),
             jnp.float32(1.0 if req.top_p is None else req.top_p))
         tok = int(np.asarray(tok))
+        t1 = time.perf_counter()
         metrics.bump("prefill_calls")
-        metrics.add_time("prefill_time_s", time.perf_counter() - t0)
+        metrics.add_time("prefill_time_s", t1 - t0)
         metrics.bump("admitted")
+        if req.trace is not None:
+            req.trace.span("prefill", t0, t1, bucket=bucket, tokens=plen)
 
         req.state = RUNNING
         req.slot = b
@@ -766,6 +810,8 @@ class Engine:
         metrics.bump("tokens_out")
         if fresh_first:
             metrics.observe_ttft(req.first_token_t - req.submit_t)
+            if req.trace is not None:
+                req.trace.instant("first_token", req.first_token_t)
         if req.stop_token_ids and tok in req.stop_token_ids:
             self._resolve(req, STOP)
             return
@@ -808,6 +854,16 @@ class Engine:
         if self.kv_layout == "paged":
             self.pool.release_slot(b)
 
+    def _trace_queue_span(self, req, b):
+        """Admission closes the request's queue-wait span: from arrival
+        (``submit_t`` — the exact float the TTFT/latency ledger uses) or,
+        after a requeue/restore hop, from the last recorded span, to now."""
+        if req.trace is None:
+            return
+        tail = req.trace.tail()
+        t0 = req.submit_t if tail is None else max(tail, req.submit_t)
+        req.trace.span("queue", t0, time.perf_counter(), slot=b)
+
     def _resolve(self, req, reason, count="completed"):
         if req.state != FINISHED:
             req._finish(reason)
@@ -817,6 +873,12 @@ class Engine:
             metrics.bump(count)
         if reason in (STOP, LENGTH):
             metrics.bump(f"finished_{reason}")
+        if req.trace is not None and not getattr(req, "_trace_done", False):
+            # "deliver" lands at finish_t, the float the latency ledger
+            # records — span timeline and SLO numbers reconcile exactly
+            req._trace_done = True
+            req.trace.instant("deliver", req.finish_t, reason=reason)
+            obs_tracing.collect(req, engine_tag=self.tag)
 
     # -- self-healing: snapshot / restore / drain ----------------------------
     def attach_checkpoint(self, mgr, every=None):
@@ -974,6 +1036,12 @@ class Engine:
                 v = getattr(r, attr)
                 if v is not None:
                     setattr(r, attr, v + shift)
+            if r.trace is not None:
+                # spans ride the same clock re-anchoring as the request
+                # timestamps, then a restore hop marks the outage on the
+                # request's own timeline
+                r.trace.shift(shift)
+                r.trace.instant("restore", outage_s=outage)
         self._results = {
             d["request_id"]: GenerationResult(
                 request_id=d["request_id"], prompt=d["prompt"],
@@ -1051,6 +1119,11 @@ class Engine:
         so an undrained long-running engine grows without bound."""
         out, self._results = self._results, {}
         return out
+
+    def export_trace(self, path):
+        """Write every collected finished-request trace (process-wide ring,
+        this engine's included) as Perfetto-loadable Chrome-trace JSON."""
+        return obs_tracing.export_perfetto(path)
 
     def run(self, requests=None):
         """Submit ``requests`` (optional) and step until queue and slots are

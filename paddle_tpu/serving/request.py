@@ -63,6 +63,10 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
     finish_reason: str | None = field(default=None)
     callback_error: object = field(default=None)  # first on_token exception
     requeue_count: int = field(default=0)         # drain/replay round trips
+    # span trace context (observability.RequestTrace) — attached by the
+    # engine when FLAGS_serving_trace is on, None otherwise (untraced
+    # requests pay one attribute check per recording site)
+    trace: object = field(default=None)
 
     def __post_init__(self):
         self.prompt = np.asarray(
@@ -142,6 +146,8 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
         self.finish_t = None
         self.finish_reason = None
         self.requeue_count += 1
+        if self.trace is not None:
+            self.trace.instant("requeue", round=self.requeue_count)
 
     def replay_copy(self):
         """Fresh QUEUED copy for replaying on ANOTHER engine after its
@@ -158,6 +164,12 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
         r.submit_t = self.submit_t
         r.first_token_t = self.first_token_t
         r.requeue_count = self.requeue_count + 1
+        if self.trace is not None:
+            # the replay inherits the whole span history (queue wait and
+            # any tokens the dead owner already produced are part of THIS
+            # request's latency story) plus a failover hop marker
+            r.trace = self.trace.copy()
+            r.trace.instant("replay", round=r.requeue_count)
         return r
 
     # -- snapshot ------------------------------------------------------------
@@ -186,6 +198,7 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
             "finish_t": self.finish_t,
             "finish_reason": self.finish_reason,
             "requeue_count": int(self.requeue_count),
+            "trace": None if self.trace is None else self.trace.to_state(),
         }
 
     @classmethod
@@ -211,6 +224,9 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
         r.finish_t = state["finish_t"]
         r.finish_reason = state["finish_reason"]
         r.requeue_count = int(state.get("requeue_count", 0))
+        if state.get("trace") is not None:
+            from ..observability import RequestTrace
+            r.trace = RequestTrace.from_state(r.request_id, state["trace"])
         return r
 
     def result(self):

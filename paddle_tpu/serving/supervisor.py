@@ -31,8 +31,10 @@ bounded queue-depth spill.
 from __future__ import annotations
 
 import os
+import threading
 
 from ..flags import get_flags
+from ..observability import register_supervisor
 from ..incubate.checkpoint import CheckpointManager, Preempted
 from ..distributed.elastic import Heartbeat, HeartbeatMonitor
 from ..utils.fault_injection import Preemption
@@ -89,6 +91,15 @@ class ServingSupervisor:
         self.max_restarts = int(
             max_restarts if max_restarts is not None
             else flags.get("FLAGS_serving_max_restarts", 3))
+        # One RLock guards the shared TRACKING state (requests/owner/
+        # results/delivered) — the same discipline as the serving metrics
+        # ledger's module lock — so monitoring threads (telemetry()
+        # gauges, pending(), results(), a Prometheus scrape) read a
+        # consistent view while the supervision loop runs. The engines
+        # themselves are NOT thread-safe: submit()/cancel()/step() must
+        # stay on the supervising thread (a router hands work to that
+        # thread; it does not call into the engines concurrently).
+        self._lock = threading.RLock()
         self._requests = {}          # request_id -> latest live Request
         self._owner = {}             # request_id -> replica idx
         self._results = {}           # request_id -> GenerationResult (1st wins)
@@ -116,6 +127,9 @@ class ServingSupervisor:
             self.monitor = HeartbeatMonitor(heartbeat_dir,
                                             world_size=int(num_replicas),
                                             timeout=float(timeout))
+        # live per-replica gauges in the metrics registry ("supervisor"
+        # family; weakly referenced — dies with this object)
+        register_supervisor(self)
 
     def _spawn_engine(self, rep):
         eng = self.engine_factory()
@@ -160,12 +174,14 @@ class ServingSupervisor:
                 f"all {len(ups)} replica queues full "
                 f"({full.scheduler.max_queue} each); retry later",
                 qsize=full.queue_depth, max_queue=full.scheduler.max_queue)
-        self._requests[request.request_id] = request
-        self._owner[request.request_id] = rep.idx
+        with self._lock:
+            self._requests[request.request_id] = request
+            self._owner[request.request_id] = rep.idx
         return request
 
     def _acked(self, rid):
-        return rid in self._results or rid in self._delivered
+        with self._lock:
+            return rid in self._results or rid in self._delivered
 
     def cancel(self, request):
         """Cancel wherever the request currently lives (race-safe against
@@ -175,15 +191,17 @@ class ServingSupervisor:
         rid = request.request_id
         if self._acked(rid):
             return
-        live = self._requests.get(rid, request)
-        owner = self._owner.get(rid)
+        with self._lock:
+            live = self._requests.get(rid, request)
+            owner = self._owner.get(rid)
         if owner is not None and self._replicas[owner].state == "up":
             self._replicas[owner].engine.cancel(live)
         elif live.state != FINISHED:
             # owner down / mid-replay: resolve directly so pending() drains
             live._finish(CANCELLED)
             metrics.bump("cancelled")
-            self._results[rid] = live.result()
+            with self._lock:
+                self._results[rid] = live.result()
 
     # -- the supervision loop ------------------------------------------------
     def step(self):
@@ -222,12 +240,14 @@ class ServingSupervisor:
         return self.pending() > 0
 
     def _collect(self, rep):
-        for rid, res in rep.engine.pop_results().items():
-            # first result wins: a snapshot-respawned replica recomputes
-            # work that was already delivered — recomputation is
-            # deterministic, so dropping the duplicate loses nothing
-            if not self._acked(rid):
-                self._results[rid] = res
+        popped = rep.engine.pop_results()
+        with self._lock:
+            for rid, res in popped.items():
+                # first result wins: a snapshot-respawned replica recomputes
+                # work that was already delivered — recomputation is
+                # deterministic, so dropping the duplicate loses nothing
+                if not self._acked(rid):
+                    self._results[rid] = res
 
     def _on_failure(self, rep, err):
         """Replica death: respawn from its last snapshot when one exists
@@ -238,8 +258,9 @@ class ServingSupervisor:
         rep.state = "down"
         rep.last_error = err
         rep.engine = None
-        unacked = [rid for rid, owner in self._owner.items()
-                   if owner == rep.idx and not self._acked(rid)]
+        with self._lock:
+            unacked = [rid for rid, owner in self._owner.items()
+                       if owner == rep.idx and not self._acked(rid)]
         snap = None
         if rep.mgr is not None:
             try:
@@ -274,7 +295,8 @@ class ServingSupervisor:
                     # hygiene, not a user cancellation: skip the ledger
                     eng.cancel(req, count=None)
                 else:
-                    self._requests[rid] = req   # live handle for cancel()
+                    with self._lock:
+                        self._requests[rid] = req  # live handle for cancel()
             # and purge stale results for moved/delivered requests (the
             # cancels above just minted CANCELLED results; a snapshot can
             # also carry pre-save ones): _collect must never deliver them
@@ -296,7 +318,8 @@ class ServingSupervisor:
         guarantee: the replayed stream is bitwise the one the dead replica
         would have produced."""
         for rid in rids:
-            src = self._requests.get(rid)
+            with self._lock:
+                src = self._requests.get(rid)
             if src is None or self._acked(rid):
                 continue
             if src.state == FINISHED:
@@ -304,7 +327,8 @@ class ServingSupervisor:
                     # cancelled while in flight: its CANCELLED result may
                     # have died with the engine before a collect — deliver
                     # the outcome from the handle so pending() drains
-                    self._results[rid] = src.result()
+                    with self._lock:
+                        self._results[rid] = src.result()
                     continue
                 # else: it FINISHED on the dying replica in the very step
                 # that crashed (result lost, never collected) — fall
@@ -317,12 +341,14 @@ class ServingSupervisor:
                 # instead of spinning on an undeliverable request
                 metrics.bump("dropped")
                 src._finish(DROPPED)
-                self._results[rid] = src.result()
+                with self._lock:
+                    self._results[rid] = src.result()
                 continue
             copy = src.replay_copy()
             target.engine.requeue(copy)
-            self._requests[rid] = copy
-            self._owner[rid] = target.idx
+            with self._lock:
+                self._requests[rid] = copy
+                self._owner[rid] = target.idx
             metrics.bump("replayed")
 
     # -- lifecycle -----------------------------------------------------------
@@ -347,13 +373,15 @@ class ServingSupervisor:
                     continue           # cancelled mid-requeue: already done
                 target = self._pick(exclude=rep) or rep
                 target.engine.requeue(req)
-                self._owner[req.request_id] = target.idx
+                with self._lock:
+                    self._owner[req.request_id] = target.idx
             for _ in range(max(0, int(absorb_steps))):
                 self.step()
 
     def pending(self):
         """Requests submitted but not yet delivered."""
-        return sum(1 for rid in self._requests if not self._acked(rid))
+        with self._lock:
+            return sum(1 for rid in self._requests if not self._acked(rid))
 
     def pop_results(self):
         """Drain resolved requests and forget their tracking state (the
@@ -362,11 +390,12 @@ class ServingSupervisor:
         forever). Delivered ids stay in a lightweight seen-set, so a
         replica respawned from a stale snapshot can never re-deliver a
         duplicate after the heavy state is dropped."""
-        out, self._results = self._results, {}
-        for rid in out:
-            self._delivered.add(rid)
-            self._requests.pop(rid, None)
-            self._owner.pop(rid, None)
+        with self._lock:
+            out, self._results = self._results, {}
+            for rid in out:
+                self._delivered.add(rid)
+                self._requests.pop(rid, None)
+                self._owner.pop(rid, None)
         return out
 
     def run(self, requests=None, max_steps=100000):
@@ -408,4 +437,24 @@ class ServingSupervisor:
 
     def results(self):
         """Resolved-but-not-yet-popped results (non-draining peek)."""
-        return dict(self._results)
+        with self._lock:
+            return dict(self._results)
+
+    def telemetry(self):
+        """Live fleet gauges (the registry's "supervisor" family — one
+        scrape shows routing pressure and failover history per replica):
+        per-replica up/queue-depth/active-slots/restarts plus the
+        fleet-level pending count."""
+        out = {"replicas": len(self._replicas),
+               "alive": len(self._up()),
+               "pending": self.pending()}
+        for rep in self._replicas:
+            eng = rep.engine
+            out[f"replica{rep.idx}"] = {
+                "up": int(rep.state == "up"),
+                "restarts": int(rep.restarts),
+                "queue_depth": (0 if eng is None else eng.queue_depth),
+                "active_slots": (0 if eng is None else eng.active_slots),
+                "step_count": (0 if eng is None else eng._step_count),
+            }
+        return out

@@ -28,8 +28,11 @@ def _sync(x):
 
 
 def _peak():
+    # single-source FLOP/MFU estimators (paddle_tpu/observability/flops.py)
+    # — shared with bench.py and the live step telemetry, so sweep numbers
+    # and live MFU cannot diverge
     import jax
-    from bench import peak_flops_bf16
+    from paddle_tpu.observability.flops import peak_flops_bf16
     return peak_flops_bf16(getattr(jax.devices()[0], "device_kind", ""))
 
 
@@ -111,8 +114,9 @@ def bert_case(batch, seq, use_flash, steps=15, tiny=False):
     _sync(loss._data)
     dt = (time.perf_counter() - t0) / steps
     tok_s = batch * seq / dt
+    from paddle_tpu.observability.flops import dense_flops_per_token
     n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
-    mfu = tok_s * 6 * n_params / _peak()
+    mfu = tok_s * dense_flops_per_token(n_params) / _peak()
     print(f"BERT bs{batch} seq{seq} flash={use_flash}: "
           f"{tok_s:.0f} tok/s, {dt * 1e3:.1f} ms/step, "
           f"MFU {mfu * 100:.1f}%, loss "
@@ -151,7 +155,7 @@ def gpt_flash_tiles(model_name="gpt3-1.3B", batch=8, seq=2048, steps=8):
             _sync(loss)
             dt = (time.perf_counter() - t0) / steps
             tok_s = batch * seq / dt
-            from bench import model_flops_per_token
+            from paddle_tpu.observability.flops import model_flops_per_token
             fpt, _ = model_flops_per_token(cfg, seq)
             print(f"FLASH {model_name} bq{bq} bk{bk}: {tok_s:.0f} tok/s, "
                   f"{dt:.3f} s/step, MFU {tok_s * fpt / _peak() * 100:.1f}%",
@@ -220,7 +224,7 @@ def gpt_tp_schedules(model_name="gpt3-1.3B", batch=8, seq=2048, steps=8,
             _sync(loss)
             dt = (time.perf_counter() - t0) / steps
             tok_s = batch * seq / dt
-            from bench import model_flops_per_token
+            from paddle_tpu.observability.flops import model_flops_per_token
             fpt, _ = model_flops_per_token(cfg, seq)
             peak = _peak() * jax.device_count()
             print(f"TP {model_name} mp{mp} {name}: {tok_s:.0f} tok/s, "
