@@ -121,6 +121,66 @@ _FLAGS = {
     # Heartbeat staleness threshold (seconds) past which the supervisor
     # declares a replica frozen and fails it over.
     "FLAGS_serving_heartbeat_timeout": 10.0,
+    # -- SLO-driven multi-tenant serving (serving/slo.py) --------------------
+    # Class-aware admission: requests carry priority ("interactive" |
+    # "batch" | "best_effort") and a tenant id; admission serves classes
+    # best-first with weighted fair queueing across tenants WITHIN a class
+    # (one tenant cannot starve another), and an interactive request about
+    # to miss its deadline preemptively evicts the youngest lowest-class
+    # running slot (requeued with its ORIGINAL arrival, the PR 7 drain
+    # machinery — its replay is bitwise, so preemption costs latency, never
+    # correctness). Default OFF: admission is the strict FCFS the parity
+    # suites gate, byte-identical to the pre-SLO engine.
+    "FLAGS_serving_priority_classes": False,
+    # Per-class default relative deadline (seconds) applied at submit when
+    # the request did not set one; 0 = no class deadline. Only read in
+    # priority mode.
+    "FLAGS_serving_class_deadline_interactive": 0.0,
+    "FLAGS_serving_class_deadline_batch": 0.0,
+    "FLAGS_serving_class_deadline_best_effort": 0.0,
+    # Slack threshold (seconds) under which a queued interactive request
+    # counts as about-to-miss-its-deadline and may preempt. 0 = derive from
+    # live telemetry (2x the ledger's TTFT p50, floor 50ms).
+    "FLAGS_serving_preempt_margin_s": 0.0,
+    # Graceful load shedding: when the wait queue sits above
+    # shed_high * max_queue for shed_window consecutive step boundaries
+    # (sustained overload, not a burst), lowest-class queued work is shed
+    # down to shed_low * max_queue with finish_reason="shed" and a
+    # retry-after hint derived from the live queue-drain rate — instead of
+    # everything timing out. While shedding, NEW lowest-class submissions
+    # raise ShedError (same hint). Default OFF.
+    "FLAGS_serving_shed": False,
+    "FLAGS_serving_shed_high": 0.75,
+    "FLAGS_serving_shed_low": 0.5,
+    "FLAGS_serving_shed_window": 4,
+    # Per-tenant token-bucket rate limit at the supervisor router:
+    # sustained requests/second per tenant (0 = off) with a burst
+    # allowance. Over-rate submissions raise ShedError with the exact
+    # time-to-next-token as retry_after.
+    "FLAGS_serving_tenant_rate": 0.0,
+    "FLAGS_serving_tenant_burst": 8,
+    # Telemetry-driven autoscaling (supervisor): watch fleet queue depth /
+    # slot occupancy / TTFT p99 with hysteresis + cooldown and grow/shrink
+    # the replica set through the existing spawn/drain machinery. OFF by
+    # default; bounds and watermarks below.
+    "FLAGS_serving_autoscale": False,
+    "FLAGS_serving_min_replicas": 1,
+    "FLAGS_serving_max_replicas": 4,
+    # Scale up past up_queue waiting requests per live replica (or past
+    # up_occupancy mean slot occupancy); scale down below down_queue AND
+    # below down_occupancy. Watermarks are deliberately far apart
+    # (hysteresis) so the fleet never flaps.
+    "FLAGS_serving_autoscale_up_queue": 4.0,
+    "FLAGS_serving_autoscale_down_queue": 0.5,
+    "FLAGS_serving_autoscale_up_occupancy": 0.9,
+    "FLAGS_serving_autoscale_down_occupancy": 0.3,
+    # TTFT p99 SLO (seconds) that also triggers scale-up when breached;
+    # 0 disables the latency trigger.
+    "FLAGS_serving_autoscale_ttft_slo": 0.0,
+    # Consecutive over/under-watermark evaluations required before acting,
+    # and the minimum wall-clock gap between two actions.
+    "FLAGS_serving_autoscale_window": 4,
+    "FLAGS_serving_autoscale_cooldown_s": 2.0,
     # Ring-decomposed compute/communication overlap on the mp axis: the
     # pre-QKV/FFN all-gather splits into mp-1 ppermute hops with each
     # chunk's GEMM issued on arrival, and the RowParallel GEMM emits

@@ -22,12 +22,25 @@ Telemetry: with ``FLAGS_serving_trace`` on, every Request carries a span
 trace (queue → prefill chunks → decode → deliver, plus CoW/prefix and
 self-healing hops) that survives engine snapshots and exports as
 Perfetto JSON / JSONL — see ``paddle_tpu.observability``.
+
+SLO traffic management (slo.py; all default-off, host-side policy over
+the machinery above): priority classes with WFQ tenant fairness and
+deadline-driven preemption (``FLAGS_serving_priority_classes``),
+graceful load shedding with drain-rate retry-after hints
+(``FLAGS_serving_shed``, ``ShedError``), per-tenant token-bucket rate
+limits, telemetry-driven autoscaling (``FLAGS_serving_autoscale``), and
+zero-downtime weight swaps (``rolling_restart(new_params=)`` /
+``Engine.swap_params``; snapshots and results carry ``params_version``).
 """
 from .request import (  # noqa: F401
     Request, GenerationResult,
     QUEUED, RUNNING, FINISHED, STOP, LENGTH, EXPIRED, CANCELLED, DROPPED,
+    SHED,
 )
-from .scheduler import Scheduler, QueueFullError  # noqa: F401
+from .scheduler import Scheduler, QueueFullError, ShedError  # noqa: F401
+from .slo import (  # noqa: F401
+    CLASSES, class_rank, Autoscaler, ShedPolicy, TokenBucket,
+)
 from .paged_kv import PagedKVPool, PagePoolExhausted, pages_for  # noqa: F401
 from .engine import Engine, EngineStoppedError  # noqa: F401
 from .supervisor import ServingSupervisor  # noqa: F401

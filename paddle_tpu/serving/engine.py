@@ -70,10 +70,11 @@ from . import metrics
 from .paged_attention import paged_forward, paged_kernel_supported
 from .paged_kv import PagedKVPool, pages_for
 from .request import (
-    CANCELLED, EXPIRED, FINISHED, LENGTH, QUEUED, RUNNING, STOP,
+    CANCELLED, EXPIRED, FINISHED, LENGTH, QUEUED, RUNNING, SHED, STOP,
     GenerationResult, Request,
 )
-from .scheduler import QueueFullError, Scheduler
+from .scheduler import QueueFullError, Scheduler, ShedError
+from .slo import ShedPolicy
 
 
 class EngineStoppedError(RuntimeError):
@@ -214,7 +215,8 @@ class Engine:
                  num_slots=None, max_seq_len=None, prefill_buckets=None,
                  max_queue=None, top_k=None, kv_layout=None, page_size=None,
                  num_pages=None, prefill_chunk=None, prefix_cache=None,
-                 tag=None, trace=None):
+                 tag=None, trace=None, priority=None, tenant_weights=None,
+                 shed=None, params_version=0):
         if model is not None:
             params = _collect_params(model)
             config = model.config
@@ -254,10 +256,41 @@ class Engine:
         buckets = prefill_buckets or flags.get(
             "FLAGS_serving_prefill_buckets", (64, 256, 1024))
         buckets = sorted({min(int(b), self.max_seq_len) for b in buckets})
+        # SLO traffic management (serving/slo.py) — ALL policy, no traced
+        # operand or executable changes: with both knobs off, admission is
+        # the strict FCFS the parity suites gate, byte-identical to the
+        # pre-SLO engine.
+        self.priority_mode = (
+            bool(flags.get("FLAGS_serving_priority_classes", False))
+            if priority is None else bool(priority))
+        self._class_deadlines = {
+            "interactive": float(
+                flags.get("FLAGS_serving_class_deadline_interactive", 0.0)),
+            "batch": float(
+                flags.get("FLAGS_serving_class_deadline_batch", 0.0)),
+            "best_effort": float(
+                flags.get("FLAGS_serving_class_deadline_best_effort", 0.0)),
+        }
+        self._preempt_margin_s = float(
+            flags.get("FLAGS_serving_preempt_margin_s", 0.0))
         self.scheduler = Scheduler(
             buckets,
             max_queue=int(max_queue or
-                          flags.get("FLAGS_serving_max_queue", 256)))
+                          flags.get("FLAGS_serving_max_queue", 256)),
+            priority=self.priority_mode, tenant_weights=tenant_weights)
+        shed_on = (bool(flags.get("FLAGS_serving_shed", False))
+                   if shed is None else bool(shed))
+        self._shed = None
+        if shed_on:
+            self._shed = ShedPolicy(
+                self.scheduler.max_queue,
+                high=float(flags.get("FLAGS_serving_shed_high", 0.75)),
+                low=float(flags.get("FLAGS_serving_shed_low", 0.5)),
+                window=int(flags.get("FLAGS_serving_shed_window", 4)))
+        # weight-swap audit trail: every admitted request is stamped with
+        # the version its tokens are produced under
+        self.params_version = int(params_version)
+        self._resolved_total = 0          # feeds the shed drain-rate EWMA
         self.top_k = (None if top_k in (None, 0)
                       else min(int(top_k), config.vocab_size))
 
@@ -416,6 +449,25 @@ class Engine:
             request.submit_t = time.perf_counter()
             self._resolve(request, LENGTH)
             return request
+        if self.priority_mode and request.deadline_s is None:
+            # per-class default deadline (0 = none): the SLO contract a
+            # class carries when the caller didn't set one explicitly
+            dflt = self._class_deadlines.get(request.priority, 0.0)
+            if dflt > 0:
+                request.deadline_s = dflt
+        if self._shed is not None and self._shed.shedding \
+                and request.class_rank >= 2:
+            # sustained overload, shedding latched: refuse new best-effort
+            # work UP FRONT with the drain-rate hint instead of queueing it
+            # only to shed it a boundary later
+            qsize = self.scheduler.qsize()
+            hint = self._shed.retry_after(qsize)
+            metrics.bump("shed")
+            raise ShedError(
+                f"shedding {request.priority} traffic under sustained "
+                f"overload ({qsize} waiting); retry in ~{hint:.2f}s",
+                qsize=qsize, max_queue=self.scheduler.max_queue,
+                retry_after=hint)
         try:
             self.scheduler.submit(request)
         except QueueFullError:
@@ -476,19 +528,45 @@ class Engine:
         _fi.maybe_kill_serving(self.tag, self._step_count)
         now = time.perf_counter()
 
-        # 1) evict running requests whose deadline passed
+        # 1) evict running requests whose deadline passed (same boundary
+        #    predicate — Request.expired — as every queue-expiry site)
         for b, req in enumerate(self._slots):
-            if req is not None and req.deadline is not None \
-                    and now > req.deadline:
+            if req is not None and req.expired(now):
                 self._free_slot(b)
                 self._resolve(req, EXPIRED, count="expired")
 
         # 2) reap deadline-expired queued requests (even with zero free
-        #    slots — they must not count toward backpressure), then FCFS
-        #    admission into free slots at the boundary (page-aware for the
-        #    paged layout: the head is admitted when PAGES suffice for its
-        #    whole lifetime, not when a whole-Smax slot does)
+        #    slots — they must not count toward backpressure); their queue
+        #    wait goes to the ledger so refused traffic stays visible
         expired = self.scheduler.expire(now)
+
+        # 2b) graceful load shedding: after `window` consecutive over-high
+        #     boundaries, shed lowest-class queued work down to the low
+        #     watermark with a retry-after hint from the live drain rate
+        if self._shed is not None:
+            qsize = self.scheduler.qsize()
+            target = self._shed.observe(qsize, self._resolved_total, now)
+            if target is not None:
+                hint = self._shed.retry_after(qsize)
+                for req in self.scheduler.shed(target):
+                    req.retry_after = hint
+                    metrics.observe_queue_wait(
+                        now - req.submit_t if req.submit_t else 0.0, "shed")
+                    self._resolve(req, SHED, count="shed")
+
+        # 2c) preemptive admission (priority mode): when an interactive
+        #     request would miss its deadline waiting for capacity, evict
+        #     the youngest lowest-class running slot — requeued through
+        #     the PR 7 drain machinery (ORIGINAL submit_t/deadline kept,
+        #     replay bitwise), so preemption costs the victim latency,
+        #     never correctness
+        if self.priority_mode:
+            self._preempt_for_deadline(now)
+
+        #    then admission into free slots at the boundary, FCFS or
+        #    class-aware WFQ (page-aware for the paged layout: a candidate
+        #    is admitted when PAGES suffice for its whole lifetime, not
+        #    when a whole-Smax slot does)
         free = [b for b, r in enumerate(self._slots) if r is None]
         fits = self._try_reserve if self.kv_layout == "paged" else None
         admitted, admit_expired = self.scheduler.admit(len(free), now,
@@ -496,6 +574,8 @@ class Engine:
         for req in expired + admit_expired:
             # already _finish(EXPIRED)ed by the scheduler; _resolve stores
             # the result, bumps the ledger and closes the trace
+            metrics.observe_queue_wait(
+                now - req.submit_t if req.submit_t else 0.0, "expired")
             self._resolve(req, EXPIRED, count="expired")
         for req, b in zip(admitted, free):
             self._admit(req, b)
@@ -694,7 +774,8 @@ class Engine:
         metrics.bump("tokens_out")
         self._tok[b] = tok
         if first and fresh_first:
-            metrics.observe_ttft(req.first_token_t - req.submit_t)
+            metrics.observe_ttft(req.first_token_t - req.submit_t,
+                                 priority=req.priority)
             if req.trace is not None:
                 # the exact timestamp the TTFT sample uses — the exported
                 # trace reconciles with the ledger to the float
@@ -706,7 +787,80 @@ class Engine:
             self._free_slot(b)
             self._resolve(req, LENGTH)
 
-    def _try_reserve(self, req):
+    # -- preemptive admission (priority mode) --------------------------------
+    def _preempt_margin(self, now=None):
+        """Slack under which a queued deadline counts as at-risk: the flag
+        when set, else 2x the ledger's recent TTFT p50 (what admission
+        actually costs right now), floor 50ms."""
+        if self._preempt_margin_s > 0:
+            return self._preempt_margin_s
+        p50 = metrics.recent_ttft_p50()
+        return max(0.05, 2.0 * p50) if p50 is not None else 0.05
+
+    def _capacity_for(self, req):
+        """Could ``req`` be admitted right now without preempting? Exact:
+        the paged check runs the real reservation as a side-effect-free
+        probe (pages allocated then immediately released, no ledger/plan
+        writes)."""
+        if not any(r is None for r in self._slots):
+            return False
+        if self.kv_layout != "paged":
+            return True
+        return self._try_reserve(req, probe=True)
+
+    def _preempt_slot(self, than_rank):
+        """Victim slot for a class-``than_rank`` preemption: a RUNNING
+        request of strictly worse class; worst class first, youngest
+        admission first within it (the least sunk work is thrown away).
+        None when every running slot is same-or-better class."""
+        best = None
+        for b, req in enumerate(self._slots):
+            if req is None or req.class_rank <= than_rank:
+                continue
+            key = (req.class_rank, int(self._admit_seq[b]))
+            if best is None or key > best[0]:
+                best = (key, b)
+        return None if best is None else best[1]
+
+    def _preempt_for_deadline(self, now):
+        """Evict lower-class running slots until the most at-risk queued
+        request (slack within the preempt margin) has capacity, then seat
+        it DIRECTLY: the regular admission order (class + WFQ tenant
+        rotation) is deadline-blind, so leaving the freed slot to
+        ``Scheduler.admit`` could hand it to a different request and the
+        eviction would have been for nothing. Victims requeue at their
+        ORIGINAL arrival — the PR 7 machinery — and their replay is
+        bitwise, so this trades best-effort latency for the deadline.
+        Bounded by the slot count per boundary."""
+        margin = None
+        for _ in range(self.num_slots):
+            if margin is None:
+                margin = self._preempt_margin(now)
+            risk = self.scheduler.deadline_risk(now, margin)
+            if risk is None:
+                return
+            if not self._capacity_for(risk):
+                b = self._preempt_slot(risk.class_rank)
+                if b is None:
+                    return
+                victim = self._slots[b]
+                self._free_slot(b)
+                victim._requeue()
+                self.scheduler.requeue(victim)
+                metrics.bump("preempted")
+                if not self._capacity_for(risk):
+                    continue          # free more slots/pages for it
+            if not self.scheduler.cancel(risk):
+                return                # resolved concurrently: nothing owed
+            if self.kv_layout == "paged" and not self._try_reserve(risk):
+                # pages raced away between probe and reserve: restore the
+                # queue entry at its arrival position, retry next boundary
+                self.scheduler.requeue(risk)
+                return
+            free_b = next(b for b, r in enumerate(self._slots) if r is None)
+            self._admit(risk, free_b)
+
+    def _try_reserve(self, req, probe=False):
         """Page-aware admission predicate (the scheduler's ``fits``): pin
         the longest cached prompt prefix, then allocate every page the
         request can touch over its WHOLE lifetime (prompt + max_new_tokens,
@@ -730,6 +884,14 @@ class Engine:
         # request never CoWs against its own registration
         spare_needed = n_shared > 0 and n_shared - 1 >= chunk_start // ps
         need = (total - n_shared) + (1 if spare_needed else 0)
+        if probe:
+            # capacity question only (preemption policy): answered without
+            # allocating — pool.try_alloc would EVICT cache entries to
+            # satisfy a transient probe, churning the very prefix pages
+            # (possibly this request's own) the reservation depends on
+            ok = pool.can_alloc(need)
+            pool.decref(shared)
+            return ok
         got = pool.try_alloc(need)
         if got is None:
             pool.decref(shared)
@@ -765,6 +927,7 @@ class Engine:
         self.pool.map_slot(b, list(shared) + list(private), spare)
         req.state = RUNNING
         req.slot = b
+        req.params_version = self.params_version
         self._slots[b] = req
         self._chunk_off[b] = chunk_start
         self._admit_count += 1
@@ -783,6 +946,7 @@ class Engine:
         the prefill emits the request's FIRST token (TTFT stops here)."""
         plen = req.prompt_len
         self._trace_queue_span(req, b)
+        req.params_version = self.params_version
         bucket = self.scheduler.bucket_for(plen)
         metrics.observe_prefill_waste(bucket - plen)
         ids = np.zeros(bucket, np.int32)
@@ -809,7 +973,8 @@ class Engine:
         req._emit(tok)
         metrics.bump("tokens_out")
         if fresh_first:
-            metrics.observe_ttft(req.first_token_t - req.submit_t)
+            metrics.observe_ttft(req.first_token_t - req.submit_t,
+                                 priority=req.priority)
             if req.trace is not None:
                 req.trace.instant("first_token", req.first_token_t)
         if req.stop_token_ids and tok in req.stop_token_ids:
@@ -868,6 +1033,11 @@ class Engine:
         if req.state != FINISHED:
             req._finish(reason)
         req.slot = None
+        if reason != SHED:
+            # feeds the shed drain-rate EWMA: shedding itself must not
+            # count as "drained" or a mass shed would spike the rate and
+            # shrink the very retry hints it is about to hand out
+            self._resolved_total += 1
         self._results[req.request_id] = req.result()
         if count is not None:
             metrics.bump(count)
@@ -879,6 +1049,64 @@ class Engine:
             req._trace_done = True
             req.trace.instant("deliver", req.finish_t, reason=reason)
             obs_tracing.collect(req, engine_tag=self.tag)
+
+    # -- hot weight swap -----------------------------------------------------
+    def swap_params(self, params, version=None, count=True):
+        """Replace the served weights in place with a SAME-SHAPE tree
+        (``init_gpt_params`` layout, the thing ``HybridTrainStep`` trains):
+        the executables are memoized per config and params are ordinary
+        traced operands, so a same-shape swap re-dispatches the already
+        compiled fused step — zero retraces (gated in tests). Bumps
+        ``params_version`` (or sets it to ``version``); requests admitted
+        AFTER the swap are stamped with the new version, requests already
+        in a slot keep decoding against the swapped weights — which is why
+        the supervisor's ``rolling_restart(new_params=)`` swaps only
+        DRAINED replicas: in-flight work is requeued and recomputed from
+        scratch on exactly one version, never a mid-stream mix.
+
+        ``count=False`` skips the ``weight_swaps`` ledger bump — for
+        RE-applications of already-live weights (a supervisor respawning a
+        crashed replica after an upgrade), which are not new swaps and
+        would make the upgrade audit trail useless for correlating
+        regressions with actual weight changes."""
+        if params is None:
+            raise ValueError("swap_params needs a params tree")
+        if any(r is not None for r in self._slots) \
+                or self.scheduler.qsize() > 0:
+            # KV already computed (and tokens already streamed) under the
+            # old weights would continue under the new ones — a mid-stream
+            # version mix. The supervisor always swaps freshly-spawned
+            # (empty) engines; direct callers must drain first.
+            raise RuntimeError(
+                "swap_params on a non-idle engine: drain() first (the "
+                "drained requests requeue and recompute single-version)")
+        params = _logical_qkv(params, self.config)
+        new = jax.tree_util.tree_map(jnp.asarray, params)
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new)
+        if old_def != new_def:
+            raise ValueError(
+                f"swap_params tree structure differs from the served "
+                f"params ({new_def} vs {old_def}); a different "
+                f"architecture needs a new Engine, not a swap")
+        for o, n in zip(old_leaves, new_leaves):
+            if o.shape != n.shape or o.dtype != n.dtype:
+                raise ValueError(
+                    f"swap_params leaf mismatch {n.shape}/{n.dtype} vs "
+                    f"served {o.shape}/{o.dtype}; same-shape swaps only "
+                    f"(anything else would retrace the fused step)")
+        self.params = new
+        self.params_version = (int(version) if version is not None
+                               else self.params_version + 1)
+        if self.kv_layout == "paged":
+            # the prefix cache holds KV pages COMPUTED UNDER THE OLD
+            # WEIGHTS — a post-swap prompt that prefix-hit them would
+            # decode against stale KV (caught by the parity gate). Version
+            # bump invalidates the whole cache.
+            self.pool.clear_cache()
+        if count:
+            metrics.bump("weight_swaps")
+        return self
 
     # -- self-healing: snapshot / restore / drain ----------------------------
     def attach_checkpoint(self, mgr, every=None):
@@ -921,8 +1149,15 @@ class Engine:
         return self._step_count
 
     def _snapshot_meta(self):
+        # params_version is part of the compatibility contract: a snapshot
+        # holds KV computed under ONE weight version, and restoring it
+        # onto an engine serving another version would resume mid-stream
+        # on mixed weights. The mismatch raises in load_state_dict; the
+        # supervisor then falls back to replay-from-scratch on the new
+        # version — zero drops either way, single-version results always.
         meta = {"kv_layout": self.kv_layout, "num_slots": self.num_slots,
                 "max_seq_len": self.max_seq_len, "top_k": self.top_k,
+                "params_version": int(self.params_version),
                 "cfg": _cfg_key(self.config)}
         if self.kv_layout == "paged":
             meta.update(page_size=self.page_size,
@@ -939,6 +1174,9 @@ class Engine:
                 "tokens": list(res.tokens),
                 "finish_reason": res.finish_reason,
                 "ttft": res.ttft, "latency": res.latency,
+                "priority": res.priority, "tenant": res.tenant,
+                "params_version": res.params_version,
+                "retry_after": res.retry_after,
                 # exceptions may not pickle; the repr is enough postmortem
                 "callback_error": (None if res.callback_error is None
                                    else repr(res.callback_error))}
@@ -1047,7 +1285,11 @@ class Engine:
                 request_id=d["request_id"], prompt=d["prompt"],
                 tokens=list(d["tokens"]), finish_reason=d["finish_reason"],
                 ttft=d["ttft"], latency=d["latency"],
-                callback_error=d["callback_error"])
+                callback_error=d["callback_error"],
+                priority=d.get("priority", "batch"),
+                tenant=d.get("tenant", "default"),
+                params_version=d.get("params_version"),
+                retry_after=d.get("retry_after"))
             for d in state["results"]}
         if restore_metrics:
             metrics.import_state(state["metrics"])

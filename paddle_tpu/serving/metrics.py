@@ -47,6 +47,19 @@ def _zero():
         "snapshots": 0, "snapshot_restores": 0, "preempt_drains": 0,
         "requeued": 0, "replayed": 0, "respawns": 0,
         "stale_failovers": 0, "rolling_restarts": 0, "dropped": 0,
+        # SLO traffic management (serving/slo.py): queued work shed under
+        # sustained overload, running slots preempted for an interactive
+        # deadline, router-side rate-limit refusals, autoscale actions and
+        # hot weight swaps. Queue-wait sums make shed/expired traffic
+        # visible: how long refused work sat in queue before the verdict.
+        "shed": 0, "preempted": 0, "rate_limited": 0,
+        "scale_ups": 0, "scale_downs": 0, "weight_swaps": 0,
+        # queue-wait is recorded only for requests refused FROM THE QUEUE
+        # (up-front ShedError refusals and mid-flight expiries carry no
+        # queue wait), so the means divide by these sample counts, not by
+        # the total shed/expired tallies
+        "shed_queue_wait_s": 0.0, "shed_queue_waits": 0,
+        "expired_queue_wait_s": 0.0, "expired_queue_waits": 0,
         # tokens / time
         "tokens_out": 0,
         "decode_time_s": 0.0, "prefill_time_s": 0.0,
@@ -63,6 +76,10 @@ _C = _zero()
 _MAX_SAMPLES = 65536
 _ttft = deque(maxlen=_MAX_SAMPLES)      # seconds
 _tok_lat = deque(maxlen=_MAX_SAMPLES)   # per-token decode latency (seconds)
+# per-priority-class TTFT rings (lazy: a class appears once it has a
+# sample) — the SLO story is per-class: the chaos gate holds the
+# INTERACTIVE p99 while best_effort visibly degrades
+_ttft_cls = {}
 
 
 def bump(name, n=1):
@@ -100,14 +117,48 @@ def observe_prefill_waste(padded_tokens):
                                        padded_tokens)
 
 
-def observe_ttft(seconds):
+def observe_ttft(seconds, priority=None):
     with _lock:
         _ttft.append(seconds)
+        if priority is not None:
+            _ttft_cls.setdefault(priority,
+                                 deque(maxlen=_MAX_SAMPLES)).append(seconds)
+
+
+def observe_queue_wait(seconds, outcome):
+    """Queue-wait of a request refused from the QUEUE (``outcome`` is
+    "shed" or "expired"): the ledger shows how long refused traffic sat
+    before the verdict, so shed/expired work is visible in
+    ``serving_summary()`` instead of vanishing."""
+    with _lock:
+        _C[f"{outcome}_queue_wait_s"] += max(0.0, seconds)
+        _C[f"{outcome}_queue_waits"] += 1
 
 
 def observe_token_latency(seconds, n=1):
     with _lock:
         _tok_lat.append(seconds / max(n, 1))
+
+
+def recent_ttft_p50(n=256):
+    """p50 over the last ``n`` TTFT samples (None when empty) — the cheap
+    live estimate the preemption margin derives from, without computing
+    the full serving_counters() snapshot every boundary."""
+    with _lock:
+        if not _ttft:
+            return None
+        tail = list(_ttft)[-int(n):]
+    return float(np.percentile(tail, 50))
+
+
+def recent_ttft_p99(n=512):
+    """p99 over the last ``n`` TTFT samples (None when empty) — the live
+    latency gauge the autoscaler compares against its SLO."""
+    with _lock:
+        if not _ttft:
+            return None
+        tail = list(_ttft)[-int(n):]
+    return float(np.percentile(tail, 99))
 
 
 def serving_counters():
@@ -118,8 +169,18 @@ def serving_counters():
         out = dict(_C)
         ttft = list(_ttft)
         lat = list(_tok_lat)
+        cls_samples = {c: list(v) for c, v in _ttft_cls.items()}
     out["ttft_p50"] = float(np.percentile(ttft, 50)) if ttft else None
     out["ttft_p99"] = float(np.percentile(ttft, 99)) if ttft else None
+    for c, v in cls_samples.items():
+        out[f"ttft_p50_{c}"] = float(np.percentile(v, 50))
+        out[f"ttft_p99_{c}"] = float(np.percentile(v, 99))
+    out["shed_queue_wait_mean"] = (
+        out["shed_queue_wait_s"] / out["shed_queue_waits"]
+        if out["shed_queue_waits"] else 0.0)
+    out["expired_queue_wait_mean"] = (
+        out["expired_queue_wait_s"] / out["expired_queue_waits"]
+        if out["expired_queue_waits"] else 0.0)
     out["token_latency_p50"] = float(np.percentile(lat, 50)) if lat else None
     # tokens_out counts prefill-emitted first tokens too, so the rate
     # divides by total executable time (prefill + decode), not decode alone
@@ -146,6 +207,7 @@ def reset_serving_counters():
         _C = _zero()
         _ttft.clear()
         _tok_lat.clear()
+        _ttft_cls.clear()
 
 
 def export_state():
@@ -154,7 +216,8 @@ def export_state():
     SLO history across a restart instead of reporting from zero."""
     with _lock:
         return {"counters": dict(_C), "ttft": list(_ttft),
-                "token_latency": list(_tok_lat)}
+                "token_latency": list(_tok_lat),
+                "ttft_cls": {c: list(v) for c, v in _ttft_cls.items()}}
 
 
 def import_state(state):
@@ -170,6 +233,9 @@ def import_state(state):
         _ttft.extend(state.get("ttft", ()))
         _tok_lat.clear()
         _tok_lat.extend(state.get("token_latency", ()))
+        _ttft_cls.clear()
+        for c, v in state.get("ttft_cls", {}).items():
+            _ttft_cls[c] = deque(v, maxlen=_MAX_SAMPLES)
 
 
 def serving_summary():
@@ -201,6 +267,19 @@ def serving_summary():
                 f"respawns: {c['respawns']} "
                 f"({c['stale_failovers']} stale-hb)  "
                 f"dropped: {c['dropped']}")
+    slo = ""
+    if any(c[k] for k in ("shed", "preempted", "rate_limited", "scale_ups",
+                          "scale_downs", "weight_swaps")):
+        cls_p99 = "  ".join(
+            f"{k[len('ttft_p99_'):]}-p99: {c[k] * 1e3:.1f}ms"
+            for k in sorted(c) if k.startswith("ttft_p99_"))
+        slo = (f"  slo: {c['shed']} shed "
+               f"({c['shed_queue_wait_mean'] * 1e3:.0f}ms avg wait)  "
+               f"preempted: {c['preempted']}  "
+               f"rate-limited: {c['rate_limited']}  "
+               f"scale: +{c['scale_ups']}/-{c['scale_downs']}  "
+               f"weight-swaps: {c['weight_swaps']}"
+               + (f"  {cls_p99}" if cls_p99 else ""))
     return (f"requests: {c['submitted']} submitted / {c['completed']} done "
             f"({c['expired']} expired, {c['rejected']} rejected)  "
             f"tokens: {c['tokens_out']}  tokens/s: {c['tokens_per_s']:.1f}  "
@@ -208,4 +287,4 @@ def serving_summary():
             f"queue: {c['queue_depth_mean']:.1f} avg/{c['queue_depth_max']} max  "
             f"executables: {c['prefill_traces']} prefill + "
             f"{c['decode_traces']} decode + {c['paged_traces']} paged"
-            f"{paged}{waste}{heal}")
+            f"{paged}{waste}{slo}{heal}")

@@ -102,6 +102,24 @@ class PagedKVPool:
             return None
         return [self._alloc_one() for _ in range(n)]
 
+    def can_alloc(self, n):
+        """Non-destructive capacity check: could ``try_alloc(n)`` succeed?
+        True when free pages plus the pages the LRU cache COULD release
+        (pages whose every reference is a cache pin) cover ``n``. Unlike
+        ``try_alloc`` this never evicts — capacity PROBES (the engine's
+        preemption policy polls one per boundary) must not churn the hot
+        cache entries they are trying to preserve."""
+        n = int(n)
+        if self.free_count >= n:
+            return True
+        cache_refs = {}
+        for key, val in self._cache.items():
+            for p in ([val] if key[0] == b"P" else list(val[0])):
+                cache_refs[p] = cache_refs.get(p, 0) + 1
+        reclaimable = sum(1 for p, c in cache_refs.items()
+                          if self.ref[p] == c)
+        return self.free_count + reclaimable >= n
+
     def incref(self, pages):
         for p in pages:
             assert p != 0

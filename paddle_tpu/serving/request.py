@@ -30,6 +30,7 @@ LENGTH = "length"      # hit max_new_tokens
 EXPIRED = "expired"    # deadline passed before/while running
 CANCELLED = "cancelled"
 DROPPED = "dropped"    # supervisor had no live replica left to replay on
+SHED = "shed"          # load-shed under sustained overload (retry_after set)
 
 
 @dataclass(eq=False)  # identity equality: deque.remove/cancel compare BY
@@ -51,6 +52,13 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
     seed: int = 0
     deadline_s: float | None = None
     on_token: object = None          # callback(request, token_id)
+    # SLO class + tenant (serving/slo.py). Policy-only: with
+    # FLAGS_serving_priority_classes off both are carried but never read,
+    # so default traffic is byte-identical to the pre-SLO engine. Classes:
+    # "interactive" (rank 0, may preempt), "batch" (default),
+    # "best_effort" (preempted and shed first).
+    priority: str = "batch"
+    tenant: str = "default"
 
     # -- engine-managed state ------------------------------------------------
     request_id: int = field(default_factory=lambda: next(_req_ids))
@@ -63,6 +71,13 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
     finish_reason: str | None = field(default=None)
     callback_error: object = field(default=None)  # first on_token exception
     requeue_count: int = field(default=0)         # drain/replay round trips
+    # weight version this request's tokens were produced under (stamped at
+    # admission; re-stamped when a requeue recomputes from scratch on a
+    # swapped replica, so the RESULT is always single-version consistent)
+    params_version: int | None = field(default=None)
+    # retry-after hint attached when load shedding resolves this request
+    # (seconds until the shed backlog should have drained)
+    retry_after: float | None = field(default=None)
     # span trace context (observability.RequestTrace) — attached by the
     # engine when FLAGS_serving_trace is on, None otherwise (untraced
     # requests pay one attribute check per recording site)
@@ -91,6 +106,9 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
             self.eos_token_id, self.stop_token_ids) or ()
         if self.top_k == 0:            # generate's "disabled" spelling
             self.top_k = None
+        from .slo import class_rank
+        class_rank(self.priority)      # validate eagerly: fail at submit
+        self.tenant = str(self.tenant)
 
     @property
     def prompt_len(self):
@@ -102,6 +120,20 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
         if self.deadline_s is None or self.submit_t is None:
             return None
         return self.submit_t + self.deadline_s
+
+    def expired(self, now):
+        """THE deadline-boundary predicate: a request is expired from the
+        first instant ``now >= deadline`` (the deadline itself is outside
+        the allowed window). Every site — queue expiry, admission,
+        mid-flight eviction — routes through here, so the boundary
+        semantics cannot drift between call sites again."""
+        dl = self.deadline
+        return dl is not None and now >= dl
+
+    @property
+    def class_rank(self):
+        from .slo import class_rank
+        return class_rank(self.priority)
 
     def _emit(self, token):
         self.tokens.append(int(token))
@@ -159,7 +191,8 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
                     do_sample=self.do_sample, temperature=self.temperature,
                     top_p=self.top_p, top_k=self.top_k,
                     stop_token_ids=self.stop_token_ids, seed=self.seed,
-                    deadline_s=self.deadline_s, on_token=self.on_token)
+                    deadline_s=self.deadline_s, on_token=self.on_token,
+                    priority=self.priority, tenant=self.tenant)
         r.request_id = self.request_id
         r.submit_t = self.submit_t
         r.first_token_t = self.first_token_t
@@ -189,6 +222,10 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
             "seed": int(self.seed),
             "deadline_s": (None if self.deadline_s is None
                            else float(self.deadline_s)),
+            "priority": self.priority,
+            "tenant": self.tenant,
+            "params_version": (None if self.params_version is None
+                               else int(self.params_version)),
             "request_id": int(self.request_id),
             "state": self.state,
             "tokens": list(self.tokens),
@@ -210,7 +247,10 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
                 do_sample=state["do_sample"], temperature=state["temperature"],
                 top_p=state["top_p"], top_k=state["top_k"],
                 stop_token_ids=state["stop_token_ids"], seed=state["seed"],
-                deadline_s=state["deadline_s"])
+                deadline_s=state["deadline_s"],
+                priority=state.get("priority", "batch"),
+                tenant=state.get("tenant", "default"))
+        r.params_version = state.get("params_version")
         r.request_id = int(state["request_id"])
         global _req_ids
         floor = next(_req_ids)
@@ -243,6 +283,10 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
             latency=(None if self.finish_t is None or self.submit_t is None
                      else self.finish_t - self.submit_t),
             callback_error=self.callback_error,
+            priority=self.priority,
+            tenant=self.tenant,
+            params_version=self.params_version,
+            retry_after=self.retry_after,
         )
 
 
@@ -258,6 +302,13 @@ class GenerationResult:
     ttft: float | None = None
     latency: float | None = None
     callback_error: object = None    # first on_token exception, if any
+    priority: str = "batch"
+    tenant: str = "default"
+    # weight version the tokens were produced under (hot-swap audit trail);
+    # None when the request never reached a slot
+    params_version: int | None = None
+    # seconds-until-retry hint on finish_reason == "shed"
+    retry_after: float | None = None
 
     @property
     def sequence(self):

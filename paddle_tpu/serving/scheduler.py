@@ -14,14 +14,16 @@ from __future__ import annotations
 import time
 from collections import deque
 
-from .request import EXPIRED, FINISHED, QUEUED
+from .request import EXPIRED, FINISHED, QUEUED, SHED
 
 
 class QueueFullError(RuntimeError):
     """Raised by submit() when the wait queue is at max_queue. Carries
     ``qsize`` (waiting requests at rejection time) and ``max_queue`` so a
     router can back off proportionally (retry-after ~ qsize/max_queue)
-    instead of blind-retrying."""
+    instead of blind-retrying. At the supervisor both fields are
+    FLEET-WIDE totals (every replica's waiting requests / capacity), so
+    the hint reflects the traffic the client actually competes with."""
 
     def __init__(self, message, qsize=None, max_queue=None):
         super().__init__(message)
@@ -29,14 +31,51 @@ class QueueFullError(RuntimeError):
         self.max_queue = max_queue
 
 
+class ShedError(QueueFullError):
+    """Load shedding refused this request: the fleet is in sustained
+    overload and the request's class is being shed. Shares the
+    ``qsize``/``max_queue`` backpressure fields with ``QueueFullError``
+    (so existing 429 handlers catch both) and adds ``retry_after`` —
+    seconds until the shed backlog should have drained, derived from the
+    LIVE queue-drain rate rather than a blind exponential backoff."""
+
+    def __init__(self, message, qsize=None, max_queue=None,
+                 retry_after=None):
+        super().__init__(message, qsize=qsize, max_queue=max_queue)
+        self.retry_after = retry_after
+
+
 class Scheduler:
-    def __init__(self, buckets, max_queue=256):
+    """``priority=False`` (default) is strict FCFS — byte-identical to the
+    pre-SLO scheduler the parity suites gate. ``priority=True`` makes
+    admission class-aware (serving/slo.py): best class first, and within a
+    class weighted fair queueing across tenants (deficit round-robin over
+    per-tenant FCFS lanes, ``tenant_weights`` credits per rotation) so one
+    tenant's burst cannot starve another's trickle. The wait queue itself
+    stays ONE arrival-ordered deque either way: snapshots, drains,
+    requeue-at-original-arrival and cancel races are order-agnostic and
+    shared between both modes — priority is a pure admission-order policy
+    computed at the boundary."""
+
+    def __init__(self, buckets, max_queue=256, priority=False,
+                 tenant_weights=None):
         buckets = sorted(int(b) for b in buckets)
         if not buckets:
             raise ValueError("need at least one prefill bucket")
         self.buckets = tuple(buckets)
         self.max_queue = int(max_queue)
+        self.priority = bool(priority)
+        # weights clamp to >= 1: a zero credit would starve the tenant's
+        # lane AND stall the WFQ rotation that expects every pass to drain
+        self.tenant_weights = {str(t): max(1, int(w))
+                               for t, w in (tenant_weights or {}).items()}
+        self._wfq_last = {}            # class rank -> last-served tenant
         self._q = deque()
+
+    def set_tenant_weight(self, tenant, weight):
+        """WFQ credit per rotation for ``tenant`` (default 1): a weight-2
+        tenant is served two requests per round-robin pass."""
+        self.tenant_weights[str(tenant)] = max(1, int(weight))
 
     # -- queue ---------------------------------------------------------------
     def submit(self, req):
@@ -103,47 +142,140 @@ class Scheduler:
         """Remove and return every queued request whose deadline passed —
         called at EVERY step boundary (not just when a slot frees), so dead
         entries never inflate qsize()/backpressure while all slots are busy.
-        Returned requests are already marked EXPIRED."""
+        Returned requests are already marked EXPIRED. Boundary semantics
+        are ``Request.expired`` (``now >= deadline``) — the single
+        predicate every expiry site shares."""
         now = time.perf_counter() if now is None else now
         expired = [r for r in self._q if r.state != FINISHED
-                   and r.deadline is not None and now > r.deadline]
+                   and r.expired(now)]
         for req in expired:
             self._q.remove(req)
             req._finish(EXPIRED)
         return expired
 
     # -- admission -----------------------------------------------------------
+    def _admission_order(self):
+        """Live queued requests in admission order. FCFS mode returns the
+        arrival order verbatim; priority mode orders best class first and,
+        within a class, deficit-round-robins across tenants (arrival order
+        within each tenant's lane). The rotation resumes after the
+        class's last-served tenant, so fairness holds across boundaries,
+        not just within one."""
+        live = [r for r in self._q if r.state != FINISHED]
+        if not self.priority or len(live) <= 1:
+            return live
+        by_class = {}
+        for r in live:
+            by_class.setdefault(r.class_rank, []).append(r)
+        out = []
+        for rank in sorted(by_class):
+            out.extend(self._wfq_order(rank, by_class[rank]))
+        return out
+
+    def _wfq_order(self, rank, reqs):
+        """Weighted fair order across tenants within one class."""
+        lanes, tenants = {}, []
+        for r in reqs:                     # arrival order within each lane
+            if r.tenant not in lanes:
+                tenants.append(r.tenant)
+                lanes[r.tenant] = deque()
+            lanes[r.tenant].append(r)
+        if len(tenants) <= 1:
+            return reqs
+        last = self._wfq_last.get(rank)
+        if last in tenants:                # resume AFTER the last-served
+            i = tenants.index(last) + 1
+            tenants = tenants[i:] + tenants[:i]
+        out = []
+        while lanes:
+            for t in tenants:
+                lane = lanes.get(t)
+                if lane is None:
+                    continue
+                for _ in range(self.tenant_weights.get(t, 1)):
+                    if not lane:
+                        break
+                    out.append(lane.popleft())
+                if not lane:
+                    del lanes[t]
+        return out
+
     def admit(self, free_slots, now=None, fits=None):
-        """Pop up to free_slots admissible requests FCFS. Requests whose
+        """Pop up to free_slots admissible requests in admission order
+        (FCFS, or class-aware WFQ under ``priority``). Requests whose
         deadline already passed are popped, marked EXPIRED and returned
         separately (they never occupy a slot).
 
-        ``fits`` is the paged engine's page-aware admission predicate: the
-        queue head is admitted only when the page pool can hold its whole
+        ``fits`` is the paged engine's page-aware admission predicate: a
+        candidate is admitted only when the page pool can hold its whole
         lifetime (prompt + max_new_tokens, minus prefix-shared pages) —
-        admission is bounded by PAGES, not whole-Smax slots. A head that
-        doesn't fit STOPS admission (strict FCFS — no head-of-line bypass,
-        so admission order stays deterministic and starvation-free)."""
+        admission is bounded by PAGES, not whole-Smax slots. A candidate
+        that doesn't fit STOPS admission (strict in-order — no bypass, so
+        admission order stays deterministic and starvation-free; in
+        priority mode a stuck interactive head blocks batch behind it
+        rather than inverting priority)."""
         now = time.perf_counter() if now is None else now
         admitted, expired = [], []
-        while self._q and len(admitted) < free_slots:
-            req = self._q[0]
-            if req.state == FINISHED:
-                # cancelled while queued (e.g. mid-requeue race where the
-                # cancel lost the deque.remove): already resolved, skip
-                self._q.popleft()
-                continue
-            dl = req.deadline
-            if dl is not None and now > dl:
-                self._q.popleft()
-                req._finish(EXPIRED)
-                expired.append(req)
-                continue
-            if fits is not None and not fits(req):
-                break
+        if free_slots > 0:
+            for req in self._admission_order():
+                if len(admitted) >= free_slots:
+                    break
+                if req.expired(now):
+                    self._q.remove(req)
+                    req._finish(EXPIRED)
+                    expired.append(req)
+                    continue
+                if fits is not None and not fits(req):
+                    break
+                self._q.remove(req)
+                admitted.append(req)
+                if self.priority:
+                    self._wfq_last[req.class_rank] = req.tenant
+        while self._q and self._q[0].state == FINISHED:
+            # cancelled while queued (e.g. mid-requeue race where the
+            # cancel lost the deque.remove): already resolved, drop
             self._q.popleft()
-            admitted.append(req)
         return admitted, expired
+
+    # -- SLO policy hooks (priority / shedding) ------------------------------
+    def deadline_risk(self, now, margin):
+        """The queued request most entitled to preempt: unexpired, has a
+        deadline, and its slack (deadline - now) is within ``margin`` —
+        i.e. it will miss its deadline unless it is admitted about now.
+        Best class wins; earliest arrival breaks ties. None when nothing
+        is at risk."""
+        best = None
+        for r in self._q:
+            if r.state == FINISHED or r.deadline is None or r.expired(now):
+                continue
+            if r.deadline - now <= margin:
+                key = (r.class_rank, r.submit_t if r.submit_t is not None
+                       else float("inf"))
+                if best is None or key < best[0]:
+                    best = (key, r)
+        return None if best is None else best[1]
+
+    def shed(self, target_len, spare_rank=0):
+        """Shed queued work down to ``target_len`` live entries, lowest
+        class first and youngest arrival first within a class (the request
+        that would have been served LAST goes first — the oldest, best
+        work keeps its place). Requests of class rank <= ``spare_rank``
+        are never shed (interactive degrades via deadlines, not drops).
+        Shed requests are marked ``SHED`` and returned; the caller
+        attaches the retry-after hint and resolves them."""
+        live = [r for r in self._q if r.state != FINISHED]
+        excess = len(live) - max(0, int(target_len))
+        if excess <= 0:
+            return []
+        victims = sorted(
+            (r for r in live if r.class_rank > spare_rank),
+            key=lambda r: (-r.class_rank,
+                           -(r.submit_t if r.submit_t is not None else 0.0)))
+        shed = victims[:excess]
+        for req in shed:
+            self._q.remove(req)
+            req._finish(SHED)
+        return shed
 
     # -- snapshot ------------------------------------------------------------
     def drain_queue(self):

@@ -1,0 +1,228 @@
+"""SLO policy for multi-tenant serving: priority classes, load shedding,
+per-tenant rate limits and telemetry-driven autoscaling.
+
+Everything here is host-side POLICY over mechanisms earlier PRs built —
+class-aware admission and preemption ride the PR 7 requeue machinery
+(original arrival kept, replays bitwise), shedding and autoscaling read
+the PR 9 gauges (queue depth, slot occupancy, TTFT percentiles). No
+object in this module touches a traced operand or an executable: with the
+FLAGS_serving_priority_classes / FLAGS_serving_shed /
+FLAGS_serving_autoscale family off, the serving path is byte-identical to
+the pre-SLO engine.
+
+Priority classes
+----------------
+Three classes, best first::
+
+    interactive   rank 0   user-facing; may preempt lower classes
+    batch         rank 1   default; throughput traffic
+    best_effort   rank 2   preempted first, shed first
+
+Within a class, admission is weighted-fair across tenants (deficit
+round-robin over per-tenant FCFS queues) so one tenant's burst cannot
+starve another's steady trickle; across classes, admission is strictly
+best-class-first. A request never changes class after submit.
+"""
+from __future__ import annotations
+
+import time
+
+# rank order IS the policy order: lower rank = better class = admitted
+# first, preempted/shed last
+CLASSES = ("interactive", "batch", "best_effort")
+_RANK = {c: i for i, c in enumerate(CLASSES)}
+DEFAULT_CLASS = "batch"
+
+
+def class_rank(priority):
+    """Rank of a priority class (0 best). Raises on unknown classes so a
+    typo'd class fails at submit, not silently as best-effort."""
+    try:
+        return _RANK[priority]
+    except KeyError:
+        raise ValueError(
+            f"unknown priority class {priority!r}; expected one of "
+            f"{CLASSES}") from None
+
+
+class TokenBucket:
+    """Per-tenant token bucket: ``rate`` sustained requests/second with a
+    ``burst`` allowance. ``take()`` returns 0.0 when a token was consumed,
+    else the exact seconds until the next token accrues (the retry-after
+    hint a router hands back). Deterministic given a clock: tests drive it
+    with an explicit ``now``."""
+
+    def __init__(self, rate, burst):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._tokens = self.burst
+        self._t = None                      # lazily anchored to first take
+
+    def take(self, now=None):
+        now = time.perf_counter() if now is None else now
+        if self._t is None:
+            self._t = now
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._t) * self.rate)
+        self._t = now
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return 0.0
+        return (1.0 - self._tokens) / self.rate if self.rate > 0 else 1.0
+
+    def idle_full(self, now):
+        """True when the bucket has (or by ``now`` will have) refilled to
+        its burst — indistinguishable from a freshly-created one, so a
+        long-lived router can drop it from its per-tenant map."""
+        if self._t is None:
+            return True
+        return self._tokens + (now - self._t) * self.rate >= self.burst
+
+
+class DrainRate:
+    """EWMA of queue-drain throughput (requests resolved per second),
+    observed at step boundaries. Feeds the shed retry-after hint: a client
+    told to come back in ``excess / drain_rate`` seconds arrives when the
+    backlog has actually drained, instead of blind exponential backoff."""
+
+    def __init__(self, alpha=0.3):
+        self.alpha = float(alpha)
+        self.rate = None                    # requests / second
+        self._last_t = None
+        self._last_done = None
+
+    def observe(self, done_total, now=None):
+        now = time.perf_counter() if now is None else now
+        if self._last_t is not None:
+            dt = now - self._last_t
+            if dt > 0:
+                inst = max(0.0, done_total - self._last_done) / dt
+                self.rate = (inst if self.rate is None
+                             else self.alpha * inst
+                             + (1 - self.alpha) * self.rate)
+        self._last_t = now
+        self._last_done = done_total
+
+    def retry_after(self, excess, floor=0.05, ceil=60.0):
+        """Seconds until ``excess`` queued requests should have drained."""
+        if excess <= 0:
+            return floor
+        rate = self.rate if self.rate else 1.0
+        return float(min(ceil, max(floor, excess / rate)))
+
+
+class ShedPolicy:
+    """Sustained-overload detector with hysteresis: the queue must sit at
+    or above ``high`` for ``window`` CONSECUTIVE boundaries before
+    shedding starts (a burst the drain absorbs never sheds), and shedding
+    targets ``low`` so the fleet exits overload with headroom instead of
+    oscillating on the high watermark. ``shedding`` stays latched until
+    the queue next drops below ``low`` — while latched, new lowest-class
+    submissions are refused up front (ShedError) rather than queued and
+    shed a boundary later."""
+
+    def __init__(self, max_queue, high=0.75, low=0.5, window=4):
+        self.high = max(1, int(float(high) * max_queue))
+        self.low = int(float(low) * max_queue)
+        self.window = max(1, int(window))
+        self._over = 0
+        self.shedding = False
+        self.drain = DrainRate()
+
+    def observe(self, qsize, done_total, now=None):
+        """Record one boundary; returns the shed target (queue length to
+        shed down to) when shedding should happen NOW, else None."""
+        self.drain.observe(done_total, now)
+        if qsize >= self.high:
+            self._over += 1
+            if self._over >= self.window:
+                self.shedding = True
+                return self.low
+        else:
+            self._over = 0
+            # strict: shedding itself lands the queue AT `low`, which must
+            # not count as recovered — the latch holds until the backlog
+            # actually drains below the watermark (or empties)
+            if qsize < self.low or qsize == 0:
+                self.shedding = False
+        return None
+
+    def retry_after(self, qsize):
+        return self.drain.retry_after(qsize - self.low)
+
+
+class Autoscaler:
+    """Hysteresis + cooldown policy over the fleet gauges the PR 9
+    telemetry already exports: mean waiting requests per live replica,
+    mean slot occupancy, and (optionally) the ledger's TTFT p99 against an
+    SLO. ``decide()`` is pure policy — it returns "grow"/"shrink"/None and
+    the supervisor applies the action through its existing spawn/drain
+    machinery at a step boundary, so scaling can never tear an engine
+    mid-dispatch."""
+
+    def __init__(self, min_replicas=1, max_replicas=4, up_queue=4.0,
+                 down_queue=0.5, up_occupancy=0.9, down_occupancy=0.3,
+                 ttft_slo_s=0.0, window=4, cooldown_s=2.0):
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.up_queue = float(up_queue)
+        self.down_queue = float(down_queue)
+        self.up_occupancy = float(up_occupancy)
+        self.down_occupancy = float(down_occupancy)
+        self.ttft_slo_s = float(ttft_slo_s)
+        self.window = max(1, int(window))
+        self.cooldown_s = float(cooldown_s)
+        self._over = 0
+        self._under = 0
+        self._last_action_t = None
+        self.last_reason = None
+
+    def decide(self, alive, queue_depth, active_slots, total_slots,
+               ttft_p99=None, now=None):
+        """One evaluation: fleet-wide waiting requests, busy slots and
+        capacity, plus the live TTFT p99. Hysteresis counts consecutive
+        over/under evaluations separately; one boundary inside the dead
+        band resets both streaks."""
+        now = time.perf_counter() if now is None else now
+        if alive <= 0:
+            return None
+        per_rep = queue_depth / alive
+        occupancy = active_slots / total_slots if total_slots else 0.0
+        over = (per_rep >= self.up_queue or occupancy >= self.up_occupancy
+                or (self.ttft_slo_s > 0 and ttft_p99 is not None
+                    and ttft_p99 > self.ttft_slo_s))
+        under = (per_rep <= self.down_queue
+                 and occupancy <= self.down_occupancy)
+        self._over = self._over + 1 if over else 0
+        self._under = self._under + 1 if under else 0
+        if self._last_action_t is not None \
+                and now - self._last_action_t < self.cooldown_s:
+            return None
+        if self._over >= self.window and alive < self.max_replicas:
+            self._over = self._under = 0
+            self._last_action_t = now
+            self.last_reason = (f"queue/rep {per_rep:.1f} occ "
+                                f"{occupancy:.2f} ttft_p99 {ttft_p99}")
+            return "grow"
+        if self._under >= self.window and alive > self.min_replicas:
+            self._over = self._under = 0
+            self._last_action_t = now
+            self.last_reason = (f"queue/rep {per_rep:.1f} occ "
+                                f"{occupancy:.2f}")
+            return "shrink"
+        return None
+
+    @classmethod
+    def from_flags(cls, flags):
+        return cls(
+            min_replicas=flags.get("FLAGS_serving_min_replicas", 1),
+            max_replicas=flags.get("FLAGS_serving_max_replicas", 4),
+            up_queue=flags.get("FLAGS_serving_autoscale_up_queue", 4.0),
+            down_queue=flags.get("FLAGS_serving_autoscale_down_queue", 0.5),
+            up_occupancy=flags.get(
+                "FLAGS_serving_autoscale_up_occupancy", 0.9),
+            down_occupancy=flags.get(
+                "FLAGS_serving_autoscale_down_occupancy", 0.3),
+            ttft_slo_s=flags.get("FLAGS_serving_autoscale_ttft_slo", 0.0),
+            window=flags.get("FLAGS_serving_autoscale_window", 4),
+            cooldown_s=flags.get("FLAGS_serving_autoscale_cooldown_s", 2.0))

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 from ..flags import get_flags
 from ..observability import register_supervisor
@@ -41,7 +42,8 @@ from ..utils.fault_injection import Preemption
 from . import metrics
 from .engine import EngineStoppedError
 from .request import CANCELLED, DROPPED, FINISHED, Request
-from .scheduler import QueueFullError
+from .scheduler import QueueFullError, ShedError
+from .slo import Autoscaler, TokenBucket
 
 
 class _Replica:
@@ -53,9 +55,20 @@ class _Replica:
         self.mgr = mgr              # persistent CheckpointManager or None
         self.hb = hb                # persistent Heartbeat or None
         self.engine = None
-        self.state = "down"         # "up" | "down"
+        # "up" | "down" | "draining" (rolling restart mid-drain: alive but
+        # UNROUTABLE — submit/spill/replay must not target it) | "retired"
+        # (scaled down: permanently out of rotation, indices stay stable)
+        self.state = "down"
         self.restarts = 0
         self.last_error = None
+
+    @property
+    def routable(self):
+        """Safe as a routing/replay target: up AND its engine accepts
+        work (a drained engine raises EngineStoppedError on submit even
+        while the replica object still says "up")."""
+        return (self.state == "up" and self.engine is not None
+                and not self.engine.stopped)
 
     @property
     def load(self):
@@ -84,13 +97,43 @@ class ServingSupervisor:
 
     def __init__(self, engine_factory, num_replicas=2, *, snapshot_dir=None,
                  snapshot_every=None, max_restarts=None, heartbeat_dir=None,
-                 heartbeat_timeout=None):
+                 heartbeat_timeout=None, autoscale=None, tenant_rate=None,
+                 tenant_burst=None):
         flags = get_flags()
         self.engine_factory = engine_factory
         self.snapshot_every = snapshot_every
         self.max_restarts = int(
             max_restarts if max_restarts is not None
             else flags.get("FLAGS_serving_max_restarts", 3))
+        # stored so autoscale-grown replicas get the same snapshot/
+        # heartbeat wiring the constructor-built ones did
+        self._snapshot_dir = snapshot_dir
+        self._heartbeat_dir = heartbeat_dir
+        self._heartbeat_timeout = heartbeat_timeout
+        # hot-swap state: once rolling_restart(new_params=) upgrades the
+        # fleet, EVERY later spawn (respawn-after-crash, autoscale grow)
+        # serves the new weights — a crash must not resurrect old ones
+        self._live_params = None          # (params_tree, version) or None
+        self._upgrading = False           # inside rolling_restart(new_params)
+        # per-tenant token buckets at the router (ShedError over-rate)
+        rate = (flags.get("FLAGS_serving_tenant_rate", 0.0)
+                if tenant_rate is None else tenant_rate)
+        burst = (flags.get("FLAGS_serving_tenant_burst", 8)
+                 if tenant_burst is None else tenant_burst)
+        self._tenant_rate = float(rate)
+        self._tenant_burst = float(burst)
+        self._buckets = {}                # tenant -> TokenBucket
+        # telemetry-driven autoscaling (policy: serving/slo.py Autoscaler;
+        # actions ride the existing spawn/drain machinery and are applied
+        # on the supervising thread at step boundaries only)
+        if autoscale is None:
+            autoscale = bool(flags.get("FLAGS_serving_autoscale", False))
+        if isinstance(autoscale, Autoscaler):
+            self.autoscaler = autoscale
+        elif autoscale:
+            self.autoscaler = Autoscaler.from_flags(flags)
+        else:
+            self.autoscaler = None
         # One RLock guards the shared TRACKING state (requests/owner/
         # results/delivered) — the same discipline as the serving metrics
         # ledger's module lock — so monitoring threads (telemetry()
@@ -106,34 +149,56 @@ class ServingSupervisor:
         self._delivered = set()      # popped rids: dedup survives pop_results
         self._replicas = []
         for i in range(int(num_replicas)):
-            mgr = None
-            if snapshot_dir is not None:
-                mgr = CheckpointManager(
-                    os.path.join(os.fspath(snapshot_dir), f"replica_{i}"),
-                    async_save=False, site="serving_snapshot")
-            hb = None
-            if heartbeat_dir is not None:
-                hb = Heartbeat(heartbeat_dir, rank=i)
-            rep = _Replica(i, mgr, hb)
-            rep.engine = self._spawn_engine(rep)
-            rep.state = "up"
-            if hb is not None:
-                hb.beat()
-            self._replicas.append(rep)
+            self._replicas.append(self._new_replica(i))
         self.monitor = None
-        if heartbeat_dir is not None:
-            timeout = (heartbeat_timeout if heartbeat_timeout is not None
-                       else flags.get("FLAGS_serving_heartbeat_timeout", 10.0))
-            self.monitor = HeartbeatMonitor(heartbeat_dir,
-                                            world_size=int(num_replicas),
-                                            timeout=float(timeout))
+        self._remake_monitor()
         # live per-replica gauges in the metrics registry ("supervisor"
         # family; weakly referenced — dies with this object)
         register_supervisor(self)
 
+    def _new_replica(self, i):
+        """Build replica slot ``i`` (constructor AND autoscale-grow path):
+        persistent snapshot manager + heartbeat, engine spawned up."""
+        mgr = None
+        if self._snapshot_dir is not None:
+            mgr = CheckpointManager(
+                os.path.join(os.fspath(self._snapshot_dir), f"replica_{i}"),
+                async_save=False, site="serving_snapshot")
+        hb = None
+        if self._heartbeat_dir is not None:
+            hb = Heartbeat(self._heartbeat_dir, rank=i)
+        rep = _Replica(i, mgr, hb)
+        rep.engine = self._spawn_engine(rep)
+        rep.state = "up"
+        if hb is not None:
+            hb.beat()
+        return rep
+
+    def _remake_monitor(self):
+        """(Re)build the heartbeat monitor over the CURRENT replica count
+        — called at construction and after an autoscale grow, so new
+        replicas are liveness-checked too."""
+        if self._heartbeat_dir is None:
+            return
+        timeout = (self._heartbeat_timeout
+                   if self._heartbeat_timeout is not None
+                   else get_flags().get("FLAGS_serving_heartbeat_timeout",
+                                        10.0))
+        self.monitor = HeartbeatMonitor(self._heartbeat_dir,
+                                        world_size=len(self._replicas),
+                                        timeout=float(timeout))
+
     def _spawn_engine(self, rep):
         eng = self.engine_factory()
         eng.tag = f"replica{rep.idx}"
+        if self._live_params is not None:
+            # the fleet was hot-upgraded: every spawn — crash respawn,
+            # rolling restart, autoscale grow — serves the LIVE weights.
+            # Only the upgrade itself counts as a weight swap; later
+            # re-applications on respawn/grow are not new swaps
+            params, version = self._live_params
+            eng.swap_params(params, version=version,
+                            count=self._upgrading)
         if rep.mgr is not None:
             eng.attach_checkpoint(rep.mgr, every=self.snapshot_every)
         return eng
@@ -142,38 +207,113 @@ class ServingSupervisor:
     def _up(self):
         return [r for r in self._replicas if r.state == "up"]
 
+    def _routable(self):
+        """Replicas that may receive NEW or replayed work: up and not
+        mid-drain (a rolling restart marks the replica "draining" and its
+        engine refuses submissions — routing there used to slip through
+        because the spill check only compared queue depth)."""
+        return [r for r in self._replicas if r.routable]
+
     def _pick(self, exclude=None):
-        ups = [r for r in self._up() if r is not exclude]
+        ups = [r for r in self._routable() if r is not exclude]
         if not ups:
             return None
         return min(ups, key=lambda r: (r.load, r.idx))
 
+    def _rate_limit(self, request):
+        """Per-tenant token bucket at the router: over-rate submissions
+        are refused with ``ShedError`` carrying the exact time until the
+        tenant's next token accrues — tenant isolation BEFORE the queues,
+        so one tenant's flood cannot fill every replica's queue and starve
+        the others into QueueFullError."""
+        if self._tenant_rate <= 0:
+            return
+        with self._lock:
+            # bucket creation AND take under the supervisor lock: router
+            # threads submit concurrently (the documented concurrency
+            # surface), and an unlocked read-modify-write of the token
+            # count would let a tenant exceed rate*t + burst
+            bucket = self._buckets.get(request.tenant)
+            if bucket is None:
+                bucket = self._buckets[request.tenant] = TokenBucket(
+                    self._tenant_rate, self._tenant_burst)
+            wait = bucket.take()
+            if len(self._buckets) > 1024:
+                # tenant ids are client-supplied strings: without a sweep
+                # a rotating/adversarial id stream grows the map forever.
+                # A refilled-to-burst bucket is indistinguishable from a
+                # fresh one, so dropping it changes no admission decision.
+                now = time.perf_counter()
+                for t, b in list(self._buckets.items()):
+                    if b is not bucket and b.idle_full(now):
+                        del self._buckets[t]
+        if wait > 0:
+            metrics.bump("rate_limited")
+            raise ShedError(
+                f"tenant {request.tenant!r} over rate limit "
+                f"({self._tenant_rate:.1f} req/s, burst "
+                f"{self._tenant_burst:.0f}); retry in ~{wait:.2f}s",
+                qsize=self.fleet_queue_depth(),
+                max_queue=self.fleet_max_queue(), retry_after=wait)
+
+    def fleet_queue_depth(self):
+        return sum(r.engine.queue_depth for r in self._replicas
+                   if r.engine is not None)
+
+    def fleet_max_queue(self):
+        return sum(r.engine.scheduler.max_queue for r in self._routable())
+
     def submit(self, request):
-        """Route a request to the least-loaded live replica (spilling to
-        the next when its queue is full; ``QueueFullError`` — with its
-        ``qsize``/``max_queue`` back-off hints — only once EVERY replica
-        is saturated). Raises ``EngineStoppedError`` when no replica is
-        up."""
+        """Route a request to the least-loaded routable replica (spilling
+        to the next when its queue is full; ``QueueFullError`` — with
+        FLEET-WIDE ``qsize``/``max_queue`` totals as its back-off hints —
+        only once EVERY replica is saturated). Draining/stopped replicas
+        are never targeted. Raises ``EngineStoppedError`` when no replica
+        is routable, ``ShedError`` when the tenant is over its rate
+        limit."""
         if not isinstance(request, Request):
             request = Request(request)
-        ups = sorted(self._up(), key=lambda r: (r.load, r.idx))
+        ups = sorted(self._routable(), key=lambda r: (r.load, r.idx))
         if not ups:
             raise EngineStoppedError(
                 "no live serving replica", queue_depth=0, requeued=())
+        self._rate_limit(request)
+        shedding = []
         for rep in ups:
-            # saturation probe, not a trial submit: a failed Engine.submit
-            # bumps the global submitted/rejected ledger, so spilling by
-            # try/except would count one logical request once per full
-            # replica and skew the SLO surface
+            # saturation probes, not trial submits: a failed Engine.submit
+            # bumps the global submitted/rejected/shed ledger, so spilling
+            # by try/except would count one logical request once per full
+            # (or shed-latched) replica and skew the SLO surface. Shed
+            # state is PER-ENGINE — a replica latched in overload is
+            # skipped and the request spills to a healthy one.
+            shed = rep.engine._shed
+            if shed is not None and shed.shedding \
+                    and request.class_rank >= 2:
+                shedding.append(rep)
+                continue
             if rep.engine.queue_depth < rep.engine.scheduler.max_queue:
                 rep.engine.submit(request)
                 break
         else:
-            full = ups[0].engine
+            # fleet-wide totals: the backoff a client derives from the
+            # hint must reflect every queue it competes with, not whatever
+            # replica happened to be probed last
+            qsize, cap = self.fleet_queue_depth(), self.fleet_max_queue()
+            if shedding:
+                # every candidate was latched or full: refuse with the
+                # largest (most honest) drain hint across latched replicas
+                metrics.bump("shed")
+                raise ShedError(
+                    f"shedding {request.priority} traffic fleet-wide "
+                    f"({qsize}/{cap} waiting); retry later",
+                    qsize=qsize, max_queue=cap,
+                    retry_after=max(
+                        r.engine._shed.retry_after(r.engine.queue_depth)
+                        for r in shedding))
             raise QueueFullError(
-                f"all {len(ups)} replica queues full "
-                f"({full.scheduler.max_queue} each); retry later",
-                qsize=full.queue_depth, max_queue=full.scheduler.max_queue)
+                f"all {len(ups)} replica queues full ({qsize}/{cap} "
+                f"waiting fleet-wide); retry later",
+                qsize=qsize, max_queue=cap)
         with self._lock:
             self._requests[request.request_id] = request
             self._owner[request.request_id] = rep.idx
@@ -237,7 +377,65 @@ class ServingSupervisor:
                     metrics.bump("stale_failovers")
                     self._on_failure(rep, RuntimeError(
                         f"stale heartbeat (replica {rank})"))
+        if self.autoscaler is not None:
+            self._autoscale_step()
         return self.pending() > 0
+
+    # -- telemetry-driven autoscaling ----------------------------------------
+    def _autoscale_step(self):
+        """Evaluate the autoscale policy against the live fleet gauges
+        (queue depth, slot occupancy, TTFT p99 — the PR 9 surface) and
+        apply at most one action. Runs on the supervising thread at a step
+        boundary, so growth/shrink can never tear an engine mid-dispatch;
+        hysteresis windows and the cooldown live in the policy object."""
+        ups = self._up()
+        action = self.autoscaler.decide(
+            alive=len(ups),
+            queue_depth=sum(r.engine.queue_depth for r in ups),
+            active_slots=sum(r.engine.active_slots for r in ups),
+            total_slots=sum(r.engine.num_slots for r in ups),
+            ttft_p99=metrics.recent_ttft_p99())
+        if action == "grow":
+            self._grow_replica()
+        elif action == "shrink":
+            self._shrink_replica()
+
+    def _grow_replica(self):
+        """Scale up: append a fresh replica (same snapshot/heartbeat
+        wiring, live weights) and extend the liveness monitor over it."""
+        rep = self._new_replica(len(self._replicas))
+        self._replicas.append(rep)
+        self._remake_monitor()
+        metrics.bump("scale_ups")
+
+    def _shrink_replica(self):
+        """Scale down: drain the least-loaded replica (its in-flight work
+        requeued on the survivors with ORIGINAL arrival — the rolling-
+        restart machinery, zero drops) and retire the slot. Indices stay
+        stable, so owner bookkeeping and heartbeat ranks never shift."""
+        ups = self._up()
+        if len(ups) <= 1:
+            return
+        rep = min(ups, key=lambda r: (r.load, -r.idx))
+        rep.state = "draining"
+        drained = rep.engine.drain()
+        self._collect(rep)
+        rep.engine = None
+        rep.state = "retired"
+        if rep.hb is not None:
+            rep.hb.beat(status="stopped")
+        for req in drained:
+            if req.state == FINISHED:
+                continue
+            target = self._pick()
+            if target is None:          # should not happen (len(ups) > 1)
+                rep.engine = self._spawn_engine(rep)
+                rep.state = "up"
+                target = rep
+            target.engine.requeue(req)
+            with self._lock:
+                self._owner[req.request_id] = target.idx
+        metrics.bump("scale_downs")
 
     def _collect(self, rep):
         popped = rep.engine.pop_results()
@@ -352,31 +550,75 @@ class ServingSupervisor:
             metrics.bump("replayed")
 
     # -- lifecycle -----------------------------------------------------------
-    def rolling_restart(self, absorb_steps=2):
-        """Restart the fleet one replica at a time with zero drops: drain
-        a replica (in-flight requeued, original arrival kept), hand its
-        work to the survivors, respawn it FRESH, then run a few
-        supervision rounds so the fleet absorbs before the next drain."""
+    def _requeue_target(self, req, exclude=None):
+        """Requeue target for a drained request: least-loaded routable
+        replica, PREFERRING one that serves the weight version the request
+        already produced tokens under — during a hot upgrade, in-flight
+        work finishes on the version it started on as long as any replica
+        of that version survives (only the final drain of the old fleet
+        recomputes on the new version, from scratch, so every result is
+        single-version consistent either way)."""
+        ups = [r for r in self._routable() if r is not exclude]
+        if not ups:
+            return None
+        if req.params_version is not None:
+            same = [r for r in ups
+                    if r.engine.params_version == req.params_version]
+            if same:
+                ups = same
+        return min(ups, key=lambda r: (r.load, r.idx))
+
+    def rolling_restart(self, absorb_steps=2, new_params=None,
+                        params_version=None):
+        """Restart the fleet one replica at a time with zero drops: mark
+        a replica DRAINING (unroutable — new submissions and replays go
+        elsewhere), drain it (in-flight requeued, original arrival kept),
+        hand its work to the survivors, respawn it FRESH, then run a few
+        supervision rounds so the fleet absorbs before the next drain.
+
+        ``new_params`` turns the restart into a ZERO-DOWNTIME WEIGHT
+        UPGRADE: each respawned replica comes back serving the new tree
+        (``Engine.swap_params`` — same-shape, builders memoized per
+        config, so no retrace), stamped ``params_version`` (default: one
+        past the fleet's current version). Snapshots carry the version, so
+        a crash-respawn can never resume new-version requests from an
+        old-version snapshot's KV (the meta mismatch falls back to replay
+        — still zero drops); results carry the version their tokens were
+        produced under; and drained in-flight requests prefer surviving
+        OLD-version replicas, finishing on the version they started on
+        whenever one exists."""
         metrics.bump("rolling_restarts")
-        for rep in list(self._replicas):
-            if rep.state != "up":
-                continue
-            drained = rep.engine.drain()
-            self._collect(rep)
-            rep.engine = self._spawn_engine(rep)
-            rep.restarts = 0           # a planned restart is not a failure
-            metrics.bump("respawns")
-            if rep.hb is not None:
-                rep.hb.beat(status="running")
-            for req in drained:
-                if req.state == FINISHED:
-                    continue           # cancelled mid-requeue: already done
-                target = self._pick(exclude=rep) or rep
-                target.engine.requeue(req)
-                with self._lock:
-                    self._owner[req.request_id] = target.idx
-            for _ in range(max(0, int(absorb_steps))):
-                self.step()
+        if new_params is not None:
+            if params_version is None:
+                versions = [r.engine.params_version for r in self._replicas
+                            if r.engine is not None]
+                params_version = max(versions, default=0) + 1
+            self._live_params = (new_params, int(params_version))
+            self._upgrading = True
+        try:
+            for rep in list(self._replicas):
+                if rep.state != "up":
+                    continue
+                rep.state = "draining"  # unroutable while its queue moves
+                drained = rep.engine.drain()
+                self._collect(rep)
+                rep.engine = self._spawn_engine(rep)
+                rep.restarts = 0        # a planned restart is not a failure
+                rep.state = "up"
+                metrics.bump("respawns")
+                if rep.hb is not None:
+                    rep.hb.beat(status="running")
+                for req in drained:
+                    if req.state == FINISHED:
+                        continue        # cancelled mid-requeue: done already
+                    target = self._requeue_target(req, exclude=rep) or rep
+                    target.engine.requeue(req)
+                    with self._lock:
+                        self._owner[req.request_id] = target.idx
+                for _ in range(max(0, int(absorb_steps))):
+                    self.step()
+        finally:
+            self._upgrading = False
 
     def pending(self):
         """Requests submitted but not yet delivered."""
@@ -447,14 +689,19 @@ class ServingSupervisor:
         fleet-level pending count."""
         out = {"replicas": len(self._replicas),
                "alive": len(self._up()),
-               "pending": self.pending()}
+               "pending": self.pending(),
+               "params_version": (self._live_params[1]
+                                  if self._live_params is not None else 0)}
         for rep in self._replicas:
             eng = rep.engine
             out[f"replica{rep.idx}"] = {
                 "up": int(rep.state == "up"),
+                "state": rep.state,
                 "restarts": int(rep.restarts),
                 "queue_depth": (0 if eng is None else eng.queue_depth),
                 "active_slots": (0 if eng is None else eng.active_slots),
                 "step_count": (0 if eng is None else eng._step_count),
+                "params_version": (0 if eng is None
+                                   else int(eng.params_version)),
             }
         return out

@@ -40,6 +40,14 @@ Serving chaos (the self-healing serving ladder):
                           silently dropped (frozen-process simulation): the
                           process looks alive, its heartbeat file goes
                           stale, and the monitor must report it failed.
+  * ``surge``             — an ``ArrivalSurge``: a deterministic per-step
+                          arrival-count schedule (seeded Poisson base rate
+                          with a surge window at a multiplied rate). The
+                          traffic driver polls ``surge_arrivals(step)`` at
+                          each boundary and submits that many requests —
+                          reproducible overload for the SLO chaos ladder
+                          (shed/recover, upgrade-under-load, kill-during-
+                          surge) without wall-clock flakiness.
 
 All hooks are host-side and zero-cost when no plan is active (one
 attribute check), and never touch a compiled executable.
@@ -56,13 +64,55 @@ class Preemption(BaseException):
     retrain."""
 
 
+class ArrivalSurge:
+    """Deterministic arrival-count schedule for serving chaos: a seeded
+    Poisson stream at ``base_rate`` arrivals/step, multiplied to
+    ``surge_rate`` over ``[surge_start, surge_start + surge_steps)``. The
+    whole schedule is materialized once from the seed, so two runs of the
+    same ladder see IDENTICAL traffic step for step — surges are
+    reproducible, never wall-clock-dependent. Host-side only; the plan
+    hook ``surge_arrivals`` costs one attribute check when inactive."""
+
+    def __init__(self, base_rate=0.5, surge_rate=4.0, surge_start=8,
+                 surge_steps=16, total_steps=256, seed=0):
+        self.base_rate = float(base_rate)
+        self.surge_rate = float(surge_rate)
+        self.surge_start = int(surge_start)
+        self.surge_steps = int(surge_steps)
+        self.total_steps = int(total_steps)
+        self.seed = int(seed)
+        rng = np.random.default_rng(self.seed)
+        rates = np.full(self.total_steps, self.base_rate)
+        rates[self.surge_start:self.surge_start + self.surge_steps] = \
+            self.surge_rate
+        self.counts = rng.poisson(rates).astype(np.int64)
+
+    def arrivals(self, step):
+        """Arrival count at ``step`` (0 past the schedule's end)."""
+        step = int(step)
+        if 0 <= step < self.total_steps:
+            return int(self.counts[step])
+        return 0
+
+    def in_surge(self, step):
+        return self.surge_start <= int(step) < \
+            self.surge_start + self.surge_steps
+
+    def __repr__(self):
+        return (f"ArrivalSurge(base_rate={self.base_rate}, "
+                f"surge_rate={self.surge_rate}, "
+                f"surge_start={self.surge_start}, "
+                f"surge_steps={self.surge_steps}, "
+                f"total_steps={self.total_steps}, seed={self.seed})")
+
+
 class FaultPlan:
     """Deterministic schedule of injected faults."""
 
     def __init__(self, nan_at_steps=(), io_error_on_writes=(),
                  preempt_at_step=None, kill_at_decode_step=None,
                  kill_engine_tag=None, io_error_on_snapshots=(),
-                 stale_heartbeat_ranks=()):
+                 stale_heartbeat_ranks=(), surge=None):
         self.nan_at_steps = frozenset(int(s) for s in nan_at_steps)
         self.io_error_on_writes = frozenset(int(n) for n in io_error_on_writes)
         self.preempt_at_step = (None if preempt_at_step is None
@@ -75,6 +125,7 @@ class FaultPlan:
             int(n) for n in io_error_on_snapshots)
         self.stale_heartbeat_ranks = frozenset(
             int(r) for r in stale_heartbeat_ranks)
+        self.surge = surge
         # one-shot: a respawned/replayed engine re-walks the same step
         # indices — re-firing the kill would loop the recovery forever
         self._kill_fired = False
@@ -82,7 +133,7 @@ class FaultPlan:
         self.stats = {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
                       "writes_seen": 0, "serving_kills": 0,
                       "snapshot_writes_seen": 0, "snapshot_io_errors": 0,
-                      "heartbeats_dropped": 0}
+                      "heartbeats_dropped": 0, "surged_arrivals": 0}
 
     def __repr__(self):
         return (f"FaultPlan(nan_at_steps={sorted(self.nan_at_steps)}, "
@@ -91,7 +142,8 @@ class FaultPlan:
                 f"kill_at_decode_step={self.kill_at_decode_step}, "
                 f"kill_engine_tag={self.kill_engine_tag!r}, "
                 f"io_error_on_snapshots={sorted(self.io_error_on_snapshots)}, "
-                f"stale_heartbeat_ranks={sorted(self.stale_heartbeat_ranks)})")
+                f"stale_heartbeat_ranks={sorted(self.stale_heartbeat_ranks)}, "
+                f"surge={self.surge!r})")
 
 
 _plan: FaultPlan | None = None
@@ -199,6 +251,18 @@ def maybe_kill_serving(tag, decode_step):
             f"simulated engine kill ({tag}) at decode step {decode_step}")
 
 
+def surge_arrivals(step):
+    """Arrival count the active plan's surge schedules at ``step`` (0 when
+    no plan / no surge is active). Traffic drivers (the SLO chaos ladder,
+    load tests) poll this at every step boundary and submit that many
+    requests — deterministic overload, zero cost when inactive."""
+    if _plan is None or _plan.surge is None:
+        return 0
+    n = _plan.surge.arrivals(step)
+    _plan.stats["surged_arrivals"] += n
+    return n
+
+
 def maybe_drop_heartbeat(rank):
     """Called by ``Heartbeat.beat()``: True when the plan freezes this
     rank's heartbeats (the beat is silently skipped, the file goes stale)."""
@@ -215,5 +279,5 @@ def stats():
         return {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
                 "writes_seen": 0, "serving_kills": 0,
                 "snapshot_writes_seen": 0, "snapshot_io_errors": 0,
-                "heartbeats_dropped": 0}
+                "heartbeats_dropped": 0, "surged_arrivals": 0}
     return dict(plan.stats)

@@ -702,7 +702,10 @@ def test_supervisor_spill_does_not_inflate_ledger():
     sup.submit(serving.Request(np.arange(2, 6), max_new_tokens=2))
     with pytest.raises(serving.QueueFullError) as ei:
         sup.submit(serving.Request(np.arange(3, 7), max_new_tokens=2))
-    assert ei.value.max_queue == 1
+    # backoff hints are FLEET-WIDE totals (every queue the client competes
+    # with), not whichever replica was probed last
+    assert ei.value.qsize == 2
+    assert ei.value.max_queue == 2
     c = profiler.serving_counters()
     assert c["submitted"] == 2             # the accepted ones only
     assert c["rejected"] == 0              # saturation probed, not trialed
@@ -728,6 +731,54 @@ def test_requeued_request_contributes_one_ttft_sample():
         dst.requeue(q)
     dst.run()
     assert len(smetrics._ttft) == 1        # no duplicate from the replay
+
+
+def test_rolling_restart_sustained_mixed_traffic(ckpt_dir):
+    """rolling_restart under SUSTAINED mixed greedy+sampled traffic (new
+    arrivals keep landing while each replica drains): zero drops, every
+    stream bitwise — including requests admitted on the surviving
+    neighbor while the other replica drained (neighbor stability) — and
+    exactly ONE TTFT histogram sample per unique request despite the
+    drain/requeue round trips (extends the PR 7/9 counter-lifecycle
+    gates)."""
+    profiler.reset_serving_counters()
+    from paddle_tpu.serving import metrics as smetrics
+
+    sup = ServingSupervisor(
+        lambda: _engine("paged", max_queue=64), num_replicas=2,
+        snapshot_dir=ckpt_dir)
+    rng = np.random.default_rng(23)
+    reqs, i = [], 0
+
+    def arrive(n):
+        nonlocal i
+        for _ in range(n):
+            kw = _sampled_kw(i) if i % 2 else {}
+            r = serving.Request(rng.integers(0, 97, 5 + (i % 4) * 2),
+                                max_new_tokens=4 + i % 3, **kw)
+            sup.submit(r)
+            reqs.append(r)
+            i += 1
+
+    arrive(6)
+    for _ in range(3):
+        sup.step()
+    arrive(4)                                 # traffic keeps flowing...
+    sup.rolling_restart(absorb_steps=1)       # ...through the restart
+    arrive(4)
+    results = sup.run()
+    gold = _golden(reqs)
+    assert len(results) == len(reqs)
+    for r in reqs:
+        assert results[r.request_id].tokens == gold[r.request_id], \
+            f"request {r.request_id} diverged across the rolling restart"
+        assert results[r.request_id].finish_reason in ("stop", "length")
+    c = profiler.serving_counters()
+    assert c["dropped"] == 0
+    assert c["rolling_restarts"] == 1
+    assert c["requeued"] > 0                  # the restart DID disrupt work
+    # one TTFT sample per unique request: requeues must not double-count
+    assert len(smetrics._ttft) == len(reqs)
 
 
 def _load_smoke():
