@@ -23,8 +23,50 @@ import os
 import threading
 import time
 
+import numpy as np
 import jax
 import jax.numpy as jnp
+
+
+# -- elastic counters (observability "elastic" family) -----------------------
+# The mesh-reforming supervisor's event ledger: shrinks/grows/reforms,
+# snapshot restores it performed, resume latency, and live gauges (active
+# dp, world size, failed ranks). Merged with the reshard-on-load counters
+# (distributed/topology.py) into the registry's "elastic" family, so every
+# event is visible in one snapshot and on the Prometheus endpoint.
+
+_elastic_lock = threading.Lock()
+
+
+def _zero_elastic():
+    return {"shrinks": 0, "grows": 0, "reforms": 0, "elastic_restores": 0,
+            "steps_lost": 0, "resume_latency_s_last": 0.0,
+            "resume_latency_s_total": 0.0, "active_dp": 0, "world_size": 0,
+            "failed_ranks": 0}
+
+
+_elastic_counters = _zero_elastic()
+
+
+def elastic_counters():
+    with _elastic_lock:
+        return dict(_elastic_counters)
+
+
+def reset_elastic_counters():
+    global _elastic_counters
+    with _elastic_lock:
+        _elastic_counters = _zero_elastic()
+
+
+def _ecount(key, n=1):
+    with _elastic_lock:
+        _elastic_counters[key] += n
+
+
+def _egauge(key, v):
+    with _elastic_lock:
+        _elastic_counters[key] = v
 
 
 class NonFiniteError(RuntimeError):
@@ -140,31 +182,61 @@ class Heartbeat:
 
 
 class HeartbeatMonitor:
-    """Watches heartbeat files for ``world_size`` ranks."""
+    """Watches heartbeat files for a SET of ranks (default: ``0 ..
+    world_size-1``). The watched set is mutable — ``resize()`` /
+    ``set_ranks()`` — because an elastic mesh changes shape at runtime: a
+    monitor pinned to its construction-time world would report the
+    retired ranks of a shrunk mesh as failed forever (and never watch the
+    ranks a grow adds)."""
 
     def __init__(self, directory, world_size, timeout=10.0):
         self.directory = os.fspath(directory)
-        self.world_size = int(world_size)
+        self.ranks = tuple(range(int(world_size)))
         self.timeout = float(timeout)
 
-    def poll(self):
-        """Return {rank: info|None} — None means no heartbeat file yet."""
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @world_size.setter
+    def world_size(self, n):  # legacy assignment keeps working
+        self.ranks = tuple(range(int(n)))
+
+    def resize(self, world_size):
+        """Watch ranks ``0 .. world_size-1`` (a grown/shrunk contiguous
+        world)."""
+        self.world_size = int(world_size)
+        return self
+
+    def set_ranks(self, ranks):
+        """Watch exactly ``ranks`` (a re-formed mesh's surviving rank set —
+        possibly non-contiguous after a mid-world chip loss). Retired
+        ranks leave the watch set, so ``failed_ranks()`` stays consistent
+        with the CURRENT mesh instead of flagging them forever."""
+        self.ranks = tuple(sorted(int(r) for r in ranks))
+        return self
+
+    def poll(self, ranks=None):
+        """Return {rank: info|None} — None means no heartbeat file yet.
+        ``ranks`` overrides the watched set for one poll (e.g. probing
+        whether RETIRED ranks have come back, without re-admitting them
+        to failure detection)."""
         out = {}
-        for r in range(self.world_size):
-            path = os.path.join(self.directory, f"hb_{r}.json")
+        for r in (self.ranks if ranks is None else ranks):
+            path = os.path.join(self.directory, f"hb_{int(r)}.json")
             try:
                 with open(path) as f:
                     info = json.load(f)
                 info["age"] = time.time() - info["ts"]
-                out[r] = info
+                out[int(r)] = info
             except (OSError, ValueError):
-                out[r] = None
+                out[int(r)] = None
         return out
 
-    def failed_ranks(self):
+    def failed_ranks(self, ranks=None):
         """Ranks that are missing, stale past timeout, or marked failed."""
         bad = []
-        for r, info in self.poll().items():
+        for r, info in self.poll(ranks).items():
             if info is None or info["age"] > self.timeout \
                     or info.get("status") == "failed":
                 bad.append(r)
@@ -241,3 +313,232 @@ class ElasticAgent:
                         f"elastic: giving up after {self.restarts - 1} restarts") from e
                 if self.on_restart is not None:
                     self.on_restart(self.restarts, e)
+
+
+class ElasticMeshSupervisor:
+    """Mesh-reforming elastic training: survive chip/rank loss by
+    re-forming the largest viable mesh from the survivors and resuming
+    from the latest good snapshot through the reshard-on-load path.
+
+    ``ElasticAgent`` restarts the SAME-shaped job; this supervisor closes
+    the remaining gap — on TPU pods the thing that actually disappears is
+    a host with its chips, and the job that comes back is SMALLER. Per
+    step boundary it:
+
+      1. **detects** rank loss: the deterministic chip-loss schedule
+         (``utils.fault_injection.lost_ranks`` — injected device failure)
+         and, with ``heartbeat_dir`` set, ranks whose heartbeat files went
+         stale past ``heartbeat_timeout`` (a frozen host looks exactly
+         like this). Retired ranks are probed for RETURN the same way
+         (fresh heartbeats / ``chip_return_at``), so the mesh grows back;
+      2. **re-forms** the mesh: the largest dp with ``min_dp <= dp <=
+         survivors`` that divides ``global_batch`` (the global batch must
+         still shard evenly), over the surviving devices;
+      3. **rebuilds** the TrainStep through ``step_factory(mesh)`` —
+         memoized per (dp, device-set), so growing back to a topology
+         seen before reuses its compiled executables;
+      4. **resumes** from ``ckpt.restore(None)``: the packed dp-sharded
+         optimizer slots reshard to the new axis size on load
+         (distributed/topology.py), the RNG stream and data position
+         continue in global terms, and training re-serves the batches
+         after the snapshot — zero manual steps from kill to progress.
+
+    Every event (shrink/grow/reform, restore, resume latency, steps
+    re-executed) lands in ``elastic_counters()`` → the observability
+    registry's "elastic" family → the Prometheus endpoint.
+
+    Single-process notes: with ``heartbeat_dir`` set the supervisor also
+    BEATS for every world rank each boundary — the single-controller
+    simulation of per-host heartbeat daemons (the fault plan's
+    ``stale_heartbeat_ranks`` freezes individual ranks). On a real
+    multi-host pod each host runs its own ``Heartbeat``; only the
+    monitoring half applies.
+    """
+
+    def __init__(self, step_factory, ckpt, global_batch, devices=None,
+                 save_every=None, min_dp=None, grow=None, max_reforms=16,
+                 heartbeat_dir=None, heartbeat_timeout=None, on_event=None):
+        from .. import flags as _flags
+        F = _flags._FLAGS
+        self.step_factory = step_factory
+        self.ckpt = ckpt
+        self.global_batch = int(global_batch)
+        self.devices = list(devices if devices is not None else jax.devices())
+        self.world = len(self.devices)
+        self.save_every = int(F.get("FLAGS_elastic_snapshot_every", 4)
+                              if save_every is None else save_every)
+        self.min_dp = int(F.get("FLAGS_elastic_min_dp", 1)
+                          if min_dp is None else min_dp)
+        self.grow = bool(F.get("FLAGS_elastic_grow", True)
+                         if grow is None else grow)
+        self.max_reforms = int(max_reforms)
+        self.on_event = on_event
+        self.events = []            # audit trail of reform events
+        self.step = None            # current TrainStep
+        self.dp = 0
+        self.active = ()            # ranks of the current mesh
+        self.failed = frozenset()
+        self.reforms = 0
+        self._steps = {}            # (dp, device ids) -> TrainStep memo
+        self.monitor = None
+        self._beats = {}
+        if heartbeat_dir is not None:
+            timeout = float(F.get("FLAGS_elastic_heartbeat_timeout", 5.0)
+                            if heartbeat_timeout is None
+                            else heartbeat_timeout)
+            self.monitor = HeartbeatMonitor(heartbeat_dir, self.world,
+                                            timeout=timeout)
+            self._beats = {r: Heartbeat(heartbeat_dir, rank=r)
+                           for r in range(self.world)}
+        _egauge("world_size", self.world)
+
+    # -- detection -----------------------------------------------------------
+    def _beat_all(self, step):
+        """Single-process heartbeat simulation: beat for every world rank
+        (the fault plan drops frozen ranks' writes, so their files age)."""
+        for hb in self._beats.values():
+            hb.beat(step=step)
+
+    def _detect(self, step):
+        """The failed rank set as of ``step``: injected chip loss
+        (``lost_ranks`` — its ``chip_return_at`` schedule re-admits) plus
+        ranks whose heartbeat is stale RIGHT NOW. With ``grow`` enabled a
+        previously-failed rank whose signal recovered simply drops out of
+        the set — the caller sees a smaller set and grows the mesh back;
+        with ``grow`` disabled failures are sticky."""
+        from ..utils import fault_injection as _fi
+        lost = set(_fi.lost_ranks(step)) & set(range(self.world))
+        stale = set()
+        if self.monitor is not None:
+            candidates = [r for r in range(self.world) if r not in lost]
+            stale = set(self.monitor.failed_ranks(candidates))
+        failed = lost | stale
+        if not self.grow:
+            failed |= set(self.failed)
+        return frozenset(failed)
+
+    # -- mesh re-forming -----------------------------------------------------
+    def viable_dp(self, n_survivors):
+        """Largest dp that the survivors can host AND that divides the
+        global batch (the batch must keep sharding evenly over the dp
+        axis). Raises with the constraint named when none exists."""
+        for d in range(min(int(n_survivors), self.world), 0, -1):
+            if d < self.min_dp:
+                break
+            if self.global_batch % d == 0:
+                return d
+        raise RuntimeError(
+            f"elastic: no viable mesh from {n_survivors} surviving ranks "
+            f"(min_dp={self.min_dp}, global_batch={self.global_batch})")
+
+    def _plan_active(self, failed):
+        """(dp, active ranks) the mesh would re-form to under ``failed``
+        — the cheap what-if ``run()`` uses to skip reforms whose active
+        set is unchanged (e.g. a retired spare flapping back)."""
+        survivors = [r for r in range(self.world) if r not in failed]
+        dp = self.viable_dp(len(survivors))
+        return dp, tuple(survivors[:dp])
+
+    def _reform(self, failed, target_step):
+        from . import env as dist_env
+        t0 = time.perf_counter()
+        dp, active = self._plan_active(failed)
+        kind = ("start" if self.dp == 0 else
+                "shrink" if dp < self.dp else
+                "grow" if dp > self.dp else "reform")
+        devs = [self.devices[r] for r in active]
+        if kind == "grow" and self.step is not None \
+                and not (set(failed) & set(self.active)):
+            # a grow that lost NO currently-active rank keeps every live
+            # shard healthy: snapshot the running step FIRST, so the
+            # resume is free — no rolled-back steps — and never falls
+            # back to a stale snapshot (or none at all). A simultaneous
+            # active-rank loss takes the disk-restore path instead (its
+            # shards may be gone).
+            try:
+                self.ckpt.wait()
+            except Exception:
+                pass  # a failed async save must not block the grow
+            self.ckpt.save(self.step._step, self.step.state_dict(),
+                           blocking=True)
+        mesh = dist_env.create_hybrid_mesh(dp=dp, devices=devs)
+        key = (dp, tuple(getattr(d, "id", i) for i, d in enumerate(devs)))
+        state = self.ckpt.restore(None)
+        step = self._steps.get(key)
+        if step is None or state is None:
+            # no snapshot to restore: NEVER resume a memoized step's stale
+            # in-memory state — rebuild fresh from the factory (step 0)
+            step = self.step_factory(mesh)
+            self._steps[key] = step
+        restored = None
+        if state is not None:
+            step.load_state_dict(state)
+            restored = step._step
+            _ecount("elastic_restores")
+            _ecount("steps_lost", max(0, int(target_step) - restored))
+        elif kind != "start":
+            # fresh restart with no snapshot: EVERYTHING re-executes —
+            # the costliest reform must not report zero steps lost
+            _ecount("steps_lost", int(target_step))
+        step.attach_checkpoint(self.ckpt, save_every=self.save_every)
+        if self.monitor is not None:
+            self.monitor.set_ranks(active)
+        self.step, self.dp = step, dp
+        self.active, self.failed = tuple(active), frozenset(failed)
+        if kind != "start":
+            self.reforms += 1
+            if self.reforms > self.max_reforms:
+                raise RuntimeError(
+                    f"elastic: giving up after {self.max_reforms} mesh "
+                    f"reforms")
+            _ecount("reforms")
+            if kind == "shrink":
+                _ecount("shrinks")
+            elif kind == "grow":
+                _ecount("grows")
+        dt = time.perf_counter() - t0
+        _egauge("resume_latency_s_last", dt)
+        _ecount("resume_latency_s_total", dt)
+        _egauge("active_dp", dp)
+        _egauge("failed_ranks", len(failed))
+        event = {"kind": kind, "dp": dp, "failed": sorted(failed),
+                 "restored_step": restored, "fresh_start": state is None,
+                 "latency_s": dt}
+        self.events.append(event)
+        if self.on_event is not None:
+            self.on_event(event)
+        return step
+
+    # -- driving -------------------------------------------------------------
+    def run(self, batch_fn, steps):
+        """Train until ``steps`` total TrainStep CALLS, surviving topology
+        changes (under ``accumulate_steps=k`` each call is one micro-batch,
+        so the run performs ``steps/k`` optimizer updates — the counter is
+        ``TrainStep._step``). ``batch_fn(step) -> (inputs, labels)`` must be a
+        deterministic function of the GLOBAL step (numpy arrays of the
+        global batch): after a restore the supervisor re-serves the
+        batches following the snapshot, continuing the exact sample
+        sequence on whatever mesh survived. Returns the final TrainStep
+        (``.step`` stays live for inspection)."""
+        from ..tensor_impl import Tensor
+        steps = int(steps)
+        if self.step is None:
+            self._beat_all(0)  # files exist before the first staleness poll
+            self._reform(self._detect(0), target_step=0)
+        while self.step._step < steps:
+            t = self.step._step
+            self._beat_all(t)
+            failed = self._detect(t)
+            if failed != self.failed:
+                if self._plan_active(failed)[1] == self.active:
+                    # the active mesh is unchanged (a retired spare came
+                    # back / another spare died): no reform — tearing
+                    # down the live healthy step would discard progress
+                    self.failed = frozenset(failed)
+                    _egauge("failed_ranks", len(failed))
+                else:
+                    self._reform(failed, target_step=t)
+                    continue
+            x, y = batch_fn(t)
+            self.step(Tensor(np.asarray(x)), Tensor(np.asarray(y)))
+        return self.step

@@ -123,6 +123,21 @@ class BucketPlan:
                                        cur_cols))
         return BucketPlan(n, entries, buckets)
 
+    def fingerprint(self):
+        """Stable short digest of the packed layout: axis size plus every
+        entry's (name, shape, dtype, size, cols, bucket, offset) and every
+        bucket's boundary. Two plans with equal fingerprints pack every
+        grad/slot byte identically — the topology metadata checkpoints
+        carry (TrainStep.topology()) so a mismatched load can be named
+        instead of failing in a reshape."""
+        import hashlib
+        ent = sorted((e.name, e.shape, str(jnp.dtype(e.dtype)), e.size,
+                      e.cols, e.bucket, e.offset)
+                     for e in self.entries.values())
+        bks = [(b.index, str(jnp.dtype(b.dtype)), b.names, b.cols)
+               for b in self.buckets]
+        return hashlib.sha1(repr((self.n, ent, bks)).encode()).hexdigest()[:16]
+
     # -- static byte accounting (per-device wire traffic) --------------------
     def payload_bytes(self):
         return sum(e.size * e.dtype.itemsize for e in self.entries.values())
@@ -393,9 +408,18 @@ def packed_shape(pshape, n):
 def _pack_leaf(v, pshape, n):
     """To packed (n, cols); a leaf already packed (restored checkpoint)
     passes through. The `!= pshape` guard keeps a 2D param whose own shape
-    happens to equal (n, cols) packable."""
+    happens to equal (n, cols) packable. A leaf packed for a DIFFERENT
+    axis size (reshard-on-load: a checkpoint from another mesh restored
+    before the first compile) is re-packed — source tail padding stripped,
+    destination padding re-applied."""
     if tuple(v.shape) == packed_shape(pshape, n) and tuple(v.shape) != pshape:
         return v
+    from . import topology as _rs
+    m = _rs.packed_n(np.shape(v), pshape)
+    if m is not None and m != n:
+        size = int(np.prod(pshape)) if pshape else 1
+        _rs.note_leaf_reshard()
+        return pack_array(jnp.asarray(v).reshape(-1)[:size], n)
     return pack_array(v, n)
 
 
@@ -607,14 +631,18 @@ def resolve(mesh, optimizer, opt_state=None, params=None, offload=False,
                         f"{type(optimizer).__name__} does not support a "
                         f"shard-local weight update (non-elementwise rule)")
         if opt_state is not None and params is not None:
+            from . import topology as _rs
             for name, sl in opt_state["slots"].items():
                 pshape = tuple(params[name].shape)
                 for k, v in sl.items():
                     # accept the packed (n, cols) layout too: a checkpoint
                     # saved under weight-update sharding restores its slots
-                    # packed before the first compile
+                    # packed before the first compile — including a layout
+                    # packed for a DIFFERENT axis size (reshard-on-load:
+                    # _pack_leaf re-packs it for this mesh)
                     if tuple(v.shape) not in (pshape,
-                                              packed_shape(pshape, n)):
+                                              packed_shape(pshape, n)) \
+                            and _rs.packed_n(tuple(v.shape), pshape) is None:
                         return bail(("slot", name, k),
                                     f"slot {name}.{k} shape {tuple(v.shape)}"
                                     f" is neither param-shaped nor packed")

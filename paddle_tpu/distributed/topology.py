@@ -1,0 +1,255 @@
+"""Reshard-on-load: topology-elastic checkpoint restoration.
+
+A checkpoint written under weight-update sharding (grad_comm) stores every
+optimizer slot — and a mid-window gradient accumulator — in the packed
+``(n, cols)`` flat layout of the PRODUCING dp axis (arXiv:2004.13336's
+weight-update-sharding layout, ``cols = ceil(size / n)`` with zero pad at
+the tail). That layout is a pure function of the parameter shape and the
+axis size, so a checkpoint from one mesh maps onto any other: strip the
+source padding, re-pad for the destination axis, done — bucket plans are
+re-derived by the destination step from its own ``(params, n)`` pair, so
+no plan state needs to travel.
+
+This module is the HOST side of that story and is deliberately
+numpy-only (no jax import): every leaf is resharded independently —
+``(n_src, cols_src) → flat[:size] → (n_dst, cols_dst)`` — so the full
+fp32 optimizer state never materializes in one buffer; the destination
+step then ``device_put``s each leaf straight to its packed dp-sharded
+placement exactly like a same-topology restore.
+
+The second job here is DIAGNOSIS: ``TrainStep.state_dict()`` stamps a
+topology record (mesh axis sizes, dp size, wus/accum flags, bucket-plan
+fingerprint — see ``TrainStep.topology()``), and a load that cannot be
+resharded raises :class:`TopologyMismatchError` NAMING the differing
+fields (param names/shapes, accumulate window position, axis sizes)
+instead of failing deep inside a reshape.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class TopologyMismatchError(RuntimeError):
+    """A checkpoint's topology/layout is incompatible with the restoring
+    step in a way reshard-on-load cannot (or was told not to) fix. The
+    message names the differing fields."""
+
+
+# -- counters (observability "elastic" family) -------------------------------
+
+_lock = threading.Lock()
+
+
+def _zero_counters():
+    return {"resharded_loads": 0, "resharded_leaves": 0, "rejected_loads": 0}
+
+
+_counters = _zero_counters()
+
+
+def reshard_counters():
+    with _lock:
+        return dict(_counters)
+
+
+def reset_reshard_counters():
+    global _counters
+    with _lock:
+        _counters = _zero_counters()
+
+
+def _count(key, n=1):
+    with _lock:
+        _counters[key] += n
+
+
+def note_leaf_reshard(n=1):
+    """Bump the leaf counter from external reshard sites (grad_comm's
+    pack path reshards foreign-packed leaves on the first compile)."""
+    _count("resharded_leaves", n)
+
+
+def note_load(n_leaves):
+    """One reshard-on-load event moving ``n_leaves`` leaves."""
+    _count("resharded_loads")
+    _count("resharded_leaves", int(n_leaves))
+
+
+def note_rejected():
+    """One refused load (strict mode / unreshardable layout) — every
+    ``TopologyMismatchError`` raise site counts here so the elastic
+    family's ``rejected_loads`` matches what fleets actually see."""
+    _count("rejected_loads")
+
+
+# -- packed-layout geometry --------------------------------------------------
+
+
+def _size(pshape):
+    return int(np.prod(pshape)) if len(pshape) else 1
+
+
+def packed_shape(pshape, n):
+    """The packed ``(n, cols)`` shape of a param of ``pshape``."""
+    return (int(n), -(-_size(pshape) // int(n)))
+
+
+def packed_n(shape, pshape):
+    """The axis size ``m`` when ``shape`` is a CONSISTENT packed layout
+    ``(m, ceil(size/m))`` of a param of ``pshape`` — and not the param
+    shape itself — else None. This is how a packed leaf from a foreign
+    topology is recognized when no metadata travelled with it."""
+    shape = tuple(int(s) for s in shape)
+    pshape = tuple(int(s) for s in pshape)
+    if shape == pshape or len(shape) != 2:
+        return None
+    m, cols = shape
+    if m >= 1 and cols == -(-_size(pshape) // m):
+        return m
+    return None
+
+
+def reshard_leaf(v, pshape, n_dst, where="leaf"):
+    """One leaf → the destination layout, in numpy on the host.
+
+    Accepts the param shape or the packed layout of ANY axis size;
+    returns ``(leaf, resharded)`` where the leaf is param-shaped when
+    ``n_dst`` is None and packed ``(n_dst, cols_dst)`` otherwise. A leaf
+    already in the destination layout passes through UNTOUCHED (object
+    identity — same-topology restores stay byte-identical). Scalars pass
+    through. Raises :class:`TopologyMismatchError` naming ``where`` when
+    the leaf fits no known layout of ``pshape``."""
+    shape = tuple(int(s) for s in np.shape(v))
+    pshape = tuple(int(s) for s in pshape)
+    dst = packed_shape(pshape, n_dst) if n_dst else pshape
+    if shape == dst:
+        return v, False
+    size = _size(pshape)
+    if shape == pshape:  # incl. scalar params: () packs to (n, 1)
+        flat = np.asarray(v).reshape(-1)
+    else:
+        m = packed_n(shape, pshape)
+        if m is None:
+            _count("rejected_loads")
+            raise TopologyMismatchError(
+                f"{where}: shape {shape} is neither the param shape "
+                f"{pshape} nor a packed (n, ceil({size}/n)) layout — "
+                f"this checkpoint was produced by a different model")
+        # strip the SOURCE axis's tail padding before re-packing
+        flat = np.asarray(v).reshape(-1)[:size]
+    if n_dst is None:
+        return flat.reshape(pshape), True
+    n, cols = dst
+    pad = n * cols - size
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    return flat.reshape(n, cols), True
+
+
+def reshard_opt_state(state, pshapes, n_dst):
+    """Optimizer ``{"step", "slots"}`` → the destination layout, leaf by
+    leaf (streamed — one param's slot in flight at a time). ``pshapes``
+    maps param name → shape; ``n_dst`` is the destination packing axis
+    size (None = param-shaped slots, the replicated/GSPMD schedule).
+    Returns ``(state, n_leaves_resharded)``."""
+    slots, moved = {}, 0
+    for name, sl in state["slots"].items():
+        out = {}
+        for k, v in sl.items():
+            out[k], did = reshard_leaf(v, pshapes[name], n_dst,
+                                       where=f"slot {name}.{k}")
+            moved += bool(did)
+        slots[name] = out
+    return {"step": state["step"], "slots": slots}, moved
+
+
+def reshard_accum(gacc, pshapes, n_dst):
+    """Gradient accumulator → destination layout; same contract as
+    :func:`reshard_opt_state`."""
+    out, moved = {}, 0
+    for name, v in gacc.items():
+        out[name], did = reshard_leaf(v, pshapes[name], n_dst,
+                                      where=f"grad_accum {name}")
+        moved += bool(did)
+    return out, moved
+
+
+# -- diagnosis ---------------------------------------------------------------
+
+_IGNORED_FIELDS = ("format", "resolved")
+
+
+def diff_topology(src, dst):
+    """Named field-by-field difference of two topology records:
+    ``[(field, src_value, dst_value), ...]``."""
+    src, dst = dict(src or {}), dict(dst or {})
+    fields = sorted(set(src) | set(dst))
+    return [(f, src.get(f), dst.get(f)) for f in fields
+            if f not in _IGNORED_FIELDS and src.get(f) != dst.get(f)]
+
+
+def describe_diff(diffs):
+    return "; ".join(f"{f}: checkpoint={s!r} vs step={d!r}"
+                     for f, s, d in diffs)
+
+
+def check_params(src_params, dst_params, max_named=6):
+    """Raise :class:`TopologyMismatchError` naming missing/extra params
+    and per-param shape/dtype differences when a checkpoint's parameter
+    tree does not match the restoring step's — the diagnosis that
+    replaces the opaque downstream reshape error for a WRONG-MODEL load.
+    Param leaves are host or device arrays; only names/shapes/dtypes are
+    read."""
+    if src_params is None:
+        return
+    bad = []
+    src_names, dst_names = set(src_params), set(dst_params)
+    for n in sorted(src_names - dst_names):
+        bad.append(f"param {n!r}: only in checkpoint")
+    for n in sorted(dst_names - src_names):
+        bad.append(f"param {n!r}: only in step")
+    for n in sorted(src_names & dst_names):
+        s, d = src_params[n], dst_params[n]
+        if tuple(np.shape(s)) != tuple(np.shape(d)):
+            bad.append(f"param {n!r}: shape {tuple(np.shape(s))} "
+                       f"(checkpoint) vs {tuple(np.shape(d))} (step)")
+        elif hasattr(s, "dtype") and hasattr(d, "dtype") and \
+                np.dtype(s.dtype) != np.dtype(d.dtype):
+            bad.append(f"param {n!r}: dtype {np.dtype(s.dtype)} "
+                       f"(checkpoint) vs {np.dtype(d.dtype)} (step)")
+    if bad:
+        _count("rejected_loads")
+        extra = f" (+{len(bad) - max_named} more)" if len(bad) > max_named \
+            else ""
+        raise TopologyMismatchError(
+            "checkpoint/model mismatch — " + "; ".join(bad[:max_named])
+            + extra)
+
+
+def check_accum_window(state, src_topo, dst_k):
+    """Validate the gradient-accumulation window across a topology
+    change. A mid-window snapshot (``micro % k_src != 0``) can only
+    continue under the SAME ``accumulate_steps`` — the accumulator holds
+    k_src-normalized partial contributions. At a window boundary a
+    ``k`` change is safe: the accumulator is zeros and the micro counter
+    restarts. Returns the (possibly adjusted) micro counter to restore,
+    or None when the destination should keep its own."""
+    src_k = int((src_topo or {}).get("accumulate_steps") or 0)
+    micro = state.get("micro")
+    if not src_k or micro is None:
+        return micro
+    micro = int(micro)
+    mid = micro % src_k != 0
+    if src_k == int(dst_k):
+        return micro
+    if mid:
+        _count("rejected_loads")
+        raise TopologyMismatchError(
+            f"accumulate_steps: checkpoint={src_k} vs step={int(dst_k)} "
+            f"with a MID-WINDOW accumulator (micro={micro}, "
+            f"{micro % src_k}/{src_k} contributions) — resume on "
+            f"accumulate_steps={src_k} or restore a window-boundary "
+            f"snapshot")
+    return 0  # boundary: restart the window count under the new k

@@ -221,6 +221,28 @@ _FLAGS = {
     # time drifts more than this percentage above the rolling baseline.
     # 0 disables the sentinel.
     "FLAGS_step_time_drift_pct": 25.0,
+    # -- topology-elastic training (distributed/elastic.py, topology.py) ----
+    # Reshard-on-load: a checkpoint whose packed dp-sharded slot layout was
+    # produced on a DIFFERENT mesh is resharded for the restoring step
+    # (streamed leaf-by-leaf on the host, bitwise round-trippable). Off:
+    # a cross-topology load raises TopologyMismatchError naming the
+    # differing fields instead (strict fleets that want resumes pinned to
+    # the producing topology). Same-topology restores are unaffected
+    # either way.
+    "FLAGS_elastic_reshard": True,
+    # ElasticMeshSupervisor snapshot cadence (TrainStep.attach_checkpoint
+    # save_every): the newest good snapshot is what a re-formed mesh
+    # resumes from, so this bounds steps re-executed after a chip loss.
+    "FLAGS_elastic_snapshot_every": 4,
+    # Smallest dp the supervisor will shrink to before giving up.
+    "FLAGS_elastic_min_dp": 1,
+    # Grow the mesh back when failed ranks return (heartbeats recover /
+    # chip_return_at fires). Off: failures are sticky, the mesh only
+    # shrinks.
+    "FLAGS_elastic_grow": True,
+    # Heartbeat staleness threshold (seconds) for the supervisor's rank
+    # failure detection when a heartbeat_dir is configured.
+    "FLAGS_elastic_heartbeat_timeout": 5.0,
     # -- per-axis communication-schedule backend ----------------------------
     # Pluggable collective decomposition per mesh axis, e.g. "mp=fused" or
     # "mp=fused,dp=ring" (distributed/comm_backend.py). Backends:

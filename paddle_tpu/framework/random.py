@@ -20,6 +20,13 @@ class _RngState(threading.local):
         self.key = jax.random.key(0)
         self.forked = None  # (base_key, counter) while inside fork_rng
         self.philox_counter = 0
+        # GLOBAL-STREAM position: keys drawn from the global generator
+        # since the last seed(). The stream is a pure function of
+        # (seed, draws) and is TOPOLOGY-INDEPENDENT — per-replica keys are
+        # derived inside the compiled step by folding the replica index,
+        # so a dp=8 -> dp=4 elastic resume that restores (seed, key,
+        # draws) continues the exact key sequence of the source run.
+        self.draws = 0
 
 
 _rng = _RngState()
@@ -30,6 +37,7 @@ def seed(s: int):
     _rng.seed = int(s)
     _rng.key = jax.random.key(int(s))
     _rng.philox_counter = 0
+    _rng.draws = 0
     return _rng
 
 
@@ -45,19 +53,32 @@ def next_key():
         _rng.forked = (base, counter + 1)
         return jax.random.fold_in(base, counter)
     _rng.key, sub = jax.random.split(_rng.key)
+    _rng.draws += 1
     return sub
+
+
+def stream_position():
+    """Keys drawn from the global stream since the last ``seed()`` — the
+    stream position in GLOBAL terms (one draw per training step, whatever
+    the mesh looks like). Recorded in ``state_dict`` so an elastic resume
+    on a different topology can audit that the key sequence continues
+    where the source run stopped."""
+    return _rng.draws
 
 
 def state_dict():
     """Serializable snapshot of the global RNG stream (exact-resume support:
     a checkpoint that captures this restores the *stream position*, so every
     post-restore ``next_key()`` returns exactly the key the uninterrupted
-    run would have drawn). The transient ``fork_rng`` base is trace-local
-    state and is deliberately not captured."""
+    run would have drawn). ``draws`` records the position in global-stream
+    terms (it is topology-independent: per-replica keys fold the replica
+    index inside the program). The transient ``fork_rng`` base is
+    trace-local state and is deliberately not captured."""
     import numpy as np
     return {"seed": _rng.seed,
             "key": np.asarray(jax.random.key_data(_rng.key)),
-            "philox_counter": _rng.philox_counter}
+            "philox_counter": _rng.philox_counter,
+            "draws": _rng.draws}
 
 
 def set_state_dict(state):
@@ -67,6 +88,7 @@ def set_state_dict(state):
     _rng.key = jax.random.wrap_key_data(
         jax.numpy.asarray(np.asarray(state["key"], dtype=np.uint32)))
     _rng.philox_counter = int(state.get("philox_counter", 0))
+    _rng.draws = int(state.get("draws", 0))
 
 
 def advance(n):

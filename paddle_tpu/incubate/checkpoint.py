@@ -80,6 +80,25 @@ def _count(key, n=1):
         _counters[key] += n
 
 
+def _topology_of(state, topology=None):
+    """The topology record a save stamps into the manifest: an explicit
+    ``topology=`` wins, else the ``"topology"`` entry a TrainStep
+    ``state_dict()`` carries (auto-detected so every existing ``save(step,
+    state)`` caller picks it up without an API change)."""
+    if topology is not None:
+        return dict(topology)
+    if isinstance(state, dict) and isinstance(state.get("topology"), dict):
+        return dict(state["topology"])
+    return None
+
+
+def _topology_crc(topo):
+    """CRC over the canonical JSON of the topology record — the manifest's
+    per-array CRCs cover the state bytes; this covers the metadata."""
+    return zlib.crc32(json.dumps(topo, sort_keys=True,
+                                 default=str).encode()) & 0xFFFFFFFF
+
+
 def _tree_checksums(snap):
     """{tree-path: {crc32, dtype, shape, nbytes}} over the array leaves."""
     out = {}
@@ -128,6 +147,10 @@ class CheckpointManager:
         # older than latest_step() after a fallback past an unreadable
         # (not quarantined) step; resume logic must pair state with THIS
         self.last_restored_step = None
+        # the manifest topology record of that same restore (None for
+        # pre-topology checkpoints): what mesh/flags produced the bytes
+        self.last_restored_topology = None
+        self._last_verified_topology = None
         self._recover()
 
     # -- querying ----------------------------------------------------------
@@ -190,14 +213,23 @@ class CheckpointManager:
                 pass
 
     # -- saving ------------------------------------------------------------
-    def save(self, step, state, blocking=None):
+    def save(self, step, state, blocking=None, topology=None):
         """Checkpoint ``state`` (a pytree of Tensors/arrays/scalars) at ``step``.
 
         Snapshots to host immediately; writes to disk on a background thread
         unless ``blocking`` (or the manager was created with
         ``async_save=False``).
+
+        ``topology`` (or, auto-detected, a ``state["topology"]`` dict — the
+        record ``TrainStep.state_dict()`` carries) lands in the step's
+        ``manifest.json`` next to the per-array CRCs, itself CRC-covered:
+        the producing mesh axis sizes, bucket-plan fingerprint and flags
+        are readable WITHOUT loading the state, so a resuming supervisor
+        can decide to reshard — and a mismatched load can name the
+        differing fields — before touching the arrays.
         """
         self.wait()  # one in-flight save at a time; surfaces prior IO errors
+        topo = _topology_of(state, topology)
 
         def _snap(a):
             if hasattr(a, "_data"):  # Tensor: host copy, keep wrapper type
@@ -213,15 +245,16 @@ class CheckpointManager:
         if blocking is None:
             blocking = not self.async_save
         if blocking:
-            self._write(int(step), snap)
+            self._write(int(step), snap, topo)
         else:
             self._thread = threading.Thread(
-                target=self._write_guarded, args=(int(step), snap), daemon=True)
+                target=self._write_guarded, args=(int(step), snap, topo),
+                daemon=True)
             self._thread.start()
 
-    def _write_guarded(self, step, snap):
+    def _write_guarded(self, step, snap, topo=None):
         try:
-            self._write(step, snap)
+            self._write(step, snap, topo)
         except BaseException as e:  # surfaced on next save()/wait()
             with self._lock:
                 self._error = e
@@ -242,12 +275,12 @@ class CheckpointManager:
                 time.sleep(delay)
                 delay *= 2
 
-    def _write(self, step, snap):
-        self._retrying(lambda: self._write_once(step, snap),
+    def _write(self, step, snap, topo=None):
+        self._retrying(lambda: self._write_once(step, snap, topo),
                        on_retry=lambda: _count("save_retries"))
         _count("saves")
 
-    def _write_once(self, step, snap):
+    def _write_once(self, step, snap, topo=None):
         _fi.maybe_fail_write(self.site)
         final = self._step_dir(step)
         tmp = final + ".tmp"
@@ -255,8 +288,12 @@ class CheckpointManager:
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         fio.save(snap, os.path.join(tmp, _STATE_FILE))
+        manifest = {"step": int(step), "arrays": _tree_checksums(snap)}
+        if topo is not None:
+            manifest["topology"] = topo
+            manifest["topology_crc32"] = _topology_crc(topo)
         with open(os.path.join(tmp, _MANIFEST), "w") as f:
-            json.dump({"step": int(step), "arrays": _tree_checksums(snap)}, f)
+            json.dump(manifest, f)
         if os.path.exists(final):
             # never delete the only published copy before the replacement is
             # live: rename it aside, publish, THEN drop it (the seed did
@@ -303,6 +340,7 @@ class CheckpointManager:
         rotten bytes; transient read failures surface as OSError."""
         d = self._step_dir(step)
         path = os.path.join(d, _STATE_FILE)
+        self._last_verified_topology = None
         try:
             state = self._read_retrying(lambda: fio.load(path))
         except OSError:
@@ -311,26 +349,73 @@ class CheckpointManager:
             raise CheckpointCorruptError(
                 f"checkpoint step {step} unreadable: {e}") from e
         manifest_path = os.path.join(d, _MANIFEST)
-        if self.verify and os.path.exists(manifest_path):
+        if os.path.exists(manifest_path):
             def read_manifest():
                 with open(manifest_path) as f:
                     return json.load(f)
-            try:
-                manifest = self._read_retrying(read_manifest)
-            except OSError:
-                raise
-            except ValueError as e:
-                raise CheckpointCorruptError(
-                    f"checkpoint step {step} manifest unreadable: {e}") from e
-            actual = _tree_checksums(state)
-            for key, rec in manifest.get("arrays", {}).items():
-                got = actual.get(key)
-                if got is None or got["crc32"] != rec["crc32"]:
+            if self.verify:
+                try:
+                    manifest = self._read_retrying(read_manifest)
+                except OSError:
+                    raise
+                except ValueError as e:
                     raise CheckpointCorruptError(
-                        f"checkpoint step {step}: array {key} failed CRC "
-                        f"verification (manifest {rec['crc32']}, got "
-                        f"{got['crc32'] if got else 'missing'})")
+                        f"checkpoint step {step} manifest unreadable: "
+                        f"{e}") from e
+                actual = _tree_checksums(state)
+                for key, rec in manifest.get("arrays", {}).items():
+                    got = actual.get(key)
+                    if got is None or got["crc32"] != rec["crc32"]:
+                        raise CheckpointCorruptError(
+                            f"checkpoint step {step}: array {key} failed "
+                            f"CRC verification (manifest {rec['crc32']}, "
+                            f"got {got['crc32'] if got else 'missing'})")
+                self._last_verified_topology = self._checked_topology(
+                    manifest, step)
+            else:
+                # verification off still CAPTURES the topology record
+                # (supervisors key off last_restored_topology); torn
+                # metadata degrades to None instead of raising
+                try:
+                    manifest = self._read_retrying(read_manifest)
+                    self._last_verified_topology = self._checked_topology(
+                        manifest, step)
+                except (OSError, ValueError, CheckpointCorruptError):
+                    self._last_verified_topology = None
         return state
+
+    def _checked_topology(self, manifest, step):
+        """Topology record of a manifest, its own CRC verified."""
+        topo = manifest.get("topology")
+        if topo is not None and manifest.get("topology_crc32") \
+                != _topology_crc(topo):
+            raise CheckpointCorruptError(
+                f"checkpoint step {step}: topology metadata failed CRC "
+                f"verification")
+        return topo
+
+    def manifest_topology(self, step=None):
+        """The topology record the manifest of ``step`` (default: latest)
+        carries, or None — readable WITHOUT loading the state arrays, so a
+        supervisor can plan a reshard before paying for the restore. The
+        record's own CRC is verified; rotten metadata raises
+        ``CheckpointCorruptError``."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        path = os.path.join(self._step_dir(step), _MANIFEST)
+        try:
+            def read():
+                with open(path) as f:
+                    return json.load(f)
+            manifest = self._read_retrying(read)
+        except OSError:
+            return None
+        except ValueError as e:  # torn/rotten manifest bytes
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} manifest unreadable: {e}") from e
+        return self._checked_topology(manifest, step)
 
     def _quarantine(self, step):
         """Rename a corrupt step dir to ``*.corrupt`` so all_steps/restore
@@ -359,6 +444,7 @@ class CheckpointManager:
             try:
                 state = self._verify_step(step)
                 self.last_restored_step = int(step)
+                self.last_restored_topology = self._last_verified_topology
                 return state
             except CheckpointCorruptError:
                 self._quarantine(step)
@@ -369,10 +455,12 @@ class CheckpointManager:
                        default=None)
             if step is None:
                 self.last_restored_step = None
+                self.last_restored_topology = None
                 return None
             try:
                 state = self._verify_step(step)
                 self.last_restored_step = int(step)
+                self.last_restored_topology = self._last_verified_topology
                 return state
             except CheckpointCorruptError:
                 tried.add(step)

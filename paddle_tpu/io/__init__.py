@@ -69,9 +69,13 @@ class DataLoader:
         self.timeout = float(timeout or 0)
         if self.timeout < 0:
             raise ValueError(f"timeout must be >= 0, got {timeout!r}")
-        # exact-resume support: batches served this epoch / skip request
+        # exact-resume support: batches served this epoch / skip request;
+        # iterable datasets additionally track the EXACT sample count
+        # (their short final batch is unknowable up front) + epoch end
         self._served = 0
         self._resume_skip = 0
+        self._samples_exact = None
+        self._epoch_end = False
         if not isinstance(prefetch_factor, int) or prefetch_factor < 1:
             raise ValueError(
                 f"prefetch_factor must be a positive int, got "
@@ -108,45 +112,159 @@ class DataLoader:
         return self.collate_fn(samples)
 
     # -- resumable position (exact mid-epoch resume) -----------------------
+    def _samples_per_batch(self):
+        """GLOBAL samples one yielded batch advances the epoch by, or None
+        when unknowable (a custom batch_sampler without a ``batch_size``
+        attribute). A DistributedBatchSampler yields this host's
+        1/nranks shard, so each yield advances the global stream by
+        batch_size * nranks — recording in global terms is what makes the
+        position meaningful across a topology change."""
+        if self._iterable:
+            return int(self.batch_size) if self.batch_size else None
+        if self.batch_sampler is None:
+            return 1  # batch_size=None: one sample per yield
+        bs = getattr(self.batch_sampler, "batch_size", None)
+        if not bs:
+            return None
+        return int(bs) * int(getattr(self.batch_sampler, "nranks", 1) or 1)
+
+    def _epoch_samples(self):
+        """Global samples one epoch serves (the clamp bound for a short
+        final batch), or None when unknowable."""
+        if not self._iterable and self.batch_sampler is not None:
+            total = getattr(self.batch_sampler, "total_size", None)
+            if total is not None:  # distributed sampler pads to this
+                return int(total)
+        try:
+            return len(self.dataset)
+        except TypeError:
+            return None
+
     def state_dict(self):
-        """Position within the current epoch: how many batches this loader
-        has yielded. Checkpoint it next to the model/optimizer state; on
-        restore, ``load_state_dict`` makes the NEXT ``__iter__`` skip that
-        many batches — for the map-style/batch_sampler path the skip
-        consumes only sampler indices (no data is fetched), so resuming
-        deep into an epoch is cheap."""
-        return {"batches_served": self._served}
+        """Position within the current epoch in GLOBAL-SAMPLE terms:
+        ``samples_served`` (= batches x samples-per-batch, alongside the
+        producing ``batch_size``) plus the raw ``batches_served``.
+        Checkpoint it next to the model/optimizer state; on restore,
+        ``load_state_dict`` makes the NEXT ``__iter__`` skip to that
+        sample — for the map-style/batch_sampler path the skip consumes
+        only sampler indices (no data is fetched), so resuming deep into
+        an epoch is cheap. Recording samples rather than batches makes the
+        position topology-independent: a resume whose global batch size
+        differs re-derives its own batch skip (and a position that does
+        not fall on the new batch boundary is REFUSED with the fields
+        named, where the old index-only skip silently desynced)."""
+        state = {"batches_served": self._served}
+        spb = self._samples_per_batch()
+        if spb and self._iterable and self._samples_exact is None \
+                and self._served:
+            # worker-prefetch iterable (no exact consumer-side count) with
+            # no length bound: batches x batch_size could overstate past a
+            # short final batch — record the batch position only (legacy
+            # skip on resume) rather than an unverifiable sample count
+            return state
+        if spb:
+            samples = self._served * spb
+            # a short FINAL batch (drop_last=False) serves fewer samples
+            n = self._epoch_samples()
+            if n is not None:
+                samples = min(samples, n)
+            if self._iterable and self._samples_exact is not None:
+                samples = self._samples_exact  # exact incl. short batch
+            state["samples_served"] = samples
+            state["batch_size"] = spb
+            if self._epoch_end or (n is not None and samples >= n):
+                # a non-boundary position is resumable iff it is the END
+                # of the epoch; mark it so the restoring loader (which may
+                # not know the epoch length — iterable datasets) can tell
+                state["epoch_end"] = True
+        return state
 
     def load_state_dict(self, state):
+        if "samples_served" in state:
+            spb = self._samples_per_batch()
+            samples = int(state["samples_served"])
+            if spb:
+                if samples % spb:
+                    n = self._epoch_samples()
+                    if state.get("epoch_end") or \
+                            (n is not None and samples == n):
+                        # EPOCH-END position (the final batch was short,
+                        # drop_last=False): every batch was served — skip
+                        # the whole epoch; the next __iter__ after the
+                        # one-shot skip starts the following epoch fresh
+                        self._resume_skip = -(-samples // spb)
+                        return
+                    raise ValueError(
+                        f"DataLoader resume position is not on a batch "
+                        f"boundary: checkpoint samples_served={samples} "
+                        f"(batch_size={state.get('batch_size')}) does not "
+                        f"divide by this loader's batch_size={spb} — the "
+                        f"resuming run would silently desync mid-batch; "
+                        f"restore with a batch size that divides "
+                        f"{samples}")
+                self._resume_skip = samples // spb
+                return
+            import warnings
+            warnings.warn(
+                f"DataLoader cannot derive its samples-per-batch (custom "
+                f"batch_sampler without a batch_size attribute): falling "
+                f"back to the raw batch skip of {state.get('batches_served')}"
+                f" — if this loader's batching differs from the producing "
+                f"run's (samples_served={samples}, batch_size="
+                f"{state.get('batch_size')}), the resumed sample sequence "
+                f"will desync")
         self._resume_skip = int(state.get("batches_served", 0))
 
     def _iter_batches(self, skip=0):
         if self._iterable:
             it = iter(self.dataset)
+            track = self._samples_exact is not None
             # iterable datasets have no index stream to skip over: resume
             # consumes (and drops) the already-served batches
             for _ in range(skip + 1):
                 chunk = list(itertools.islice(it, self.batch_size))
                 if not chunk or (len(chunk) < self.batch_size
                                  and self.drop_last):
+                    if track:
+                        self._epoch_end = True
                     return
             while chunk:
+                if track:
+                    self._samples_exact += len(chunk)
                 yield self.collate_fn(chunk)
                 chunk = list(itertools.islice(it, self.batch_size))
                 if len(chunk) < self.batch_size and self.drop_last:
+                    if track:
+                        self._epoch_end = True
                     return
+            if track:
+                self._epoch_end = True
         elif self.batch_sampler is None:
             for i in range(skip, len(self.dataset)):  # batch_size=None
                 yield self.dataset[i]
+            if self.num_workers <= 0:
+                self._epoch_end = True
         else:
             # skip consumes only sampler indices — no data is fetched for
             # the already-served prefix, so deep mid-epoch resume is cheap
             for indices in itertools.islice(self.batch_sampler, skip, None):
                 yield self._fetch(indices)
+            # exhaustion marks epoch end even when drop_last truncated the
+            # tail (samples_served < len(dataset) yet the epoch is DONE);
+            # consumer-side only — worker prefetch runs ahead of the user
+            if self.num_workers <= 0:
+                self._epoch_end = True
 
     def __iter__(self):
         skip, self._resume_skip = self._resume_skip, 0
         self._served = skip
+        self._epoch_end = False
+        spb = self._samples_per_batch()
+        # exact sample tracking only where the generator runs on the
+        # consumer's thread (worker prefetch counts AHEAD of the user)
+        self._samples_exact = (skip * spb if (self._iterable and spb
+                                              and self.num_workers <= 0)
+                               else None)
         if self.num_workers <= 0:
             src = self._iter_batches(skip)
         elif self.worker_mode == "process":

@@ -1054,6 +1054,43 @@ class TrainStep:
             state["micro"] = int(jax.device_get(self._micro))
         return state
 
+    def topology(self):
+        """Topology/flags metadata stamped into ``state_dict()`` (and into
+        the CheckpointManager manifest, CRC-covered): mesh axis sizes, the
+        dp axis size the packed slot layout was produced for, weight-
+        update-sharding and accumulation flags, the wire dtype, and the
+        bucket-plan fingerprint. ``load_state_dict`` uses the record to
+        reshard a checkpoint onto a DIFFERENT mesh
+        (distributed/topology.py) — or to name the differing fields when it
+        cannot. Reflects the STORED layout: ``wus``/``dp`` come from the
+        resolved grad-comm config once compiled, from the mesh hint
+        before."""
+        from .. import flags as _flags
+        mesh_axes = {}
+        if self.mesh is not None:
+            mesh_axes = {a: int(self.mesh.shape[a])
+                         for a in self.mesh.axis_names
+                         if int(self.mesh.shape[a]) > 1}
+        cfg = self._gc_cfg
+        wus = bool(cfg is not None and cfg.weight_update_sharding)
+        if cfg is not None:
+            dp = int(cfg.n)
+        else:
+            dp = next((mesh_axes[a] for a in ("dp", "sharding")
+                       if a in mesh_axes), 1)
+        return {
+            "format": 1,
+            "mesh_axes": mesh_axes,
+            "dp": dp,
+            "wus": wus,
+            "accumulate_steps": int(self.accumulate_steps),
+            "wire_dtype": str(_flags._FLAGS.get("FLAGS_allreduce_dtype",
+                                                "float32")),
+            "bucket_plan": (cfg.plan.fingerprint()
+                            if cfg is not None and cfg.plan is not None
+                            else None),
+        }
+
     def state_dict(self):
         """Complete training state for EXACT resume: params, buffers,
         optimizer slots (packed dp-sharded layout preserved as stored —
@@ -1062,8 +1099,13 @@ class TrainStep:
         scheduler, and — when attached — GradScaler scaling state and the
         DataLoader's epoch position. A run killed at step t and
         ``load_state_dict``-resumed reproduces the uninterrupted
-        trajectory bitwise."""
+        trajectory bitwise. The ``topology`` record makes the snapshot
+        loadable on a DIFFERENT mesh: ``load_state_dict`` reshards the
+        packed slot layout for the destination dp size (reshard-on-load),
+        so a dp=8 checkpoint resumes on the dp=4 mesh that survives a
+        host loss."""
         state = self.state_for_checkpoint()
+        state["topology"] = self.topology()
         from ..framework import random as _rnd
         state["rng"] = _rnd.state_dict()
         from ..optimizer.lr import LRScheduler
@@ -1074,7 +1116,7 @@ class TrainStep:
         if self._attached_loader is not None and hasattr(
                 self._attached_loader, "state_dict"):
             state["loader"] = self._attached_loader.state_dict()
-        state["format_version"] = 1
+        state["format_version"] = 2
         return state
 
     def load_state_dict(self, state):
@@ -1109,6 +1151,62 @@ class TrainStep:
         # device_puts each leaf straight to its target sharding (packed
         # dp-sharded slots restore shard-wise, no replicated intermediate);
         # without a mesh, arrays go to the default device here
+        from ..distributed import topology as _rs
+        from .. import flags as _flags
+        src_topo = state.get("topology")
+        # wrong-model loads fail HERE with the differing params named,
+        # not deep inside a slot reshape
+        _rs.check_params(state.get("params"), self._params)
+        # strict mode: refuse a cross-topology load up front — BEFORE the
+        # compiled/uncompiled split, so an uncompiled step cannot slip the
+        # reshard through its first-call pack path
+        if src_topo is not None and \
+                not _flags._FLAGS.get("FLAGS_elastic_reshard", True):
+            dst_topo = self.topology()
+            if (src_topo.get("dp") != dst_topo.get("dp")
+                    or src_topo.get("mesh_axes") != dst_topo.get(
+                        "mesh_axes")):
+                diffs = _rs.diff_topology(src_topo, dst_topo)
+                _rs.note_rejected()
+                raise _rs.TopologyMismatchError(
+                    "FLAGS_elastic_reshard is off and the checkpoint "
+                    "topology differs — " + _rs.describe_diff(diffs))
+        state = dict(state)
+        if src_topo is not None and "grad_accum" in state:
+            # a k change across the restore is only legal at a window
+            # boundary (named diagnosis otherwise); at a boundary the
+            # window count restarts under the new k
+            micro = _rs.check_accum_window(state, src_topo,
+                                           self.accumulate_steps)
+            if self.accumulate_steps > 1:
+                state["micro"] = 0 if micro is None else micro
+            else:
+                # boundary snapshot into a non-accumulating step: the
+                # accumulator is zeros — drop it
+                state.pop("grad_accum")
+                state.pop("micro", None)
+        if self._jitted is not None:
+            # the compiled step fixed a slot layout at build time:
+            # reshard-on-load maps whatever the checkpoint stored —
+            # param-shaped, packed for THIS axis size, or packed for a
+            # DIFFERENT mesh's — onto it, leaf by leaf in host numpy
+            # (streamed; the full optimizer state never materializes in
+            # one buffer), before any device placement
+            wus = (self._gc_cfg is not None
+                   and self._gc_cfg.weight_update_sharding)
+            n_dst = self._gc_cfg.n if wus else None
+            pshapes = {nm: tuple(np.shape(a))
+                       for nm, a in state["params"].items()}
+            resharded = 0
+            state["opt_state"], moved = _rs.reshard_opt_state(
+                state["opt_state"], pshapes, n_dst)
+            resharded += moved
+            if "grad_accum" in state and self.accumulate_steps > 1:
+                state["grad_accum"], moved = _rs.reshard_accum(
+                    state["grad_accum"], pshapes, n_dst)
+                resharded += moved
+            if resharded:
+                _rs.note_load(resharded)
         if self.mesh is not None:
             put = lambda tree: tree  # noqa: E731
         else:
@@ -1118,26 +1216,21 @@ class TrainStep:
         self._opt_state = put(state["opt_state"])
         self._buffers = jax.tree_util.tree_map(jnp.asarray, state["buffers"])
         self._step = int(state["step"])
-        if "grad_accum" in state:
+        if "grad_accum" in state and self.accumulate_steps > 1:
             self._grad_accum = put(state["grad_accum"])
             self._micro = jnp.asarray(state["micro"], jnp.int32)
             self._micro_py = int(state["micro"])
-        if self._jitted is not None:
-            # the compiled step fixed a slot layout at build time; normalize
-            # a checkpoint from the other schedule (packed <-> param-shaped)
-            from ..distributed import grad_comm as _gc
-            if self._gc_cfg is not None and self._gc_cfg.weight_update_sharding:
-                self._opt_state = _gc.pack_opt_state(
-                    self._opt_state, self._params, self._gc_cfg.n)
-                if self._grad_accum is not None:
-                    self._grad_accum = _gc.pack_accum(
-                        self._grad_accum, self._params, self._gc_cfg.n)
-            else:
-                self._opt_state = _gc.unpack_opt_state(self._opt_state,
-                                                       self._params)
-                if self._grad_accum is not None:
-                    self._grad_accum = _gc.unpack_accum(self._grad_accum,
-                                                        self._params)
+        elif self.accumulate_steps > 1:
+            # checkpoint from a non-accumulating run: start a FRESH window
+            # — keeping this step's live accumulator/micro would mix
+            # pre-restore partial gradients into the first update
+            self._grad_accum = jax.tree_util.tree_map(jnp.zeros_like,
+                                                      self._grad_accum)
+            self._micro = jnp.zeros((), jnp.int32)
+            self._micro_py = 0
+        # not compiled yet: leaves keep the checkpoint's layout — the first
+        # __call__ resolves the schedule and pack_opt_state/_pack_leaf
+        # reshards any foreign-packed leaves then (resolve() accepts them)
         if self.mesh is not None:
             self.shard_params()
         self.sync_to_model()

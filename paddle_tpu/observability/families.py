@@ -61,6 +61,14 @@ def _step():
     return step_telemetry.step_counters()
 
 
+def _elastic():
+    from ..distributed import elastic as _el
+    from ..distributed import topology as _topo
+    out = dict(_el.elastic_counters())
+    out.update(_topo.reshard_counters())
+    return out
+
+
 def register_default_families():
     """Idempotent: (re-)register the framework families. Called at
     observability import; safe to call again after a registry reset."""
@@ -71,6 +79,7 @@ def register_default_families():
     REGISTRY.register_family("serving", _serving)
     REGISTRY.register_family("recovery", _recovery)
     REGISTRY.register_family("step", _step)
+    REGISTRY.register_family("elastic", _elastic)
 
 
 def register_supervisor(sup):

@@ -402,6 +402,37 @@ def recovery_counters():
     return collect("recovery")
 
 
+def elastic_counters():
+    """Topology-elastic ledger: mesh shrinks/grows/reforms and snapshot
+    restores the ElasticMeshSupervisor performed, resume latency, steps
+    re-executed after a restore, live active-dp/world/failed-ranks gauges,
+    plus the reshard-on-load counters (checkpoints loaded across a
+    topology change, leaves moved, rejected mismatched loads). (Thin view
+    over the registry's "elastic" family.)"""
+    from ..observability import collect
+    return collect("elastic")
+
+
+def reset_elastic_counters():
+    from ..distributed import elastic as _el
+    from ..distributed import topology as _topo
+    _el.reset_elastic_counters()
+    _topo.reset_reshard_counters()
+
+
+def elastic_summary():
+    """One-line human-readable topology-elastic report."""
+    c = elastic_counters()
+    return (f"dp: {c['active_dp']}/{c['world_size']}  "
+            f"failed-ranks: {c['failed_ranks']}  "
+            f"shrinks: {c['shrinks']}  grows: {c['grows']}  "
+            f"restores: {c['elastic_restores']}  "
+            f"resharded-loads: {c['resharded_loads']} "
+            f"({c['resharded_leaves']} leaves)  "
+            f"steps-lost: {c['steps_lost']}  "
+            f"resume: {c['resume_latency_s_last'] * 1e3:.0f}ms")
+
+
 def benchmark():
     """Step-timer handle (ref profiler.utils.benchmark)."""
     return _Benchmark()

@@ -40,6 +40,15 @@ Serving chaos (the self-healing serving ladder):
                           silently dropped (frozen-process simulation): the
                           process looks alive, its heartbeat file goes
                           stale, and the monitor must report it failed.
+  * ``chip_loss_at`` /    — deterministic chip/rank-loss schedule for the
+    ``chip_return_at``      topology-elastic supervisor: ``{step: ranks}``
+                          dicts. ``lost_ranks(step)`` reports the
+                          cumulative lost set; the schedule is STICKY
+                          across restore rewinds (an internal high-water
+                          mark — a supervisor that restores to an earlier
+                          step after detecting the loss keeps seeing the
+                          rank as lost until a ``chip_return_at`` entry at
+                          a step the run has reached re-admits it).
   * ``surge``             — an ``ArrivalSurge``: a deterministic per-step
                           arrival-count schedule (seeded Poisson base rate
                           with a surge window at a multiplied rate). The
@@ -112,7 +121,8 @@ class FaultPlan:
     def __init__(self, nan_at_steps=(), io_error_on_writes=(),
                  preempt_at_step=None, kill_at_decode_step=None,
                  kill_engine_tag=None, io_error_on_snapshots=(),
-                 stale_heartbeat_ranks=(), surge=None):
+                 stale_heartbeat_ranks=(), surge=None,
+                 chip_loss_at=None, chip_return_at=None):
         self.nan_at_steps = frozenset(int(s) for s in nan_at_steps)
         self.io_error_on_writes = frozenset(int(n) for n in io_error_on_writes)
         self.preempt_at_step = (None if preempt_at_step is None
@@ -126,6 +136,20 @@ class FaultPlan:
         self.stale_heartbeat_ranks = frozenset(
             int(r) for r in stale_heartbeat_ranks)
         self.surge = surge
+
+        def _ranks_by_step(sched):
+            out = {}
+            for s, ranks in (sched or {}).items():
+                if isinstance(ranks, (int, np.integer)):
+                    ranks = (ranks,)
+                out[int(s)] = frozenset(int(r) for r in ranks)
+            return out
+
+        self.chip_loss_at = _ranks_by_step(chip_loss_at)
+        self.chip_return_at = _ranks_by_step(chip_return_at)
+        # high-water mark of steps the run has REACHED: a restore that
+        # rewinds the step counter must keep already-fired losses visible
+        self._chip_watermark = -1
         # one-shot: a respawned/replayed engine re-walks the same step
         # indices — re-firing the kill would loop the recovery forever
         self._kill_fired = False
@@ -133,7 +157,8 @@ class FaultPlan:
         self.stats = {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
                       "writes_seen": 0, "serving_kills": 0,
                       "snapshot_writes_seen": 0, "snapshot_io_errors": 0,
-                      "heartbeats_dropped": 0, "surged_arrivals": 0}
+                      "heartbeats_dropped": 0, "surged_arrivals": 0,
+                      "chip_losses": 0, "chip_returns": 0}
 
     def __repr__(self):
         return (f"FaultPlan(nan_at_steps={sorted(self.nan_at_steps)}, "
@@ -143,7 +168,9 @@ class FaultPlan:
                 f"kill_engine_tag={self.kill_engine_tag!r}, "
                 f"io_error_on_snapshots={sorted(self.io_error_on_snapshots)}, "
                 f"stale_heartbeat_ranks={sorted(self.stale_heartbeat_ranks)}, "
-                f"surge={self.surge!r})")
+                f"surge={self.surge!r}, "
+                f"chip_loss_at={dict(sorted((k, sorted(v)) for k, v in self.chip_loss_at.items()))}, "
+                f"chip_return_at={dict(sorted((k, sorted(v)) for k, v in self.chip_return_at.items()))})")
 
 
 _plan: FaultPlan | None = None
@@ -263,6 +290,34 @@ def surge_arrivals(step):
     return n
 
 
+def lost_ranks(step):
+    """Cumulative set of lost (and not yet returned) ranks as of ``step``
+    under the active plan's chip-loss schedule — the injected-device-
+    failure signal the topology-elastic supervisor polls at every step
+    boundary. The schedule is applied in step order up to the HIGHEST
+    step ever queried (sticky watermark): a supervisor that detects the
+    loss, restores an older snapshot and re-walks earlier step indices
+    keeps seeing the rank as lost, exactly like a real dead chip.
+    Zero-cost inactive (one attribute check); returns a frozenset."""
+    if _plan is None or not (_plan.chip_loss_at or _plan.chip_return_at):
+        return frozenset()
+    wm = _plan._chip_watermark
+    step = int(step)
+    if step > wm:
+        for s in range(wm + 1, step + 1):
+            _plan.stats["chip_losses"] += len(_plan.chip_loss_at.get(s, ()))
+            _plan.stats["chip_returns"] += len(
+                _plan.chip_return_at.get(s, ()))
+        _plan._chip_watermark = wm = step
+    lost = set()
+    for s in sorted(set(_plan.chip_loss_at) | set(_plan.chip_return_at)):
+        if s > wm:
+            break
+        lost |= _plan.chip_loss_at.get(s, frozenset())
+        lost -= _plan.chip_return_at.get(s, frozenset())
+    return frozenset(lost)
+
+
 def maybe_drop_heartbeat(rank):
     """Called by ``Heartbeat.beat()``: True when the plan freezes this
     rank's heartbeats (the beat is silently skipped, the file goes stale)."""
@@ -279,5 +334,6 @@ def stats():
         return {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
                 "writes_seen": 0, "serving_kills": 0,
                 "snapshot_writes_seen": 0, "snapshot_io_errors": 0,
-                "heartbeats_dropped": 0, "surged_arrivals": 0}
+                "heartbeats_dropped": 0, "surged_arrivals": 0,
+                "chip_losses": 0, "chip_returns": 0}
     return dict(plan.stats)

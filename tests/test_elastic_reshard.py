@@ -1,0 +1,814 @@
+"""Topology-elastic training: reshard-on-load checkpoints
+(distributed/topology.py + TrainStep.topology()/load_state_dict), the
+mesh-reforming ElasticMeshSupervisor (chip-loss detection, dp shrink/grow,
+resume from the resharded snapshot), and the satellites — checkpoint
+manifest topology metadata, HeartbeatMonitor resize, DataLoader
+global-sample position, RNG global-stream position, deterministic
+chip-loss fault plans, and the elastic observability family."""
+import os
+import time
+
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, profiler
+from paddle_tpu.distributed import elastic, topology
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.incubate.checkpoint import (
+    CheckpointCorruptError, CheckpointManager)
+from paddle_tpu.io import DataLoader
+from paddle_tpu.utils import fault_injection as fi
+
+
+_DEFAULT_FLAGS = {
+    "FLAGS_grad_comm": "auto",
+    "FLAGS_weight_update_sharding": False,
+    "FLAGS_allreduce_dtype": "float32",
+    "FLAGS_elastic_reshard": True,
+    "FLAGS_elastic_grow": True,
+}
+
+WUS = {"FLAGS_grad_comm": "on", "FLAGS_weight_update_sharding": True}
+
+
+@pytest.fixture(autouse=True)
+def _reset(devices8):
+    yield
+    paddle.set_flags(dict(_DEFAULT_FLAGS))
+    dist_env.set_mesh(None)
+    fi.deactivate()
+
+
+def _mesh(dp, ranks=None):
+    devs = None if ranks is None else [jax.devices()[r] for r in ranks]
+    return dist_env.create_hybrid_mesh(dp=dp, devices=devs)
+
+
+def _step(mesh=None, k=1, seed=7, width=8, flags=WUS):
+    paddle.set_flags(dict(_DEFAULT_FLAGS))
+    if flags:
+        paddle.set_flags(flags)
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(width, width), nn.ReLU(),
+                      nn.Linear(width, 4))
+    opt = paddle.optimizer.AdamW(0.01, parameters=m.parameters())
+    return paddle.jit.TrainStep(m, nn.MSELoss(), opt, mesh=mesh,
+                                accumulate_steps=k)
+
+
+def _data(n=8, width=8, rows=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, rows, width)).astype(np.float32),
+            rng.standard_normal((n, rows, 4)).astype(np.float32))
+
+
+def _run(step, X, Y, lo=0, hi=None):
+    hi = len(X) if hi is None else hi
+    for i in range(lo, hi):
+        step(paddle.to_tensor(X[i]), paddle.to_tensor(Y[i]))
+    return {n: np.asarray(a) for n, a in step.params.items()}
+
+
+def _slots(state):
+    return {(n, k): np.asarray(v)
+            for n, sl in state["opt_state"]["slots"].items()
+            for k, v in sl.items()}
+
+
+# ---------------------------------------------------------------------------
+# reshard matrix: dp x wus x accumulate_steps x wire dtype
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dp_src,dp_dst,k,wire", [
+    (8, 4, 1, "float32"),
+    (8, 4, 2, "float32"),          # mid-window accumulator travels too
+    (4, 8, 2, "bfloat16"),         # grow + compressed wire
+    (8, 2, 1, "int8"),
+    (2, 8, 3, "float32"),
+    (8, 1, 1, "float32"),          # shrink to a single device (no mesh)
+    (1, 8, 1, "float32"),          # param-shaped slots -> packed
+])
+def test_reshard_matrix_roundtrip_bitwise(dp_src, dp_dst, k, wire):
+    """Property over the reshard matrix: train a few steps on the source
+    topology (snapshot possibly MID accumulation window), load on the
+    destination topology, reshard the resulting state BACK to the source
+    layout with the host-side helper, and require BITWISE equality on
+    params + packed slots (+ accumulator) — padding included."""
+    flags = dict(WUS, FLAGS_allreduce_dtype=wire)
+    X, Y = _data(3 if k == 1 else 2 * k)
+    src = _step(mesh=_mesh(dp_src) if dp_src > 1 else None, k=k,
+                flags=flags if dp_src > 1 else None)
+    _run(src, X, Y, hi=3 if k == 1 else k + 1)  # k>1: land mid-window
+    snap = src.state_dict()
+    assert snap["topology"]["dp"] == dp_src
+    assert snap["topology"]["wus"] == (dp_src > 1)
+
+    dst = _step(mesh=_mesh(dp_dst) if dp_dst > 1 else None, k=k,
+                seed=11, flags=flags if dp_dst > 1 else None)
+    if dp_dst > 1:  # compile so the packed layout is fixed
+        _run(dst, X, Y, hi=1)
+    dst.load_state_dict(snap)
+    out = dst.state_dict()
+
+    # params are replicated: bitwise through the hop
+    for n in snap["params"]:
+        np.testing.assert_array_equal(np.asarray(snap["params"][n]),
+                                      np.asarray(out["params"][n]), n)
+    # slots: reshard the destination state back to the SOURCE packing on
+    # the host and compare bitwise (pad regions are zeros on both sides)
+    pshapes = {n: tuple(np.shape(a)) for n, a in snap["params"].items()}
+    n_src = dp_src if dp_src > 1 else None
+    back, _ = topology.reshard_opt_state(out["opt_state"], pshapes, n_src)
+    a, b = _slots(snap), _slots({"opt_state": back})
+    assert set(a) == set(b)
+    for key in a:
+        np.testing.assert_array_equal(a[key], b[key], key)
+    if k > 1:
+        gacc, _ = topology.reshard_accum(out["grad_accum"], pshapes, n_src)
+        for n in snap["grad_accum"]:
+            np.testing.assert_array_equal(np.asarray(snap["grad_accum"][n]),
+                                          np.asarray(gacc[n]), n)
+        assert int(out["micro"]) == int(snap["micro"])  # window continues
+
+
+def test_resharded_slots_restore_to_packed_sharded_placement():
+    """A dp=8 snapshot loaded on the dp=4 mesh lands with every slot in
+    the (4, cols) packed layout, dp-SHARDED on device (each replica holds
+    one (1, cols) shard) — never a replicated full materialization."""
+    X, Y = _data(4)
+    src = _step(mesh=_mesh(8))
+    _run(src, X, Y)
+    snap = src.state_dict()
+
+    dst = _step(mesh=_mesh(4, ranks=(0, 1, 2, 3)), seed=11)
+    _run(dst, X, Y, hi=1)
+    dst.load_state_dict(snap)
+    for name, sl in dst.opt_state["slots"].items():
+        for kk, arr in sl.items():
+            assert arr.shape[0] == 4, (name, kk, arr.shape)
+            assert arr.sharding.spec[0] == "dp", (name, kk)
+            shards = arr.addressable_shards
+            assert len(shards) == 4
+            assert shards[0].data.shape == (1, arr.shape[1])
+
+
+def test_resume_on_dp4_bitwise_and_loss_continuation():
+    """Gates (b) and (c): the dp=8 -> dp=4 resumed trajectory is BITWISE
+    identical to an independent dp=4 step restored from the same
+    snapshot, and the final params track the uninterrupted dp=8 run
+    within tolerance (the reduce order legitimately differs)."""
+    X, Y = _data(8)
+    golden = _run(_step(mesh=_mesh(8)), X, Y)
+
+    src = _step(mesh=_mesh(8))
+    _run(src, X, Y, hi=4)
+    snap = src.state_dict()
+
+    a = _step(mesh=_mesh(4, ranks=(0, 1, 2, 3)), seed=11)
+    a.load_state_dict(snap)
+    pa = _run(a, X, Y, lo=4)
+
+    b = _step(mesh=_mesh(4, ranks=(0, 1, 2, 3)), seed=23)
+    b.load_state_dict(snap)
+    pb = _run(b, X, Y, lo=4)
+
+    for n in pa:  # bitwise across independent restores
+        np.testing.assert_array_equal(pa[n], pb[n], n)
+    for n in golden:  # tolerance vs the uninterrupted topology
+        assert np.abs(golden[n] - pa[n]).max() < 2e-3, n
+
+
+def test_same_topology_restore_stays_bitwise():
+    """Reshard-on-load must not move a byte when the topology matches:
+    the PR 4/7 kill-and-resume contract is unchanged."""
+    X, Y = _data(6)
+    golden = _run(_step(mesh=_mesh(8), k=2), X, Y)
+    src = _step(mesh=_mesh(8), k=2)
+    _run(src, X, Y, hi=3)
+    snap = src.state_dict()
+    topology.reset_reshard_counters()
+    dst = _step(mesh=_mesh(8), k=2, seed=11)
+    dst.load_state_dict(snap)
+    resumed = _run(dst, X, Y, lo=3)
+    for n in golden:
+        np.testing.assert_array_equal(golden[n], resumed[n], n)
+    c = topology.reshard_counters()
+    assert c["resharded_loads"] == 0 and c["resharded_leaves"] == 0
+
+
+# ---------------------------------------------------------------------------
+# named-field diagnosis
+# ---------------------------------------------------------------------------
+
+
+def test_wrong_model_load_names_params():
+    X, Y = _data(2)
+    src = _step()
+    _run(src, X, Y)
+    snap = src.state_dict()
+    dst = _step(width=16, seed=1, flags=None)
+    with pytest.raises(topology.TopologyMismatchError) as ei:
+        dst.load_state_dict(snap)
+    msg = str(ei.value)
+    assert "0.weight" in msg and "(8, 8)" in msg and "(16, 16)" in msg
+
+
+def test_mid_window_accum_change_named(devices8):
+    """A MID-window snapshot cannot continue under a different
+    accumulate_steps — the refusal names the field and the window
+    position instead of silently corrupting the average."""
+    X, Y = _data(4)
+    src = _step(mesh=_mesh(8), k=2)
+    _run(src, X, Y, hi=3)  # micro=3: mid-window
+    snap = src.state_dict()
+    dst = _step(mesh=_mesh(4, ranks=(0, 1, 2, 3)), k=4, seed=11)
+    with pytest.raises(topology.TopologyMismatchError) as ei:
+        dst.load_state_dict(snap)
+    assert "accumulate_steps" in str(ei.value)
+    assert "micro=3" in str(ei.value)
+    # at a window BOUNDARY the change is legal and the count restarts
+    _run(src, X, Y, lo=3, hi=4)  # micro=4: boundary
+    snap2 = src.state_dict()
+    dst.load_state_dict(snap2)
+    assert dst._micro_py == 0
+
+
+def test_strict_mode_refuses_cross_topology_load():
+    X, Y = _data(2)
+    src = _step(mesh=_mesh(8))
+    _run(src, X, Y)
+    snap = src.state_dict()
+    dst = _step(mesh=_mesh(4, ranks=(0, 1, 2, 3)), seed=11)
+    _run(dst, X, Y, hi=1)
+    cold = _step(mesh=_mesh(4, ranks=(0, 1, 2, 3)), seed=12)
+    paddle.set_flags({"FLAGS_elastic_reshard": False})
+    before = topology.reshard_counters()["rejected_loads"]
+    with pytest.raises(topology.TopologyMismatchError) as ei:
+        dst.load_state_dict(snap)
+    assert "dp" in str(ei.value)
+    assert topology.reshard_counters()["rejected_loads"] == before + 1
+    # the refusal must also cover a NOT-YET-COMPILED step (whose reshard
+    # would otherwise happen at the first call's pack, past the flag)
+    with pytest.raises(topology.TopologyMismatchError):
+        cold.load_state_dict(snap)
+    paddle.set_flags({"FLAGS_elastic_reshard": True})
+    dst.load_state_dict(snap)  # flag back on: the same load reshards
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manifest topology metadata (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_records_topology_crc_covered(tmp_path):
+    X, Y = _data(4)
+    step = _step(mesh=_mesh(8))
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    step.attach_checkpoint(mgr, save_every=2)
+    _run(step, X, Y)
+    topo = mgr.manifest_topology()  # latest, read WITHOUT loading arrays
+    assert topo["dp"] == 8 and topo["wus"] is True
+    assert topo["mesh_axes"] == {"dp": 8}
+    assert topo["bucket_plan"]  # plan fingerprint travels
+    mgr.restore()
+    assert mgr.last_restored_topology == topo
+    # the record is CRC-covered: tampering is detected
+    import json
+    mpath = os.path.join(mgr._step_dir(mgr.latest_step()), "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest["topology"]["dp"] = 2
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(CheckpointCorruptError, match="topology"):
+        mgr.manifest_topology()
+
+
+def test_manifest_topology_absent_for_plain_states(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(1, {"w": paddle.to_tensor(np.zeros(4, np.float32))})
+    assert mgr.manifest_topology(1) is None
+    mgr.restore(1)
+    assert mgr.last_restored_topology is None
+    # torn manifest bytes surface as corruption, not a raw decode error
+    mpath = os.path.join(mgr._step_dir(1), "manifest.json")
+    with open(mpath, "w") as f:
+        f.write('{"step": 1, "arr')
+    with pytest.raises(CheckpointCorruptError, match="manifest"):
+        mgr.manifest_topology(1)
+
+
+def test_bucket_plan_fingerprint_tracks_axis_size():
+    from paddle_tpu.distributed.grad_comm import BucketPlan
+    params = {"w": np.zeros((8, 8), np.float32),
+              "b": np.zeros((8,), np.float32)}
+    p8 = BucketPlan.build(params, 8, 1 << 20)
+    p8b = BucketPlan.build(params, 8, 1 << 20)
+    p4 = BucketPlan.build(params, 4, 1 << 20)
+    assert p8.fingerprint() == p8b.fingerprint()
+    assert p8.fingerprint() != p4.fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# HeartbeatMonitor resize / rank-set updates (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_resize_retires_ranks_consistently(tmp_path):
+    """After a shrink the retired rank must NOT be reported failed
+    forever: set_ranks() narrows the watch set to the re-formed mesh."""
+    beats = {r: elastic.Heartbeat(tmp_path, rank=r) for r in range(4)}
+    for hb in beats.values():
+        hb.beat()
+    mon = elastic.HeartbeatMonitor(tmp_path, world_size=4, timeout=5.0)
+    assert mon.failed_ranks() == []
+    with fi.inject(fi.FaultPlan(stale_heartbeat_ranks=[2])):
+        time.sleep(0.02)
+        for hb in beats.values():
+            hb.beat()  # rank 2's write is dropped — its file ages
+        mon.timeout = 0.01
+        assert mon.failed_ranks() == [2]
+        # mesh re-forms without rank 2: the monitor follows
+        mon.set_ranks([0, 1, 3])
+        assert mon.ranks == (0, 1, 3)
+        assert mon.world_size == 3
+        assert mon.failed_ranks() == []  # retired rank no longer flagged
+        # one-shot probe of the retired rank (grow-back scan) still works
+        assert mon.failed_ranks(ranks=[2]) == [2]
+    for hb in beats.values():
+        hb.beat()  # plan inactive: rank 2 beats again
+    assert mon.failed_ranks(ranks=[2]) == []
+    mon.resize(4)  # grow back to a contiguous world
+    assert mon.ranks == (0, 1, 2, 3)
+    assert mon.failed_ranks() == []
+    mon.world_size = 2  # legacy assignment keeps working
+    assert mon.ranks == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# DataLoader global-sample position + RNG global-stream position (satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_dataloader_global_sample_resume_across_batch_size():
+    data = np.arange(24, dtype=np.float32)
+
+    class DS:
+        def __len__(self):
+            return 24
+
+        def __getitem__(self, i):
+            return data[i]
+
+    dl = DataLoader(DS(), batch_size=4)
+    it = iter(dl)
+    for _ in range(3):
+        next(it)  # 12 samples served
+    st = dl.state_dict()
+    assert st["samples_served"] == 12 and st["batch_size"] == 4
+    # resume with a DIFFERENT batch size: the sample position re-derives
+    # the batch skip (the old index-only skip silently desynced here)
+    dl2 = DataLoader(DS(), batch_size=2)
+    dl2.load_state_dict(st)
+    first = next(iter(dl2))
+    np.testing.assert_array_equal(np.asarray(first._data), [12.0, 13.0])
+
+
+def test_dataloader_indivisible_resume_named():
+    dl = DataLoader(list(range(24)), batch_size=5)
+    with pytest.raises(ValueError, match="samples_served=12"):
+        dl.load_state_dict({"samples_served": 12, "batch_size": 4,
+                            "batches_served": 3})
+
+
+def test_dataloader_iterable_short_final_batch_epoch_end():
+    """An IterableDataset (no len()) with a short final batch: the exact
+    sample count and the epoch_end marker make the position resumable on
+    a different batch size — the computed batches x batch_size count
+    would both overstate and be unrecognizable as an epoch boundary."""
+    from paddle_tpu.io import IterableDataset
+
+    class Stream(IterableDataset):
+        def __iter__(self):
+            return iter(np.arange(5, dtype=np.float32))
+
+    dl = DataLoader(Stream(), batch_size=2)
+    assert len(list(dl)) == 3  # 2+2+1
+    st = dl.state_dict()
+    assert st["samples_served"] == 5 and st.get("epoch_end") is True
+    # a restoring loader that cannot know the stream length resumes the
+    # epoch-end position via the marker (whole-epoch skip)
+    dl2 = DataLoader(Stream(), batch_size=4)
+    dl2.load_state_dict(st)
+    assert dl2._resume_skip == 2
+    assert list(dl2) == []  # served epoch skipped
+    # mid-epoch iterable position stays exact too
+    dl3 = DataLoader(Stream(), batch_size=2)
+    it = iter(dl3)
+    next(it)
+    st3 = dl3.state_dict()
+    assert st3["samples_served"] == 2 and "epoch_end" not in st3
+
+
+def test_dataloader_worker_prefetch_iterable_records_batches_only():
+    """Iterable dataset + worker prefetch: the generator runs ahead of
+    the consumer, so no exact sample count exists and (without a length
+    bound) batches x batch_size could overstate past a short final batch
+    — state_dict records the batch position only, and the resume takes
+    the legacy skip without a spurious boundary refusal."""
+    from paddle_tpu.io import IterableDataset
+
+    class Stream(IterableDataset):
+        def __iter__(self):
+            return iter(np.arange(10, dtype=np.float32))
+
+    dl = DataLoader(Stream(), batch_size=4, num_workers=1)
+    assert len(list(dl)) == 3  # 4+4+2
+    st = dl.state_dict()
+    assert st == {"batches_served": 3}  # no phantom samples_served=12
+    dl2 = DataLoader(Stream(), batch_size=5)
+    dl2.load_state_dict(st)  # legacy skip, no refusal
+    assert dl2._resume_skip == 3
+
+
+def test_dataloader_drop_last_epoch_end_resumable():
+    """drop_last=True truncates the tail (9 of 10 samples served), so the
+    epoch-end position is NOT len(dataset)-aligned — the explicit
+    epoch_end marker still makes it resumable on another batch size."""
+    dl = DataLoader(list(range(10)), batch_size=3, drop_last=True)
+    assert len(list(dl)) == 3
+    st = dl.state_dict()
+    assert st["samples_served"] == 9 and st.get("epoch_end") is True
+    dl2 = DataLoader(list(range(10)), batch_size=2)
+    dl2.load_state_dict(st)
+    assert dl2._resume_skip == 5  # whole-epoch skip
+
+
+def test_dataloader_legacy_state_still_loads():
+    dl = DataLoader(list(range(8)), batch_size=2)
+    dl.load_state_dict({"batches_served": 2})  # pre-topology checkpoint
+    assert dl._resume_skip == 2
+
+
+def test_dataloader_short_final_batch_position_exact():
+    """drop_last=False: the short final batch serves fewer than
+    batch_size samples — the recorded global-sample position must be the
+    TRUE sample count, not batches x batch_size."""
+    dl = DataLoader(list(range(5)), batch_size=2)
+    for _ in dl:
+        pass
+    st = dl.state_dict()
+    assert st == {"batches_served": 3, "samples_served": 5,
+                  "batch_size": 2, "epoch_end": True}
+    # 5 samples is a clean boundary for batch_size=5, not for 2
+    dl5 = DataLoader(list(range(5)), batch_size=5)
+    dl5.load_state_dict(st)
+    assert dl5._resume_skip == 1
+    # an IDENTICAL loader resumes the epoch-end position too (skip the
+    # whole epoch; next epoch starts fresh) — not a refusal
+    dl2 = DataLoader(list(range(5)), batch_size=2)
+    dl2.load_state_dict(st)
+    assert dl2._resume_skip == 3
+    assert list(dl2) == []  # one-shot skip of the served epoch
+    assert len(list(dl2)) == 3  # next epoch from the top
+    # a genuinely MID-epoch non-boundary position still refuses
+    dl3 = DataLoader(list(range(6)), batch_size=4)
+    with pytest.raises(ValueError, match="batch boundary"):
+        dl3.load_state_dict({"samples_served": 2, "batch_size": 2,
+                             "batches_served": 1})
+
+
+def test_dataloader_unknowable_batching_warns_on_fallback():
+    from paddle_tpu.io import BatchSampler
+
+    class NoSize:
+        def __iter__(self):
+            return iter([[0, 1], [2, 3]])
+
+        def __len__(self):
+            return 2
+
+    dl = DataLoader(list(range(4)), batch_sampler=NoSize())
+    with pytest.warns(UserWarning, match="samples-per-batch"):
+        dl.load_state_dict({"samples_served": 6, "batch_size": 2,
+                            "batches_served": 3})
+    assert dl._resume_skip == 3  # legacy batch skip, loudly
+
+
+def test_dataloader_distributed_sampler_records_global_samples():
+    """A DistributedBatchSampler yields this host's 1/nranks shard: one
+    yield advances the GLOBAL stream by batch_size * nranks — the
+    recorded position must be global, or a resume on a different replica
+    count silently desyncs."""
+    from paddle_tpu.io import DistributedBatchSampler
+    ds = list(range(32))
+    bs = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=0)
+    dl = DataLoader(ds, batch_sampler=bs)
+    it = iter(dl)
+    next(it)
+    st = dl.state_dict()
+    assert st["samples_served"] == 8 and st["batch_size"] == 8
+    # resume single-host: 8 global samples = 2 local batches of 4
+    dl2 = DataLoader(ds, batch_size=4)
+    dl2.load_state_dict(st)
+    assert dl2._resume_skip == 2
+
+
+def test_reshard_leaf_scalar_param_packs():
+    """Scalar params pack to (n, 1) like the pre-reshard pack path did."""
+    v = np.asarray(3.5, np.float32)
+    packed, moved = topology.reshard_leaf(v, (), 8)
+    assert moved and packed.shape == (8, 1)
+    assert packed[0, 0] == np.float32(3.5) and packed[1:].sum() == 0
+    back, moved = topology.reshard_leaf(packed, (), None)
+    assert moved and back.shape == () and back == np.float32(3.5)
+    same, moved = topology.reshard_leaf(v, (), None)
+    assert not moved and same is v
+
+
+def test_restore_k1_checkpoint_into_accum_step_resets_window():
+    """A checkpoint from a non-accumulating run restored into an
+    accumulate_steps>1 step must ZERO the live accumulator and micro
+    counter — not mix pre-restore partial gradients into the first
+    post-restore update."""
+    X, Y = _data(4)
+    src = _step(flags=None)  # k=1, no mesh
+    _run(src, X, Y, hi=2)
+    snap = src.state_dict()
+    dst = _step(k=2, seed=11, flags=None)
+    _run(dst, X, Y, hi=3)  # micro=3: mid-window, accumulator live
+    assert dst._micro_py == 3
+    dst.load_state_dict(snap)
+    assert dst._micro_py == 0 and int(np.asarray(dst._micro)) == 0
+    for n, a in dst._grad_accum.items():
+        assert np.asarray(a).sum() == 0, n
+
+
+def test_rng_stream_position_recorded():
+    from paddle_tpu.framework import random as rnd
+    rnd.seed(123)
+    assert rnd.stream_position() == 0
+    for _ in range(5):
+        rnd.next_key()
+    st = rnd.state_dict()
+    assert st["draws"] == 5
+    rnd.seed(0)
+    rnd.set_state_dict(st)
+    assert rnd.stream_position() == 5
+    k6 = rnd.next_key()
+    rnd.seed(123)
+    for _ in range(6):
+        ref = rnd.next_key()
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(k6)),
+                                  np.asarray(jax.random.key_data(ref)))
+
+
+# ---------------------------------------------------------------------------
+# deterministic chip-loss plans (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_chip_loss_plan_sticky_watermark():
+    with fi.inject(fi.FaultPlan(chip_loss_at={5: [2], 7: 3},
+                                chip_return_at={9: [2]})):
+        assert fi.lost_ranks(0) == frozenset()
+        assert fi.lost_ranks(5) == {2}
+        # a restore rewinds the step counter: the loss stays visible
+        assert fi.lost_ranks(3) == {2}
+        assert fi.lost_ranks(7) == {2, 3}
+        assert fi.lost_ranks(9) == {3}   # rank 2 returned
+        assert fi.lost_ranks(4) == {3}   # return is sticky too
+        assert fi.stats()["chip_losses"] == 2
+        assert fi.stats()["chip_returns"] == 1
+    assert fi.lost_ranks(100) == frozenset()  # zero-cost inactive
+
+
+# ---------------------------------------------------------------------------
+# mesh-reforming supervisor
+# ---------------------------------------------------------------------------
+
+
+def _factory(seed=7, k=1):
+    def factory(mesh):
+        return _step(mesh=mesh, k=k, seed=seed)
+    return factory
+
+
+def test_viable_dp_selection(tmp_path):
+    sup = elastic.ElasticMeshSupervisor(_factory(), None, global_batch=16)
+    assert sup.viable_dp(8) == 8
+    assert sup.viable_dp(7) == 4   # largest divisor of 16 that fits
+    assert sup.viable_dp(3) == 2
+    assert sup.viable_dp(1) == 1
+    sup_min = elastic.ElasticMeshSupervisor(_factory(), None,
+                                            global_batch=16, min_dp=4)
+    with pytest.raises(RuntimeError, match="min_dp=4"):
+        sup_min.viable_dp(3)
+
+
+def test_supervisor_kill_shrink_resume_zero_manual_steps(tmp_path):
+    """The acceptance rung: kill a rank mid-run on dp=8; the supervisor
+    re-forms dp=4 and resumes from the resharded snapshot — no manual
+    steps — and the elastic events land in the observability registry."""
+    profiler.reset_elastic_counters()
+    X, Y = _data(8)
+    golden = _run(_step(mesh=_mesh(8)), X, Y)
+    mgr = CheckpointManager(tmp_path, async_save=False, keep_last_n=50)
+    sup = elastic.ElasticMeshSupervisor(_factory(), mgr, global_batch=16,
+                                        save_every=2, grow=False)
+    with fi.inject(fi.FaultPlan(chip_loss_at={5: [2]})):
+        step = sup.run(lambda t: (X[t], Y[t]), 8)
+    kinds = [(e["kind"], e["dp"]) for e in sup.events]
+    assert ("shrink", 4) in kinds
+    assert sup.dp == 4 and sup.failed == {2}
+    shrink = next(e for e in sup.events if e["kind"] == "shrink")
+    assert shrink["restored_step"] == 4  # newest snapshot before the loss
+    final = {n: np.asarray(a) for n, a in step.params.items()}
+    for n in golden:
+        assert np.abs(golden[n] - final[n]).max() < 2e-3, n
+    # counters visible through the registry family and Prometheus text
+    c = profiler.elastic_counters()
+    assert c["shrinks"] == 1 and c["elastic_restores"] >= 1
+    assert c["active_dp"] == 4 and c["failed_ranks"] == 1
+    from paddle_tpu import observability
+    snap = observability.snapshot()
+    assert snap["elastic.shrinks"] == 1
+    from paddle_tpu.observability import prometheus
+    text = prometheus.render(snap)
+    assert "paddle_tpu_elastic_shrinks 1" in text
+    assert "paddle_tpu_elastic_resharded_leaves" in text
+
+
+def test_supervisor_grow_back_reuses_memoized_step(tmp_path):
+    profiler.reset_elastic_counters()
+    X, Y = _data(10)
+    mgr = CheckpointManager(tmp_path, async_save=False, keep_last_n=50)
+    sup = elastic.ElasticMeshSupervisor(_factory(), mgr, global_batch=16,
+                                        save_every=2)
+    with fi.inject(fi.FaultPlan(chip_loss_at={4: [0]},
+                                chip_return_at={7: [0]})):
+        sup.run(lambda t: (X[t], Y[t]), 10)
+    kinds = [(e["kind"], e["dp"]) for e in sup.events]
+    assert kinds == [("start", 8), ("shrink", 4), ("grow", 8)]
+    assert sup.dp == 8 and sup.failed == frozenset()
+    # the dp=8 step of the grow is the memoized start step (same devices)
+    assert len(sup._steps) == 2
+    c = profiler.elastic_counters()
+    assert c["grows"] == 1 and c["shrinks"] == 1
+
+
+def test_supervisor_grow_snapshots_live_state_no_rollback(tmp_path):
+    """A grow loses no live state: the supervisor snapshots the running
+    step BEFORE re-forming, so the grown mesh resumes at the exact step
+    reached — zero rolled-back steps — instead of rewinding to the last
+    cadence snapshot."""
+    X, Y = _data(10)
+    mgr = CheckpointManager(tmp_path, async_save=False, keep_last_n=50)
+    sup = elastic.ElasticMeshSupervisor(_factory(), mgr, global_batch=16,
+                                        save_every=3)
+    with fi.inject(fi.FaultPlan(chip_loss_at={4: [2]},
+                                chip_return_at={6: [2]})):
+        sup.run(lambda t: (X[t], Y[t]), 10)
+    grow = next(e for e in sup.events if e["kind"] == "grow")
+    assert grow["restored_step"] == 6  # the live step, not snapshot 3
+    assert not grow["fresh_start"]
+
+
+def test_supervisor_no_snapshot_never_resumes_stale_memo(tmp_path):
+    """With no snapshot on disk, a reform must NEVER resurrect a
+    memoized step's stale in-memory state: the topology restarts fresh
+    (recorded as fresh_start) and later reforms pick up from real
+    snapshots only."""
+    X, Y = _data(12)
+    mgr = CheckpointManager(tmp_path, async_save=False, keep_last_n=50)
+    # save_every larger than the first kill: the shrink finds NO snapshot
+    sup = elastic.ElasticMeshSupervisor(_factory(), mgr, global_batch=16,
+                                        save_every=4)
+    with fi.inject(fi.FaultPlan(chip_loss_at={2: [1]},
+                                chip_return_at={5: [1]})):
+        step = sup.run(lambda t: (X[t], Y[t]), 12)
+    shrink = next(e for e in sup.events if e["kind"] == "shrink")
+    assert shrink["fresh_start"] and shrink["restored_step"] is None
+    grow = next(e for e in sup.events if e["kind"] == "grow")
+    # the grow restored the dp=4 live snapshot — not the start step's
+    # stale memo (which still held its pre-kill step counter)
+    assert not grow["fresh_start"] and grow["restored_step"] == 5
+    assert step._step == 12
+
+
+def test_supervisor_spare_flap_does_not_reform(tmp_path):
+    """A retired, never-active rank returning (or a spare dying) leaves
+    the active mesh unchanged: the supervisor must NOT tear down the
+    live step — with no snapshot on disk that reform would silently
+    restart training from step 0."""
+    X, Y = _data(8)
+    mgr = CheckpointManager(tmp_path, async_save=False, keep_last_n=50)
+    sup = elastic.ElasticMeshSupervisor(_factory(), mgr, global_batch=16,
+                                        save_every=100)
+    with fi.inject(fi.FaultPlan(chip_loss_at={2: [5, 6, 7]},
+                                chip_return_at={5: [5]})):
+        step = sup.run(lambda t: (X[t], Y[t]), 8)
+    kinds = [e["kind"] for e in sup.events]
+    assert kinds == ["start", "shrink"]  # rank 5's return reformed nothing
+    assert sup.active == (0, 1, 2, 3)
+    assert sup.failed == {6, 7}  # the ledger still tracks it
+    assert step._step == 8
+
+
+def test_supervisor_grow_with_lost_active_rank_restores_from_disk(tmp_path):
+    """A 'grow' (dp increases) that simultaneously LOST a currently
+    active rank must not snapshot the live step (its shards may be gone)
+    — it resumes from the last disk snapshot like a shrink."""
+    X, Y = _data(10, rows=12)
+    mgr = CheckpointManager(tmp_path, async_save=False, keep_last_n=50)
+    sup = elastic.ElasticMeshSupervisor(
+        _factory(), mgr, global_batch=12, save_every=2)
+    with fi.inject(fi.FaultPlan(
+            chip_loss_at={2: [0, 1, 2, 3, 4], 5: [5]},
+            chip_return_at={5: [0, 1, 2, 3]})):
+        sup.run(lambda t: (X[t], Y[t]), 8)
+    grow = next(e for e in sup.events if e["kind"] == "grow")
+    assert 5 in grow["failed"]  # active rank 5 died in the same event
+    assert grow["restored_step"] == 4  # disk snapshot, NOT the live step 5
+    assert sup.dp == 6
+
+
+def test_verify_off_manager_still_captures_topology(tmp_path):
+    X, Y = _data(2)
+    step = _step(mesh=_mesh(8))
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    step.attach_checkpoint(mgr, save_every=2)
+    _run(step, X, Y)
+    lax_mgr = CheckpointManager(tmp_path, async_save=False, verify=False)
+    lax_mgr.restore()
+    assert lax_mgr.last_restored_topology is not None
+    assert lax_mgr.last_restored_topology["dp"] == 8
+
+
+def test_supervisor_stale_heartbeat_detection(tmp_path):
+    """Failure detection via heartbeats: one rank's beats are dropped
+    (frozen process); its file ages past the timeout and the supervisor
+    shrinks — no injected chip loss involved."""
+    X, Y = _data(10)
+    mgr = CheckpointManager(tmp_path / "ckpt", async_save=False)
+    for r in range(8):  # all ranks alive and fresh at startup
+        elastic.Heartbeat(tmp_path / "hb", rank=r).beat()
+    sup = elastic.ElasticMeshSupervisor(
+        _factory(), mgr, global_batch=16, save_every=2, grow=False,
+        heartbeat_dir=tmp_path / "hb", heartbeat_timeout=0.12)
+
+    def slow_batch(t):
+        time.sleep(0.04)
+        return X[t % len(X)], Y[t % len(Y)]
+
+    with fi.inject(fi.FaultPlan(stale_heartbeat_ranks=[3])):
+        sup.run(slow_batch, 10)
+    assert 3 in sup.failed
+    assert sup.dp == 4
+    assert ("shrink", 4) in [(e["kind"], e["dp"]) for e in sup.events]
+
+
+def test_supervisor_no_viable_mesh_named(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    sup = elastic.ElasticMeshSupervisor(_factory(), mgr, global_batch=16,
+                                        min_dp=8)
+    X, Y = _data(4)
+    with fi.inject(fi.FaultPlan(chip_loss_at={1: [2]})):
+        with pytest.raises(RuntimeError, match="no viable mesh"):
+            sup.run(lambda t: (X[t], Y[t]), 4)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 rung of the elastic chaos ladder (full ladder is slow-marked)
+# ---------------------------------------------------------------------------
+
+
+def _smoke():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "tools_fault_smoke",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools_fault_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_elastic_ladder_deterministic_rung():
+    """tools_fault_smoke's topology-elastic ladder, fast deterministic
+    sub-rung: kill-shrink-resume (bitwise vs an independent dp=4 restore)
+    and grow-back."""
+    out = _smoke().run_elastic_ladder(deterministic=True)
+    assert out["ok"], out
+    assert out["kill_shrink"]["bitwise_vs_dp4"]
+    assert out["grow_back"]["grew"]
+
+
+@pytest.mark.slow
+def test_elastic_ladder_full():
+    out = _smoke().run_elastic_ladder()
+    assert out["ok"], out
+    assert out["shrink_accum"]["mid_window_restore"]

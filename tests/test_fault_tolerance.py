@@ -689,7 +689,10 @@ def test_dataloader_position_state_skips_without_fetching():
         seen.append(np.asarray(b._data).tolist())
         if i == 2:
             st = dl.state_dict()
-            assert st == {"batches_served": 3}
+            # position recorded in GLOBAL-SAMPLE terms (topology-elastic
+            # resume) alongside the raw batch count
+            assert st == {"batches_served": 3, "samples_served": 6,
+                          "batch_size": 2}
     fetched.clear()
     dl2 = DataLoader(Tracking(), batch_size=2)
     dl2.load_state_dict(st)

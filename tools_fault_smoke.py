@@ -38,8 +38,28 @@ Serving chaos ladder (run_serving_ladder; the self-healing serving legs):
                              process); the supervisor fails it over; zero
                              requests dropped, bitwise
 
+Topology-elastic ladder (run_elastic_ladder; the mesh-reforming legs —
+each seeded, injected chip loss, zero wall-clock dependence):
+
+ 10. elastic-kill-shrink-resume — dp=8 + weight-update sharding, a rank
+                             is lost mid-run; the ElasticMeshSupervisor
+                             re-forms dp=4 from the survivors and resumes
+                             from the resharded snapshot with ZERO manual
+                             steps; the resumed dp=4 trajectory is BITWISE
+                             identical to an independent dp=4 step
+                             restored from the same snapshot, and the
+                             final params track the uninterrupted dp=8 run
+                             within tolerance (reduce order differs)
+ 11. elastic-grow-back      — the lost rank returns; the supervisor grows
+                             the mesh back to dp=8 (memoized executables
+                             reused) and finishes within tolerance
+ 12. elastic-shrink-accum   — accumulate_steps=2 with the snapshot landing
+                             MID accumulation window; the resharded
+                             accumulator + micro counter continue the
+                             window on the dp=4 mesh
+
   python tools_fault_smoke.py [--steps N] [--kill-step K] [--seed S]
-                              [--skip-serving]
+                              [--skip-serving] [--skip-elastic]
 
 Prints, machine-greppable:
 
@@ -414,6 +434,199 @@ def leg_serve_stale_heartbeat(seed):
         shutil.rmtree(d, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# topology-elastic ladder (mesh-reforming supervisor + reshard-on-load)
+# ---------------------------------------------------------------------------
+
+ELASTIC_FLAGS = {"FLAGS_grad_comm": "on",
+                 "FLAGS_weight_update_sharding": True}
+
+
+def _elastic_fixture(seed, k=1, width=16, rows=16, steps=12):
+    """(factory, batch_fn, golden_params) for one elastic leg: a dp-mesh
+    TrainStep factory under weight-update sharding, a deterministic
+    global-batch schedule, and the uninterrupted dp=8 golden params."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import env as dist_env
+
+    def factory(mesh):
+        paddle.set_flags(dict(DEFAULT_FLAGS))
+        paddle.set_flags(ELASTIC_FLAGS)
+        paddle.seed(seed)
+        m = nn.Sequential(nn.Linear(width, width), nn.GELU(),
+                          nn.Linear(width, 8))
+        opt = paddle.optimizer.AdamW(0.01, parameters=m.parameters())
+        return paddle.jit.TrainStep(m, nn.MSELoss(), opt, mesh=mesh,
+                                    accumulate_steps=k)
+
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((steps, rows, width)).astype(np.float32)
+    Y = rng.standard_normal((steps, rows, 8)).astype(np.float32)
+    batch_fn = lambda t: (X[t], Y[t])  # noqa: E731
+
+    mesh = dist_env.create_hybrid_mesh(dp=8)
+    g = factory(mesh)
+    for i in range(steps):
+        g(paddle.to_tensor(X[i]), paddle.to_tensor(Y[i]))
+    golden = {n: np.asarray(a) for n, a in g.params.items()}
+    dist_env.set_mesh(None)
+    return factory, batch_fn, golden
+
+
+def _max_dev(a, b):
+    import numpy as np
+    return max(float(np.abs(a[n] - np.asarray(b[n])).max()) for n in a)
+
+
+def leg_elastic_kill_shrink(seed, steps=12, kill_step=5, save_every=2,
+                            k=1, name="elastic-kill-shrink-resume"):
+    """Kill one rank mid-run on dp=8; the supervisor re-forms dp=4 and
+    resumes from the resharded snapshot. Gates: the shrink happened with
+    zero manual steps, the post-shrink trajectory is BITWISE identical to
+    an independent dp=4 restore of the same snapshot, and the final
+    params track the uninterrupted dp=8 run within tolerance."""
+    import tempfile
+
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed import elastic, env as dist_env
+    from paddle_tpu.incubate.checkpoint import CheckpointManager
+    from paddle_tpu.utils import fault_injection as fi
+
+    factory, batch_fn, golden = _elastic_fixture(seed, k=k, steps=steps)
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d, async_save=False, keep_last_n=50)
+        sup = elastic.ElasticMeshSupervisor(
+            factory, mgr, global_batch=16, save_every=save_every, grow=False)
+        with fi.inject(fi.FaultPlan(chip_loss_at={kill_step: [2]})):
+            step = sup.run(batch_fn, steps)
+        final = {n: np.asarray(a) for n, a in step.params.items()}
+        shrinks = [e for e in sup.events if e["kind"] == "shrink"]
+        restored = shrinks[0]["restored_step"] if shrinks else None
+        # independent dp=4 resume from the SAME snapshot: bitwise gate
+        bitwise = False
+        if restored is not None:
+            dist_env.set_mesh(None)
+            mesh4 = dist_env.create_hybrid_mesh(
+                dp=4, devices=[jax.devices()[r] for r in (0, 1, 3, 4)])
+            ref = factory(mesh4)
+            ref.load_state_dict(mgr.restore(restored))
+            for t in range(restored, steps):
+                x, y = batch_fn(t)
+                ref(paddle.to_tensor(x), paddle.to_tensor(y))
+            bitwise = all(
+                np.array_equal(final[n], np.asarray(a))
+                for n, a in ref.params.items())
+        dev = _max_dev(golden, final)
+        out = {"name": name,
+               "shrank": bool(shrinks) and shrinks[0]["dp"] == 4,
+               "restored_step": restored, "bitwise_vs_dp4": bitwise,
+               "max_dev_vs_dp8": dev, "tol": 2e-3,
+               "events": [(e["kind"], e["dp"]) for e in sup.events],
+               "counters": profiler.elastic_counters()}
+        out["ok"] = out["shrank"] and bitwise and dev < out["tol"]
+    dist_env.set_mesh(None)
+    paddle.set_flags(dict(DEFAULT_FLAGS))
+    return out
+
+
+def leg_elastic_grow_back(seed, steps=12, kill_step=4, return_step=8,
+                          save_every=2):
+    """The lost rank returns mid-run: the supervisor grows the mesh back
+    (dp=8 again, kill of rank 0 makes the shrunk mesh NON-contiguous) and
+    finishes within tolerance of the uninterrupted run."""
+    import tempfile
+
+    import numpy as np
+    from paddle_tpu import profiler
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import elastic, env as dist_env
+    from paddle_tpu.incubate.checkpoint import CheckpointManager
+    from paddle_tpu.utils import fault_injection as fi
+
+    factory, batch_fn, golden = _elastic_fixture(seed, steps=steps)
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d, async_save=False, keep_last_n=50)
+        sup = elastic.ElasticMeshSupervisor(
+            factory, mgr, global_batch=16, save_every=save_every)
+        with fi.inject(fi.FaultPlan(chip_loss_at={kill_step: [0]},
+                                    chip_return_at={return_step: [0]})):
+            step = sup.run(batch_fn, steps)
+        final = {n: np.asarray(a) for n, a in step.params.items()}
+        kinds = [e["kind"] for e in sup.events]
+        dev = _max_dev(golden, final)
+        out = {"name": "elastic-grow-back",
+               "shrank": "shrink" in kinds, "grew": "grow" in kinds,
+               "final_dp": sup.dp, "max_dev_vs_dp8": dev, "tol": 2e-3,
+               "events": [(e["kind"], e["dp"]) for e in sup.events],
+               "counters": profiler.elastic_counters()}
+        out["ok"] = (out["shrank"] and out["grew"] and sup.dp == 8
+                     and dev < out["tol"])
+    dist_env.set_mesh(None)
+    paddle.set_flags(dict(DEFAULT_FLAGS))
+    return out
+
+
+def leg_elastic_shrink_accum(seed, steps=12, kill_step=5, save_every=3):
+    """accumulate_steps=2 with the snapshot cadence landing MID
+    accumulation window: the resharded accumulator + micro counter must
+    continue the window consistently on the shrunk mesh."""
+    out = leg_elastic_kill_shrink(seed, steps=steps, kill_step=kill_step,
+                                  save_every=save_every, k=2,
+                                  name="elastic-shrink-accum")
+    out["name"] = "elastic-shrink-accum"
+    # save_every=3 with k=2: snapshots at micro 3 and 9 are mid-window
+    out["mid_window_restore"] = out["restored_step"] is not None and \
+        out["restored_step"] % 2 == 1
+    out["ok"] = out["ok"] and out["mid_window_restore"]
+    return out
+
+
+def run_elastic_ladder(deterministic=False, seed=7):
+    """The topology-elastic chaos ladder. ``deterministic=True`` is the
+    fast tier-1 sub-rung (kill-shrink-resume + grow-back at small step
+    counts); the full ladder adds the mid-accumulation-window shrink and
+    prints machine-greppable lines. Every leg is injected chip loss —
+    zero wall-clock dependence."""
+    from paddle_tpu import profiler
+
+    profiler.reset_elastic_counters()
+    if deterministic:
+        ks = leg_elastic_kill_shrink(seed, steps=8, kill_step=4)
+        gb = leg_elastic_grow_back(seed + 1, steps=8, kill_step=3,
+                                   return_step=6)
+        return {"kill_shrink": ks, "grow_back": gb,
+                "ok": ks["ok"] and gb["ok"],
+                "elastic": profiler.elastic_counters()}
+    ks = leg_elastic_kill_shrink(seed)
+    print(f"FAULT_SMOKE elastic-kill-shrink-resume: "
+          f"{'OK' if ks['ok'] else 'FAIL'}  dp8->dp4 "
+          f"restored=step_{ks['restored_step']} "
+          f"bitwise-vs-independent-dp4={ks['bitwise_vs_dp4']} "
+          f"max-dev-vs-dp8={ks['max_dev_vs_dp8']:.2e}")
+    gb = leg_elastic_grow_back(seed + 1)
+    print(f"FAULT_SMOKE elastic-grow-back: "
+          f"{'OK' if gb['ok'] else 'FAIL'}  events={gb['events']} "
+          f"final-dp={gb['final_dp']} "
+          f"max-dev-vs-dp8={gb['max_dev_vs_dp8']:.2e}")
+    sa = leg_elastic_shrink_accum(seed + 2)
+    print(f"FAULT_SMOKE elastic-shrink-accum: "
+          f"{'OK' if sa['ok'] else 'FAIL'}  "
+          f"mid-window-restore={sa['mid_window_restore']} "
+          f"bitwise-vs-independent-dp4={sa['bitwise_vs_dp4']} "
+          f"max-dev-vs-dp8={sa['max_dev_vs_dp8']:.2e}")
+    out = {"kill_shrink": ks, "grow_back": gb, "shrink_accum": sa,
+           "ok": ks["ok"] and gb["ok"] and sa["ok"],
+           "elastic": profiler.elastic_counters()}
+    print(f"FAULT_SMOKE elastic-ladder: {'OK' if out['ok'] else 'FAIL'}  "
+          f"{profiler.elastic_summary()}")
+    return out
+
+
 def run_serving_ladder(quick=True, deterministic=False, seed=7):
     """The serving chaos ladder. ``deterministic=True`` is the fast tier-1
     sub-rung: kill-resume + rolling-restart only, tiny traffic, no
@@ -473,6 +686,8 @@ def main():
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--skip-serving", action="store_true",
                     help="skip the serving chaos ladder")
+    ap.add_argument("--skip-elastic", action="store_true",
+                    help="skip the topology-elastic ladder")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -491,6 +706,9 @@ def main():
     leg_nan_rollback(paddle, nn, fi, args)
     leg_io_chaos(paddle, fi, args)
     paddle.set_flags(dict(DEFAULT_FLAGS))
+    if not args.skip_elastic:
+        out = run_elastic_ladder(seed=args.seed)
+        assert out["ok"], out
     if not args.skip_serving:
         out = run_serving_ladder(quick=False, seed=args.seed)
         assert out["requests_dropped"] == 0, out
