@@ -88,6 +88,19 @@ def requested(axis):
     return parse(_flags().get("FLAGS_comm_backend", "")).get(axis)
 
 
+def serving_requested():
+    """The serving engine's mp rung from ``FLAGS_comm_backend`` (None when
+    the flag leaves mp alone — the engine then defaults to ``gspmd``).
+    Serving interprets the rungs over its GATHER-ONLY schedule
+    (``tp_overlap.resolve_serving``): ``gspmd`` = whole all-gather
+    collectives, ``ring`` = ppermute decomposition, ``fused`` = Pallas
+    in-kernel rings (``fused_gemm_ag`` on the column-parallel projections,
+    ``fused_ag_bucket`` on the context/activation gathers). All rungs are
+    bitwise-identical — the backend choice moves bytes differently, never
+    changes math."""
+    return requested("mp")
+
+
 def fused_mesh_ok(mesh):
     """Interpret-mode remote DMA (jax<0.5 discharge rule) supports exactly
     ONE named mesh axis; on a real TPU the kernels compute flat logical

@@ -406,6 +406,135 @@ def resolve_gpt(config, mesh, batch=None, seq=None):
 
 
 # ---------------------------------------------------------------------------
+# serving entry points: the tensor-parallel serving engine's mp rung
+# (serving/mp_forward.py) resolves its collective schedule here, next to
+# the training schedule it mirrors
+
+
+@dataclass(frozen=True)
+class ServingMPConfig:
+    """Static mp configuration of a serving engine (hashable — it keys the
+    engine's memoized executable builders). ``backend`` names the serving
+    RUNG: 'gspmd' (whole all-gather collectives — the schedule the
+    partitioner would emit for a gather-only program), 'ring' (ppermute
+    decomposition) or 'fused' (Pallas in-kernel rings). All three rungs
+    run the SAME gather-only math, so engine output is bitwise identical
+    across rungs AND to the single-chip engine."""
+    axis: str
+    n: int
+    backend: str       # 'gspmd' | 'ring' | 'fused'
+    shard_vocab: bool  # lm head + logits AG sharded over vocab (V % n == 0)
+
+    def kernel_meta(self, mesh):
+        if self.backend != "fused":
+            return None
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        return _fc.meta_for(mesh, self.axis)
+
+
+def resolve_serving(config, mesh, backend=None):
+    """Resolve the serving engine's mp schedule for ``mesh`` (a 1-D 'mp'
+    mesh; other axes must be size 1). Returns ``ServingMPConfig`` or None
+    when mp <= 1. Unlike ``resolve_gpt`` the serving schedule is
+    GATHER-ONLY — every GEMM shards its OUTPUT dim and keeps the full
+    contraction, so no cross-chip reduction ever happens and the engine's
+    bitwise-parity contract with single-chip ``generate_from_params``
+    survives sharding. Hard config errors raise (a serving deploy must not
+    silently change layout); backend ineligibility degrades one rung with
+    a warning naming the fix, like the training resolver."""
+    if mesh is None:
+        return None
+    mp = int(mesh.shape.get("mp", 1))
+    if mp <= 1:
+        return None
+    extra = [a for a in mesh.axis_names
+             if a != "mp" and mesh.shape.get(a, 1) > 1]
+    if extra:
+        raise ValueError(
+            f"serving mp mesh must be 1-D over 'mp'; axes {extra} have "
+            f"size > 1 (build the replica mesh with "
+            f"dist_env.create_single_axis_mesh('mp', n) or "
+            f"serving.mp_replica_meshes)")
+    H = config.hidden_size
+    nh = config.num_heads
+    I = config.ffn_mult * H
+    if H % mp or nh % mp or I % mp:
+        raise ValueError(
+            f"serving mp={mp} must divide hidden {H}, heads {nh} and ffn "
+            f"{I} (choose an mp degree dividing all three)")
+    if backend is None:
+        from . import comm_backend
+        backend = comm_backend.serving_requested() or "gspmd"
+    if backend == "fused":
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        ok, why = _fc.supported(
+            mesh, shapes=(H, 3 * H // mp, I // mp, H // mp),
+            why="serving mp")
+        if not ok:
+            _warn_once(("fused-serving", tuple(mesh.axis_names)),
+                       f"fused serving backend unavailable: {why} — "
+                       f"falling back to FLAGS_comm_backend='mp=ring'")
+            backend = "ring"
+    if backend == "ring" and jax.default_backend() == "cpu" and \
+            jnp.dtype(config.compute_dtype or "float32") == jnp.bfloat16:
+        _warn_once("cpu-bf16-serving-ring",
+                   "serving ring rung uses ppermute, which the XLA CPU "
+                   "backend cannot partition in bf16 — using whole "
+                   "collectives (gspmd rung) on CPU")
+        backend = "gspmd"
+    shard_vocab = config.vocab_size % mp == 0
+    if not shard_vocab:
+        _warn_once(("serving-vocab", config.vocab_size, mp),
+                   f"vocab {config.vocab_size} not divisible by serving "
+                   f"mp={mp}: the embedding stays feature-sharded but the "
+                   f"lm head and logits stay replicated (pad the vocab to "
+                   f"a multiple of mp to shard them)")
+    return ServingMPConfig(axis="mp", n=mp, backend=str(backend),
+                           shard_vocab=shard_vocab)
+
+
+def serving_step_record(config, cfg: ServingMPConfig, B, T):
+    """Static per-device mp wire ledger of ONE fused serving dispatch at
+    window shape [B, T] (decode: [slots, 1]; prefill chunk: [1, rung]).
+    Gather-only schedule — per block an AG of the attention context
+    (contraction input of the out projection), the out projection's output
+    blocks, the FFN activation and the down projection's output blocks,
+    plus the embedding AG and (vocab-sharded) the logits AG. Recorded per
+    executed dispatch into the SAME counters as the training schedule
+    (``profiler.mp_comm_counters``)."""
+    n = cfg.n
+    item = jnp.dtype(config.compute_dtype or "float32").itemsize
+    H = config.hidden_size
+    I = config.ffn_mult * H
+    L = config.num_layers
+    R = B * T
+
+    def ag(F, isz=item):
+        # ring all-gather: each device sends its 1/n block to n-1 peers
+        return R * F * isz * (n - 1) // n
+
+    rec = MpStepRecord()
+    rec.backend = cfg.backend
+    total = ag(H) + L * (ag(H) + ag(H) + ag(I) + ag(H))
+    colls = 1 + 4 * L
+    if cfg.shard_vocab:
+        # logits exist only at each slot's LAST position ([B, V] fp32),
+        # not per window token — a chunk-prefill dispatch still gathers
+        # one row per slot
+        total += B * config.vocab_size * 4 * (n - 1) // n
+        colls += 1
+    rec.ag_bytes = total
+    rec.collectives = colls
+    rec.bytes_by_kind = {"all_gather": total}
+    if cfg.backend == "ring":
+        rec.ppermute_hops = colls * (n - 1)
+    elif cfg.backend == "fused":
+        rec.fused_dispatches = colls
+    rec.activation_bytes = R * H * item
+    return rec
+
+
+# ---------------------------------------------------------------------------
 # mp_layers routing (Column/RowParallelLinear explicit overlap path)
 
 

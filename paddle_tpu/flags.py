@@ -106,6 +106,16 @@ _FLAGS = {
     # equivalent but NOT bitwise identical to the jnp path — disable when
     # auditing bitwise parity on TPU.
     "FLAGS_serving_paged_kernel": True,
+    # Tensor-parallel serving degree: > 1 builds the engine over a 1-D
+    # 'mp' mesh of that many chips — GPT weights column-sharded (head-
+    # major qkv), the paged KV pool sharded over its HEAD axis (per-chip
+    # KV bytes ~ 1/mp; the host page table stays global), logits/embedding
+    # vocab- and feature-sharded. The schedule is GATHER-ONLY, so engine
+    # output stays BITWISE identical to the single-chip engine. The
+    # collective rung comes from FLAGS_comm_backend ("mp=gspmd|ring|
+    # fused"); an explicit Engine(mesh=/mp=/comm_backend=) overrides both
+    # flags. 0/1 = single chip.
+    "FLAGS_serving_mp": 0,
     # -- self-healing serving (serving/engine.py + serving/supervisor.py) ---
     # Engine-snapshot cadence: with a CheckpointManager attached
     # (Engine.attach_checkpoint), every N step boundaries the FULL engine

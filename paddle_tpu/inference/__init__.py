@@ -356,7 +356,12 @@ class Predictor:
         whole-sequence forward — the wrong program for token-at-a-time
         serving — so `serve()` rebuilds the functional GPT param tree from
         the artifact's weight dict instead (artifact must be a
-        GPTForCausalLM export; `gpt_config` is its GPTConfig)."""
+        GPTForCausalLM export; `gpt_config` is its GPTConfig).
+
+        Engine kwargs pass through — including the tensor-parallel ones
+        (``mp=``, ``mesh=``, ``comm_backend=``): ``serve(cfg, mp=4)``
+        shards the rebuilt tree and the paged KV pool over a 4-chip mp
+        mesh at construction."""
         params = _gpt_functional_params(self._params, gpt_config)
         from ..serving import Engine
         return Engine(params=params, config=gpt_config, **engine_kwargs)
@@ -394,7 +399,10 @@ def serve(model=None, *, params=None, config=None, **engine_kwargs):
     """Build a continuous-batching serving engine
     (`paddle_tpu.serving.Engine`) from a GPTForCausalLM Layer or a
     functional param tree — the deploy entry point once a model graduates
-    from single-shot `Predictor.run` to request traffic."""
+    from single-shot `Predictor.run` to request traffic. An mp-trained
+    ``HybridTrainStep`` tree serves directly (``serve(params=step.params,
+    config=step.config, mp=4)``): head-major sharded weights are
+    device_put straight to the serving layout, no host round trip."""
     from ..serving import Engine
     return Engine(model, params=params, config=config, **engine_kwargs)
 

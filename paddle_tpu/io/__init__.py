@@ -172,7 +172,18 @@ class DataLoader:
                 samples = self._samples_exact  # exact incl. short batch
             state["samples_served"] = samples
             state["batch_size"] = spb
-            if self._epoch_end or (n is not None and samples >= n):
+            done = self._epoch_end or (n is not None and samples >= n)
+            if not done and not self._iterable:
+                # map-style completion is verifiable CONSUMER-side from
+                # the batch count (len(batch_sampler) / len(dataset)) —
+                # this covers a drop_last=True epoch under worker
+                # prefetch, where _epoch_end stays unset (the producer
+                # thread runs ahead of the user) and samples < n
+                try:
+                    done = self._served >= len(self)
+                except TypeError:
+                    pass
+            if done:
                 # a non-boundary position is resumable iff it is the END
                 # of the epoch; mark it so the restoring loader (which may
                 # not know the epoch length — iterable datasets) can tell

@@ -56,15 +56,22 @@ def _layer_cached(p, h, kc, vc, start, nh, eps):
         kc, vc
 
 
-def _final_logits(params, config, xlast):
-    """Final LN (fp32) + LM head over last-position hidden states [B,H]."""
+def _final_ln(params, config, xlast):
+    """Final LN (fp32) over last-position hidden states [B,H] — shared
+    with the mp serving forward, which follows it with a vocab-SHARDED
+    head matmul (serving/mp_forward.py) instead of the full one below."""
     xf = xlast.astype(jnp.float32)
     mu = jnp.mean(xf, -1, keepdims=True)
     var = jnp.var(xf, -1, keepdims=True)
     xn = (xf - mu) * jax.lax.rsqrt(var + config.layer_norm_epsilon)
-    xn = xn * params["lnf_g"].astype(jnp.float32) + \
+    return xn * params["lnf_g"].astype(jnp.float32) + \
         params["lnf_b"].astype(jnp.float32)
-    return xn @ params["head_w"].astype(jnp.float32)
+
+
+def _final_logits(params, config, xlast):
+    """Final LN (fp32) + LM head over last-position hidden states [B,H]."""
+    return _final_ln(params, config, xlast) @ \
+        params["head_w"].astype(jnp.float32)
 
 
 def _forward_cached(params, config, ids, kc, vc, start, last_index=None):

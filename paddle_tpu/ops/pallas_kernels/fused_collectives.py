@@ -23,6 +23,11 @@ arXiv:2305.06942; T3 arXiv:2401.16677; EQuARX arXiv:2506.17615):
   (n, cols) reduce-scatter / all-gather as in-kernel rings; the RS
   epilogue optionally quantizes the traveling accumulator to a bf16 wire
   (EQuARX-style: compressed on the wire, fp32 local accumulation).
+* ``fused_gemm_ag`` — the SERVING engine's column-parallel projection:
+  the full-contraction block GEMM's epilogue feeds the ring all-gather
+  of the output directly (no HBM round trip between GEMM and
+  collective). Gather-only and full-K, so the result is BITWISE equal
+  to the unsharded GEMM — the sharded engine's exactness contract.
 
 CPU tier-1 parity runs the SAME kernels in Pallas interpret mode (the
 ``paged_attention`` kernel set this precedent); real-TPU routing is gated
@@ -61,7 +66,7 @@ _SMEM = pl.BlockSpec(memory_space=pltpu.SMEM)
 # distinct Mosaic collective ids per kernel family (barrier semaphores of
 # concurrently-compiled kernels must not alias)
 _CID = {"ag_gemm": 0, "gemm_rs": 1, "ag_accum": 2, "rs_bucket": 3,
-        "ag_bucket": 4}
+        "ag_bucket": 4, "gemm_ag": 5}
 
 
 def interpret_default():
@@ -439,6 +444,56 @@ def _ag_bucket_kernel(nbr_ref, x_ref, o_ref, comm_ref, send_sem, recv_sem,
     lax.fori_loop(0, n, step, 0)
 
 
+def _gemm_ag_kernel(nbr_ref, x_ref, w_ref, o_ref, comm_ref, send_sem,
+                    recv_sem, cap_sem, *, n, out_dtype, interpret):
+    """GEMM + ring all-gather of the OUTPUT (the serving engine's
+    column-parallel projections): each device computes its full-contraction
+    column block ``x @ w_shard`` straight into the ring buffer and the
+    blocks ride the ring into every device's output — the pre-collective
+    block never takes an HBM round trip between the GEMM epilogue and the
+    transfer. Full-contraction per block, so the gathered result is
+    BITWISE identical to slicing the unsharded GEMM (the serving
+    exactness contract)."""
+    idx, right, left = nbr_ref[0], nbr_ref[1], nbr_ref[2]
+    barrier = _barrier(interpret)
+    if barrier:
+        barrier(left, right)
+    # plain matmul, NOT dot_general-with-preferred-fp32: the block must be
+    # bitwise equal to the column slice of the unsharded `x @ w` the
+    # single-chip engine computes (a preferred_element_type dot takes a
+    # different accumulation path on CPU — observed ~1e-6 drift)
+    comm_ref[0] = (x_ref[...] @ w_ref[...]).astype(out_dtype)
+
+    def step(t, _):
+        t = t.astype(jnp.int32)
+        cur = lax.rem(t, jnp.int32(2))
+        nxt = lax.rem(t + jnp.int32(1), jnp.int32(2))
+        src = lax.rem(idx - t + jnp.int32(n), jnp.int32(n))
+        dma = _rdma(comm_ref.at[cur], comm_ref.at[nxt], send_sem.at[cur],
+                    recv_sem.at[nxt], right)
+
+        @pl.when(t < n - 1)
+        def _():
+            if not interpret:
+                @pl.when(t > 0)
+                def _():
+                    pltpu.semaphore_wait(cap_sem, 1)
+            dma.start()
+
+        o_ref[src] = comm_ref[cur]
+
+        @pl.when(t < n - 1)
+        def _():
+            dma.wait()
+            if not interpret:
+                pltpu.semaphore_signal(
+                    cap_sem, inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(0, n, step, 0)
+
+
 # ---------------------------------------------------------------------------
 # kernel-call wrappers (per-device shards, inside full-manual shard_map)
 
@@ -572,6 +627,36 @@ def fused_ag_bucket(meta, row):
     )(_nbr(meta), row)
 
 
+def fused_gemm_ag(meta, x, w):
+    """Column-parallel GEMM + in-kernel ring all-gather of the output:
+    x [..., K] replicated rows, w [K, F/n] column shard -> [..., F] with
+    feature blocks in ring (= logical) order. Every block is a
+    full-contraction GEMM, so the result is BITWISE identical to
+    ``x @ w_full`` — the gather moves data, never changes math. The
+    serving engine's out/down/lm-head projections ride this kernel under
+    the ``fused`` rung."""
+    _count("gemm_ag")
+    n = meta.n
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    F = w.shape[1]
+    R = 1
+    for s in lead:
+        R *= int(s)
+    out = pl.pallas_call(
+        functools.partial(_gemm_ag_kernel, n=n, out_dtype=x.dtype,
+                          interpret=meta.interpret),
+        out_shape=jax.ShapeDtypeStruct((n, R, F), x.dtype),
+        in_specs=[_SMEM, _VMEM, _VMEM],
+        scratch_shapes=[pltpu.VMEM((2, R, F), x.dtype)] + _sems(),
+        interpret=meta.interpret,
+        **_compiler_params("gemm_ag", meta.interpret),
+    )(_nbr(meta), x.reshape(R, K), w)
+    # [n, R, F] -> [R, n*F]: block j lands at columns j*F..(j+1)*F (chip
+    # order == logical feature order for contiguous column shards)
+    return out.transpose(1, 0, 2).reshape(lead + (n * F,))
+
+
 # ---------------------------------------------------------------------------
 # differentiable entry points (custom VJPs: the backward passes are fused
 # kernels too — the transpose of AG+GEMM is GEMM+RS of the cotangent)
@@ -659,6 +744,24 @@ def ag_accum_reference(axis, n, r, stat):
         if t < n - 1:
             chunk = lax.ppermute(chunk, axis, perm)
     return acc
+
+
+def gemm_ag_reference(axis, n, x, w):
+    """Local column-block GEMM + ppermute ring all-gather of the output
+    along the last axis, in the kernel's exact block placement."""
+    y = x @ w
+    idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    F = y.shape[-1]
+    out = jnp.zeros(y.shape[:-1] + (n * F,), y.dtype)
+    chunk = y
+    for t in range(n):
+        src = (idx - t) % n
+        out = lax.dynamic_update_slice_in_dim(out, chunk, src * F,
+                                              axis=y.ndim - 1)
+        if t < n - 1:
+            chunk = lax.ppermute(chunk, axis, perm)
+    return out
 
 
 def rs_bucket_reference(axis, n, x, wire_dtype=None):

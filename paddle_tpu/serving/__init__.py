@@ -23,6 +23,15 @@ trace (queue → prefill chunks → decode → deliver, plus CoW/prefix and
 self-healing hops) that survives engine snapshots and exports as
 Perfetto JSON / JSONL — see ``paddle_tpu.observability``.
 
+Tensor-parallel serving (mp_forward.py): ``Engine(mp=N)`` shards the GPT
+weights column-parallel and the paged KV pool's HEAD axis over a 1-D
+'mp' mesh (per-chip KV ~ 1/mp; the page table stays global), with a
+GATHER-ONLY collective schedule so engine output stays bitwise identical
+to the single-chip engine on every rung (``FLAGS_comm_backend``:
+mp=gspmd | ring | fused Pallas GEMM+collective kernels). Snapshots are
+mp-portable; a supervisor replica is an mp group
+(``mp_replica_meshes``).
+
 SLO traffic management (slo.py; all default-off, host-side policy over
 the machinery above): priority classes with WFQ tenant fairness and
 deadline-driven preemption (``FLAGS_serving_priority_classes``),
@@ -43,7 +52,8 @@ from .slo import (  # noqa: F401
 )
 from .paged_kv import PagedKVPool, PagePoolExhausted, pages_for  # noqa: F401
 from .engine import Engine, EngineStoppedError  # noqa: F401
-from .supervisor import ServingSupervisor  # noqa: F401
+from .mp_forward import replica_mesh  # noqa: F401
+from .supervisor import ServingSupervisor, mp_replica_meshes  # noqa: F401
 from .metrics import (  # noqa: F401
     serving_counters, reset_serving_counters, serving_summary,
 )

@@ -145,7 +145,8 @@ def _make_decode(cfg, top_k, donate):
 
 
 @lru_cache(maxsize=None)
-def _make_paged_step(cfg, top_k, page_size, use_kernel, donate):
+def _make_paged_step(cfg, top_k, page_size, use_kernel, donate,
+                     mp_key=None):
     """Build the FUSED chunk/decode executable over the paged pool: every
     batch row is a slot processing a T-token window (ids' second dim) at
     its own offset. The engine dispatches it at exactly two steady-state
@@ -157,14 +158,27 @@ def _make_paged_step(cfg, top_k, page_size, use_kernel, donate):
 
     A slot's PRNG key splits ONLY on steps where it emits a token
     (emit[b]), replicating generate's split-per-emitted-token stream even
-    though prefill now spans several steps."""
+    though prefill now spans several steps.
+
+    ``mp_key`` = (mesh, ServingMPConfig) routes the forward through the
+    mp-sharded schedule (serving/mp_forward.py) — same signature, same
+    traced operands, bitwise-identical logits — so the host loop, trace
+    gates and snapshot machinery are mp-blind."""
     config = _cfg_view(cfg)
 
     def fn(params, kc, vc, ids, start, valid, emit, table, do_sample,
            temperature, top_p, key_data):
         metrics.bump("paged_traces")  # body runs only when traced
-        logits, kc, vc = paged_forward(params, config, ids, kc, vc, start,
-                                       valid, table, page_size, use_kernel)
+        if mp_key is None:
+            logits, kc, vc = paged_forward(params, config, ids, kc, vc,
+                                           start, valid, table, page_size,
+                                           use_kernel)
+        else:
+            from .mp_forward import mp_paged_forward
+            logits, kc, vc = mp_paged_forward(params, config, ids, kc, vc,
+                                              start, valid, table,
+                                              page_size, use_kernel,
+                                              mp_key[0], mp_key[1])
         keys = jax.random.wrap_key_data(key_data)           # [B] keys
         pair = jax.vmap(jax.random.split)(keys)             # [B, 2] keys
         subs = pair[:, 1]
@@ -216,7 +230,8 @@ class Engine:
                  max_queue=None, top_k=None, kv_layout=None, page_size=None,
                  num_pages=None, prefill_chunk=None, prefix_cache=None,
                  tag=None, trace=None, priority=None, tenant_weights=None,
-                 shed=None, params_version=0):
+                 shed=None, params_version=0, mesh=None, mp=None,
+                 comm_backend=None):
         if model is not None:
             params = _collect_params(model)
             config = model.config
@@ -224,12 +239,44 @@ class Engine:
             raise ValueError("Engine needs a GPTForCausalLM model, or "
                              "params= (init_gpt_params layout) + config=")
         self.config = config
-        # undo head-major qkv storage (sequence-parallel HybridTrainStep)
-        # once at construction — decode splits qkv logically
-        params = _logical_qkv(params, config)
-        self.params = jax.tree_util.tree_map(jnp.asarray, params)
-
         flags = get_flags()
+
+        # -- tensor-parallel serving (serving/mp_forward.py): resolve the
+        # mp mesh FIRST — it decides the param layout (head-major sharded
+        # vs logical replicated). mp > 1 shards the GPT weights column-
+        # parallel and the paged KV pool's head axis over a 1-D 'mp' mesh;
+        # the schedule is gather-only, so engine output stays BITWISE
+        # identical to the single-chip engine on every collective rung.
+        if mesh is None and mp is None:
+            mp = int(flags.get("FLAGS_serving_mp", 0) or 0)
+        if mesh is None and mp is not None and int(mp) > 1:
+            from .mp_forward import replica_mesh
+            mesh = replica_mesh(int(mp))
+        self._mesh = None
+        self._mp_cfg = None
+        self._kv_sharding = None
+        if mesh is not None:
+            from ..distributed import tp_overlap as _tpov
+            self._mp_cfg = _tpov.resolve_serving(config, mesh,
+                                                 backend=comm_backend)
+            if self._mp_cfg is not None:
+                self._mesh = mesh
+        self.mp = 1 if self._mp_cfg is None else self._mp_cfg.n
+        self._mp_records = {}        # dispatch shape -> static comm record
+        if self.mp > 1:
+            # head-major + column-sharded placement; an already-mp-sharded
+            # HybridTrainStep tree (config.qkv_head_major) is device_put
+            # straight to the serving shardings — no host round trip
+            from .mp_forward import shard_serving_params
+            self.params = shard_serving_params(params, config, self._mesh,
+                                               self._mp_cfg)
+            metrics.set_mp_info(self.mp, self._mp_cfg.backend)
+        else:
+            # undo head-major qkv storage (sequence-parallel
+            # HybridTrainStep) once at construction — single-chip decode
+            # splits qkv logically
+            params = _logical_qkv(params, config)
+            self.params = jax.tree_util.tree_map(jnp.asarray, params)
         # per-request span tracing (observability/tracing.py): host-side
         # only — recording sites are gated on `req.trace is not None`, so
         # disabled tracing costs one attribute check and the executables /
@@ -245,6 +292,11 @@ class Engine:
         if self.kv_layout not in ("paged", "pooled"):
             raise ValueError(f"kv_layout must be 'paged' or 'pooled', got "
                              f"{self.kv_layout!r}")
+        if self.mp > 1 and self.kv_layout != "paged":
+            raise ValueError(
+                "tensor-parallel serving shards the PAGED pool (the "
+                "pooled layout is the single-chip parity baseline); use "
+                "kv_layout='paged' with mp > 1")
         self.num_slots = int(num_slots or flags.get("FLAGS_serving_slots", 8))
         self.max_seq_len = int(max_seq_len or
                                flags.get("FLAGS_serving_max_seq_len", 0) or
@@ -333,15 +385,31 @@ class Engine:
                 prefix_cache=prefix_cache)
             use_kernel = bool(flags.get("FLAGS_serving_paged_kernel", True)
                               ) and paged_kernel_supported(
-                                  nh, d, self.page_size, why="serving engine")
-            self._paged_step = _make_paged_step(
-                cfg, self.top_k, self.page_size, use_kernel,
-                (1, 2) if donate_ok else ())
+                                  nh // self.mp, d, self.page_size,
+                                  why="serving engine")
+            if self.mp > 1:
+                self._paged_step = _make_paged_step(
+                    cfg, self.top_k, self.page_size, use_kernel,
+                    (1, 2) if donate_ok else (),
+                    mp_key=(self._mesh, self._mp_cfg))
+            else:
+                self._paged_step = _make_paged_step(
+                    cfg, self.top_k, self.page_size, use_kernel,
+                    (1, 2) if donate_ok else ())
             self._page_copy = _make_page_copy((0, 1) if donate_ok else ())
             shape = (config.num_layers, self.pool.num_pages, self.page_size,
                      nh, d)
         self._kc = jnp.zeros(shape, compute)
         self._vc = jnp.zeros(shape, compute)
+        if self.mp > 1:
+            # the pool's GLOBAL geometry is mp-independent (the page table
+            # addresses it identically at every mp); only the HEAD axis is
+            # laid out across chips — per-chip KV bytes are 1/mp
+            from jax.sharding import NamedSharding
+            from .mp_forward import KV_SPEC
+            self._kv_sharding = NamedSharding(self._mesh, KV_SPEC)
+            self._kc = jax.device_put(self._kc, self._kv_sharding)
+            self._vc = jax.device_put(self._vc, self._kv_sharding)
 
         # host-authoritative per-slot state (numpy; re-uploaded every step —
         # tiny arrays, and exactly why joins/evicts can never retrace)
@@ -636,6 +704,32 @@ class Engine:
                 self._free_slot(b)
                 self._resolve(req, LENGTH)
 
+    def _record_mp_comm(self, B, T, t0, t1, reqs=()):
+        """mp-rung observability per fused-step dispatch: the STATIC
+        collective schedule of this dispatch shape is recorded into the
+        training-shared ``profiler.mp_comm_counters()`` ledger (PR 3
+        plumbing) and the serving ledger (wire bytes / collectives /
+        fused dispatches), and every traced request on board gets a
+        per-boundary ``mp_comm`` span carrying wire bytes + backend
+        label (PR 9 tracing). Zero-cost at mp == 1."""
+        if self.mp <= 1:
+            return
+        from ..distributed import tp_overlap as _tpov
+        rec = self._mp_records.get((B, T))
+        if rec is None:
+            rec = _tpov.serving_step_record(self.config, self._mp_cfg, B, T)
+            self._mp_records[(B, T)] = rec
+        _tpov.record_step(rec)
+        wire = rec.rs_bytes + rec.ag_bytes
+        metrics.bump("mp_steps")
+        metrics.bump("mp_collectives", rec.collectives)
+        metrics.bump("mp_wire_bytes", wire)
+        metrics.bump("mp_fused_dispatches", rec.fused_dispatches)
+        for req in reqs:
+            if req is not None and req.trace is not None:
+                req.trace.span("mp_comm", t0, t1, bytes=wire,
+                               backend=self._mp_cfg.backend, mp=self.mp)
+
     def _cow(self, b, start, end):
         """Copy-on-write guard: a slot may only WRITE pages it exclusively
         owns — split any shared page in [start, end) to a fresh physical
@@ -702,6 +796,8 @@ class Engine:
         nxt = np.asarray(nxt)
         self._keys = np.array(keys)
         now = time.perf_counter()
+        self._record_mp_comm(B, 1, t0, now,
+                             [self._slots[b] for b in decoding])
         metrics.bump("paged_steps")
         metrics.add_time("decode_time_s", now - t0)
         # the latency a decode stream OBSERVES spans the whole boundary —
@@ -746,6 +842,7 @@ class Engine:
             jnp.asarray(self._top_p[b:b + 1]),
             jnp.asarray(self._keys[b:b + 1]))
         t1 = time.perf_counter()
+        self._record_mp_comm(1, C, t0, t1, [req])
         metrics.bump("paged_steps")
         metrics.bump("chunk_steps")
         metrics.bump("prefill_chunks")
@@ -1080,8 +1177,15 @@ class Engine:
             raise RuntimeError(
                 "swap_params on a non-idle engine: drain() first (the "
                 "drained requests requeue and recompute single-version)")
-        params = _logical_qkv(params, self.config)
-        new = jax.tree_util.tree_map(jnp.asarray, params)
+        if self.mp > 1:
+            # same prep as construction: head-major + column-sharded
+            # placement (an already-sharded tree reshards on device)
+            from .mp_forward import shard_serving_params
+            new = shard_serving_params(params, self.config, self._mesh,
+                                       self._mp_cfg)
+        else:
+            params = _logical_qkv(params, self.config)
+            new = jax.tree_util.tree_map(jnp.asarray, params)
         old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
         new_leaves, new_def = jax.tree_util.tree_flatten(new)
         if old_def != new_def:
@@ -1250,6 +1354,14 @@ class Engine:
         compute = self._kc.dtype
         self._kc = jnp.asarray(np.asarray(state["kc"]), compute)
         self._vc = jnp.asarray(np.asarray(state["vc"]), compute)
+        if self._kv_sharding is not None:
+            # snapshots hold the GLOBAL pool (mp-independent geometry, and
+            # the gather-only schedule makes its contents bitwise equal at
+            # every mp) — lay the head axis back out across this engine's
+            # chips. A snapshot therefore restores across mp degrees, incl.
+            # single-chip <-> sharded.
+            self._kc = jax.device_put(self._kc, self._kv_sharding)
+            self._vc = jax.device_put(self._vc, self._kv_sharding)
         self._pos = np.asarray(state["pos"], np.int32).copy()
         self._tok = np.asarray(state["tok"], np.int32).copy()
         self._keys = np.asarray(state["keys"], np.uint32).copy()
@@ -1415,6 +1527,18 @@ class Engine:
         return [results[r.request_id] for r in reqs]
 
     # -- introspection -------------------------------------------------------
+    def kv_shard_bytes(self):
+        """Per-chip bytes of ONE of the two KV pool arrays: the whole pool
+        on a single-chip engine, 1/mp of it (the head shard) under mp —
+        the memory gate of the sharded engine."""
+        if self._kv_sharding is None:
+            return int(self._kc.nbytes)
+        shape = self._kv_sharding.shard_shape(self._kc.shape)
+        n = 1
+        for s in shape:
+            n *= int(s)
+        return n * self._kc.dtype.itemsize
+
     @property
     def active_slots(self):
         return sum(r is not None for r in self._slots)

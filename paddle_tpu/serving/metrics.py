@@ -60,6 +60,13 @@ def _zero():
         # the total shed/expired tallies
         "shed_queue_wait_s": 0.0, "shed_queue_waits": 0,
         "expired_queue_wait_s": 0.0, "expired_queue_waits": 0,
+        # tensor-parallel serving (serving/mp_forward.py): per-dispatch
+        # STATIC collective schedule of the mp rung — wire bytes moved,
+        # collectives issued, Pallas fused-kernel dispatches (fused rung
+        # only). The same records also feed the training-shared
+        # profiler.mp_comm_counters() ledger.
+        "mp_steps": 0, "mp_collectives": 0, "mp_wire_bytes": 0,
+        "mp_fused_dispatches": 0,
         # tokens / time
         "tokens_out": 0,
         "decode_time_s": 0.0, "prefill_time_s": 0.0,
@@ -71,6 +78,9 @@ def _zero():
 
 
 _C = _zero()
+# mp rung labels (summary display only — counters stay numeric so the
+# Prometheus family export is untouched): set by the last mp engine built
+_mp_info = {}
 # ring buffers: percentiles track the LAST window of traffic, not the
 # first — a long-running server must surface a late latency regression
 _MAX_SAMPLES = 65536
@@ -85,6 +95,14 @@ _ttft_cls = {}
 def bump(name, n=1):
     with _lock:
         _C[name] += n
+
+
+def set_mp_info(mp, backend):
+    """Record the mp rung shape for ``serving_summary()`` display (kept
+    out of the counters dict: labels are strings, counters numeric)."""
+    with _lock:
+        _mp_info["mp"] = int(mp)
+        _mp_info["backend"] = str(backend)
 
 
 def add_time(name, dt):
@@ -208,6 +226,9 @@ def reset_serving_counters():
         _ttft.clear()
         _tok_lat.clear()
         _ttft_cls.clear()
+        # _mp_info survives on purpose: it is engine CONFIGURATION (the
+        # live rung/degree labels), not a counter — a benchmark resetting
+        # counters between rungs must not blank the summary's mp labels
 
 
 def export_state():
@@ -267,6 +288,14 @@ def serving_summary():
                 f"respawns: {c['respawns']} "
                 f"({c['stale_failovers']} stale-hb)  "
                 f"dropped: {c['dropped']}")
+    mp = ""
+    if c["mp_steps"]:
+        with _lock:
+            info = dict(_mp_info)
+        mp = (f"  mp: {info.get('backend', '?')}x{info.get('mp', '?')}  "
+              f"wire: {c['mp_wire_bytes'] / 1e6:.2f}MB over "
+              f"{c['mp_collectives']} collectives in {c['mp_steps']} "
+              f"dispatches  fused-dispatches: {c['mp_fused_dispatches']}")
     slo = ""
     if any(c[k] for k in ("shed", "preempted", "rate_limited", "scale_ups",
                           "scale_downs", "weight_swaps")):
@@ -287,4 +316,4 @@ def serving_summary():
             f"queue: {c['queue_depth_mean']:.1f} avg/{c['queue_depth_max']} max  "
             f"executables: {c['prefill_traces']} prefill + "
             f"{c['decode_traces']} decode + {c['paged_traces']} paged"
-            f"{paged}{waste}{slo}{heal}")
+            f"{paged}{mp}{waste}{slo}{heal}")

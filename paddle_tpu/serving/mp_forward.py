@@ -1,0 +1,255 @@
+"""Tensor-parallel (mp-sharded) serving forward for the paged engine.
+
+The training stack shards the mp axis Megatron-style: column-parallel
+qkv/up, ROW-parallel out/down with a cross-chip reduction per block. A
+reduction re-associates the contraction sum, so its result is only
+numerically — not bitwise — equal to the single-chip matmul. Serving's
+contract is stronger: engine output must be BITWISE identical to
+single-chip ``generate_from_params`` for any admission order, greedy and
+sampled. This module therefore runs a GATHER-ONLY schedule:
+
+* every GEMM shards its OUTPUT dim (column-parallel with head-major qkv,
+  ``out_w``/``down_w``/``head_w`` column-sharded too) and keeps the FULL
+  contraction — each chip's block is bitwise equal to a column slice of
+  the unsharded GEMM;
+* the only collectives are all-gathers (pure data movement): the
+  attention context and FFN activation before their full-contraction
+  projections, each projection's output blocks, the feature-sharded
+  embedding, and (vocab-divisible) the logits;
+* the paged KV pool shards its HEAD axis — per chip ``[L, P, page,
+  nh/mp, d]``, ~1/mp of the KV bytes — while the host-authoritative page
+  table stays global: a page id addresses ``(chip, page)`` implicitly
+  through the head shard, so the allocator, prefix cache and CoW
+  machinery are untouched.
+
+The exactness premium is bounded: per block the schedule moves one extra
+activation-sized gather versus the two all-reduces of the Megatron
+schedule, while per-chip GEMM FLOPs and KV-read bytes are 1/mp either
+way — and per-token decode activations are tiny next to the weight and
+KV traffic the sharding removes.
+
+Three collective rungs (``FLAGS_comm_backend``, "mp=..."), all
+bitwise-identical because the backend only moves bytes differently:
+
+* ``gspmd`` (default) — whole ``lax.all_gather`` collectives, the
+  schedule the partitioner would emit for this gather-only program;
+* ``ring`` — each all-gather decomposes into mp-1 ``ppermute`` hops;
+* ``fused`` — Pallas in-kernel rings: the column-parallel projections
+  ride ``fused_gemm_ag`` (the GEMM's output blocks enter the ring
+  straight from the epilogue, no HBM round trip) and the data gathers
+  ride ``fused_ag_bucket``. CPU tier-1 runs the SAME kernels in
+  interpret mode on the 8-virtual-device mesh
+  (``dist_env.create_single_axis_mesh('mp', n)``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..distributed.env import shard_map_compat
+from ..models.generation import _final_ln
+from ..models.gpt import ln_fp32
+from .paged_attention import paged_attention_read, paged_kv_scatter
+
+KV_SPEC = P(None, None, None, "mp", None)   # [L, P, page, nh@mp, d]
+
+
+def serving_param_specs(mp_cfg):
+    """Per-leaf PartitionSpecs of the serving layout (init_gpt_params
+    structure, stacked [L, ...] blocks, HEAD-MAJOR qkv storage so a
+    contiguous column shard is whole heads). Every matmul weight shards
+    its OUTPUT dim; norms and the biases added after an output gather
+    stay replicated."""
+    mpx = "mp"
+    blocks = {
+        "ln1_g": P(None, None), "ln1_b": P(None, None),
+        "qkv_w": P(None, None, mpx), "qkv_b": P(None, mpx),
+        "out_w": P(None, None, mpx), "out_b": P(None, None),
+        "ln2_g": P(None, None), "ln2_b": P(None, None),
+        "up_w": P(None, None, mpx), "up_b": P(None, mpx),
+        "down_w": P(None, None, mpx), "down_b": P(None, None),
+    }
+    return {
+        "wte": P(None, mpx),            # feature-sharded: local lookup + AG
+        "wpe": P(None, None),
+        "lnf_g": P(None), "lnf_b": P(None),
+        "head_w": P(None, mpx) if mp_cfg.shard_vocab else P(None, None),
+        "blocks": blocks,
+    }
+
+
+def shard_serving_params(params, config, mesh, mp_cfg):
+    """Place a GPT param tree onto the serving mp layout. Accepts the
+    LOGICAL qkv layout (permuted to head-major here) or params already in
+    head-major storage (``config.qkv_head_major`` — what HybridTrainStep
+    trains under the explicit mp schedule): those are device_put straight
+    to the serving shardings, so an already-mp-sharded trained tree moves
+    chip-to-chip without a host gather + re-shard round trip."""
+    if not getattr(config, "qkv_head_major", False):
+        from ..distributed.tp_overlap import to_qkv_head_major
+        params = {**params,
+                  "blocks": to_qkv_head_major(params["blocks"],
+                                              config.hidden_size,
+                                              config.num_heads)}
+    specs = serving_param_specs(mp_cfg)
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(jnp.asarray(a), NamedSharding(mesh, s)),
+        params, specs)
+
+
+# ---------------------------------------------------------------------------
+# per-device collective helpers (inside the full-manual shard_map; every
+# one is an exact gather — chip-order concat, no arithmetic)
+
+
+def _ring_ag_last(x, axis, n):
+    """ppermute ring all-gather along the LAST axis."""
+    idx = lax.axis_index(axis)
+    F = x.shape[-1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    out = jnp.zeros(x.shape[:-1] + (n * F,), x.dtype)
+    chunk = x
+    for t in range(n):
+        src = (idx - t) % n
+        out = lax.dynamic_update_slice_in_dim(out, chunk, src * F,
+                                              axis=x.ndim - 1)
+        if t < n - 1:
+            chunk = lax.ppermute(chunk, axis, perm)
+    return out
+
+
+def ag_last(x, axis, n, backend, meta):
+    """Exact all-gather along the last axis: [..., F/n] -> [..., F] with
+    blocks in chip (= logical) order."""
+    if n == 1:
+        return x
+    if backend == "fused":
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        out = _fc.fused_ag_bucket(meta, x.reshape(-1))       # [n, numel]
+        out = out.reshape((n,) + x.shape)
+        return jnp.moveaxis(out, 0, -2).reshape(
+            x.shape[:-1] + (n * x.shape[-1],))
+    if backend == "ring":
+        return _ring_ag_last(x, axis, n)
+    return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+
+
+def gemm_ag(x, w, axis, n, backend, meta):
+    """Column-parallel projection: full-contraction local block
+    ``x @ w_shard`` + all-gather of the output blocks. Bitwise equal to
+    ``x @ w_full`` on every rung (the fused rung's GEMM epilogue feeds
+    the ring directly — ``fused_collectives.fused_gemm_ag``)."""
+    if n == 1:
+        return x @ w
+    if backend == "fused":
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        return _fc.fused_gemm_ag(meta, x, w)
+    y = x @ w
+    if backend == "ring":
+        return _ring_ag_last(y, axis, n)
+    return lax.all_gather(y, axis, axis=y.ndim - 1, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# the per-device block + forward
+
+
+def _mp_block(p, h, kc_l, vc_l, table, pos, valid, nh, n, eps, page_size,
+              use_kernel, axis, backend, meta):
+    """One transformer block on PER-CHIP shards: h [B, T, H] replicated,
+    weights column-sharded (qkv head-major: the local contiguous shard is
+    nh/n whole heads), KV pool holding the local heads only. Every op is
+    either replicated elementwise math, a full-contraction GEMM block, a
+    per-head attention (head subsets are bitwise-independent), or an
+    exact gather — so the block output is bitwise identical to
+    paged_attention._layer_paged on one chip."""
+    B, T, H = h.shape
+    nh_l = nh // n
+    d = H // nh
+
+    h1 = ln_fp32(h, p["ln1_g"], p["ln1_b"], eps)
+    qkv = h1 @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+    qkv4 = qkv.reshape(B, T, nh_l, 3, d)        # head-major local columns
+    q, k, v = qkv4[..., 0, :], qkv4[..., 1, :], qkv4[..., 2, :]
+
+    kc_l, vc_l = paged_kv_scatter(kc_l, vc_l, k, v, table, pos, valid,
+                                  page_size)
+    ctx = paged_attention_read(q, kc_l, vc_l, table, pos, page_size,
+                               use_kernel, h.dtype)             # [B,T,nh_l,d]
+    # gather the context heads (chip order == logical head order), then
+    # the out projection keeps the FULL contraction against its column
+    # shard — the one arrangement that is bitwise under sharding
+    ctx_full = ag_last(ctx.reshape(B, T, nh_l * d), axis, n, backend, meta)
+    attn = gemm_ag(ctx_full, p["out_w"].astype(h.dtype), axis, n, backend,
+                   meta) + p["out_b"].astype(h.dtype)
+    h = h + attn
+    h2 = ln_fp32(h, p["ln2_g"], p["ln2_b"], eps)
+    up = h2 @ p["up_w"].astype(h.dtype) + p["up_b"].astype(h.dtype)
+    up = jax.nn.gelu(up, approximate=True)
+    act = ag_last(up, axis, n, backend, meta)                   # [B, T, I]
+    down = gemm_ag(act, p["down_w"].astype(h.dtype), axis, n, backend, meta)
+    return h + down + p["down_b"].astype(h.dtype), kc_l, vc_l
+
+
+def mp_paged_forward(params, config, ids, kc, vc, start, valid, table,
+                     page_size, use_kernel, mesh, mp_cfg):
+    """Fused chunk/decode forward over the mp-sharded engine: same
+    signature and semantics as ``paged_attention.paged_forward`` but with
+    params/KV sharded over ``mesh``'s 1-D mp axis. Returns replicated
+    logits [B, V] plus the updated head-sharded pools."""
+    compute = jnp.dtype(config.compute_dtype or "float32")
+    n, axis, backend = mp_cfg.n, mp_cfg.axis, mp_cfg.backend
+    meta = mp_cfg.kernel_meta(mesh)
+    nh = config.num_heads
+    eps = config.layer_norm_epsilon
+
+    def device_fn(params, kc, vc, ids, start, valid, table):
+        B, T = ids.shape
+        pos = start[:, None] + jnp.arange(T)[None, :]           # [B, T]
+        x = ag_last(params["wte"].astype(compute)[ids], axis, n, backend,
+                    meta) + \
+            jnp.take(params["wpe"].astype(compute), pos, axis=0)
+
+        def layer_fn(h, xs):
+            p_l, kc_l, vc_l = xs
+            h, kc_l, vc_l = _mp_block(p_l, h, kc_l, vc_l, table, pos,
+                                      valid, nh, n, eps, page_size,
+                                      use_kernel, axis, backend, meta)
+            return h, (kc_l, vc_l)
+
+        x, (kc2, vc2) = jax.lax.scan(layer_fn, x,
+                                     (params["blocks"], kc, vc))
+        idx = jnp.maximum(valid - 1, 0)
+        xlast = jax.vmap(
+            lambda xb, i: jax.lax.dynamic_slice_in_dim(xb, i, 1, axis=0))(
+                x, idx)[:, 0]                                   # [B, H]
+        xn = _final_ln(params, config, xlast)
+        if mp_cfg.shard_vocab:
+            logits = gemm_ag(xn, params["head_w"].astype(jnp.float32),
+                             axis, n, backend, meta)
+        else:
+            logits = xn @ params["head_w"].astype(jnp.float32)
+        return logits, kc2, vc2
+
+    mapped = shard_map_compat(
+        device_fn, mesh,
+        in_specs=(serving_param_specs(mp_cfg), KV_SPEC, KV_SPEC,
+                  P(None, None), P(None), P(None), P(None, None)),
+        out_specs=(P(None, None), KV_SPEC, KV_SPEC))
+    return mapped(params, kc, vc, ids, start, valid, table)
+
+
+def replica_mesh(mp, devices=None):
+    """A 1-D ('mp',) mesh over ``mp`` devices — the shape one serving
+    replica (= one mp group) runs on. Does NOT touch the process-global
+    mesh (a supervisor runs several replicas, each on its own devices)."""
+    from jax.sharding import Mesh
+    devices = list(jax.devices() if devices is None else devices)
+    mp = int(mp)
+    if mp > len(devices):
+        raise ValueError(f"serving mp={mp} needs {mp} devices, only "
+                         f"{len(devices)} available")
+    return Mesh(np.array(devices[:mp]), ("mp",))

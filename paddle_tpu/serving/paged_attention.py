@@ -151,6 +151,70 @@ def paged_decode_attention(q, kc_l, vc_l, table, pos, *, page_size,
 # fused step forward (jnp gather path; kernel spliced in for T=1 on TPU)
 
 
+def paged_kv_scatter(kc_l, vc_l, k, v, table, pos, valid, page_size):
+    """Scatter one window's K/V [B, T, nh', d] into the paged pool through
+    the slot->page table: logical page -> physical; lanes past valid[b]
+    (and whole inactive slots) write to trash page 0. ``nh'`` is whichever
+    head count the caller holds — all heads single-chip, the local shard
+    under mp (the table is head-independent)."""
+    MP = table.shape[1]
+    T = pos.shape[1]
+    writable = jnp.arange(T)[None, :] < valid[:, None]          # [B, T]
+    li = jnp.minimum(pos // page_size, MP - 1)
+    phys = jnp.where(writable, jnp.take_along_axis(table, li, axis=1), 0)
+    off = pos % page_size
+    kc_l = kc_l.at[phys, off].set(k.astype(kc_l.dtype))
+    vc_l = vc_l.at[phys, off].set(v.astype(vc_l.dtype))
+    return kc_l, vc_l
+
+
+def paged_attention_read(q, kc_l, vc_l, table, pos, page_size, use_kernel,
+                         out_dtype):
+    """Paged attention read: q [B, T, nh', d] against the pool's nh' heads
+    through the table; returns ctx [B, T, nh', d] in ``out_dtype``. Every
+    head's math is independent and mirrors generation._layer_decode_slots
+    exactly, so any head SUBSET (the mp engine's per-chip shard) is
+    bitwise identical to the same heads of the full computation."""
+    B, T, nh, d = q.shape
+    MP = table.shape[1]
+
+    if use_kernel and T == 1:
+        return paged_decode_attention(
+            q[:, 0].astype(jnp.float32), kc_l, vc_l, table, pos[:, 0],
+            page_size=page_size)[:, None].astype(out_dtype)     # [B,1,nh,d]
+    S = MP * page_size
+    P = kc_l.shape[0]
+    if T == 1 and 2 * P * page_size <= B * S:
+        # decode on an UNDERSUBSCRIBED pool (physical pages well below
+        # the sum of virtual windows — the memory-equal serving
+        # regime): score the query against the pool once and gather
+        # only the tiny score rows into virtual order. Each score is
+        # the same q-dot-k over d either way, so this is bitwise
+        # identical to scoring gathered keys while reading far fewer
+        # key bytes (measured ~2.8x faster at P*ps ~ B*S/6; the
+        # gather branch wins when P*ps ~ B*S, hence the static 2x
+        # shape guard).
+        s_all = jnp.einsum("bthd,pshd->bhtps", q.astype(jnp.float32),
+                           kc_l.astype(jnp.float32)) / (d ** 0.5)
+        scores = jax.vmap(lambda sa, tb: sa[:, :, tb])(
+            s_all, table).reshape(B, nh, T, S)
+    else:
+        # chunk prefill (pool-wide scoring is FLOP-heavy for T
+        # queries) and amply-sized pools: gather the key window
+        kv_k = kc_l[table].reshape(B, S, nh, d)
+        scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                            kv_k.astype(jnp.float32)) / (d ** 0.5)
+    kv_v = vc_l[table].reshape(B, S, nh, d)
+    # absolute causal mask; masked keys (incl. trash/unmapped reads)
+    # contribute exact zeros, preserving bitwise parity with the
+    # contiguous layouts
+    mask = jnp.arange(S)[None, None, :] <= pos[:, :, None]      # [B, T, S]
+    scores = jnp.where(mask[:, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs,
+                      kv_v.astype(jnp.float32)).astype(out_dtype)
+
+
 def _layer_paged(p, h, kc_l, vc_l, table, pos, valid, nh, eps, page_size,
                  use_kernel):
     """One transformer block over h [B, T, H] where each batch row is a
@@ -162,58 +226,16 @@ def _layer_paged(p, h, kc_l, vc_l, table, pos, valid, nh, eps, page_size,
     stream is bitwise identical to single-request decode."""
     B, T, H = h.shape
     d = H // nh
-    MP = table.shape[1]
 
     h1 = ln_fp32(h, p["ln1_g"], p["ln1_b"], eps)
     qkv = h1 @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
     q, k, v = jnp.split(qkv.reshape(B, T, 3, nh, d), 3, axis=2)
     q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
 
-    # scatter this window's K/V: logical page -> physical via the table;
-    # lanes past valid[b] (and whole inactive slots) write to trash page 0
-    writable = jnp.arange(T)[None, :] < valid[:, None]          # [B, T]
-    li = jnp.minimum(pos // page_size, MP - 1)
-    phys = jnp.where(writable, jnp.take_along_axis(table, li, axis=1), 0)
-    off = pos % page_size
-    kc_l = kc_l.at[phys, off].set(k.astype(kc_l.dtype))
-    vc_l = vc_l.at[phys, off].set(v.astype(vc_l.dtype))
-
-    if use_kernel and T == 1:
-        ctx = paged_decode_attention(
-            q[:, 0].astype(jnp.float32), kc_l, vc_l, table, pos[:, 0],
-            page_size=page_size)[:, None].astype(h.dtype)       # [B,1,nh,d]
-    else:
-        S = MP * page_size
-        P = kc_l.shape[0]
-        if T == 1 and 2 * P * page_size <= B * S:
-            # decode on an UNDERSUBSCRIBED pool (physical pages well below
-            # the sum of virtual windows — the memory-equal serving
-            # regime): score the query against the pool once and gather
-            # only the tiny score rows into virtual order. Each score is
-            # the same q-dot-k over d either way, so this is bitwise
-            # identical to scoring gathered keys while reading far fewer
-            # key bytes (measured ~2.8x faster at P*ps ~ B*S/6; the
-            # gather branch wins when P*ps ~ B*S, hence the static 2x
-            # shape guard).
-            s_all = jnp.einsum("bthd,pshd->bhtps", q.astype(jnp.float32),
-                               kc_l.astype(jnp.float32)) / (d ** 0.5)
-            scores = jax.vmap(lambda sa, tb: sa[:, :, tb])(
-                s_all, table).reshape(B, nh, T, S)
-        else:
-            # chunk prefill (pool-wide scoring is FLOP-heavy for T
-            # queries) and amply-sized pools: gather the key window
-            kv_k = kc_l[table].reshape(B, S, nh, d)
-            scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                                kv_k.astype(jnp.float32)) / (d ** 0.5)
-        kv_v = vc_l[table].reshape(B, S, nh, d)
-        # absolute causal mask; masked keys (incl. trash/unmapped reads)
-        # contribute exact zeros, preserving bitwise parity with the
-        # contiguous layouts
-        mask = jnp.arange(S)[None, None, :] <= pos[:, :, None]  # [B, T, S]
-        scores = jnp.where(mask[:, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("bhts,bshd->bthd", probs,
-                         kv_v.astype(jnp.float32)).astype(h.dtype)
+    kc_l, vc_l = paged_kv_scatter(kc_l, vc_l, k, v, table, pos, valid,
+                                  page_size)
+    ctx = paged_attention_read(q, kc_l, vc_l, table, pos, page_size,
+                               use_kernel, h.dtype)
 
     attn = ctx.reshape(B, T, H) @ p["out_w"].astype(h.dtype) + \
         p["out_b"].astype(h.dtype)

@@ -46,6 +46,36 @@ from .scheduler import QueueFullError, ShedError
 from .slo import Autoscaler, TokenBucket
 
 
+def mp_replica_meshes(num_replicas, mp, devices=None):
+    """Partition the device set into ``num_replicas`` DISJOINT 1-D ('mp',)
+    meshes of ``mp`` chips each — under tensor-parallel serving a replica
+    is an mp GROUP, not a chip. Hand each mesh to its replica's engine via
+    a one-arg factory::
+
+        meshes = serving.mp_replica_meshes(2, mp=4)      # 8 chips
+        sup = ServingSupervisor(
+            lambda i: serving.Engine(params=p, config=cfg,
+                                     mesh=meshes[i]),
+            num_replicas=2)
+
+    The supervisor calls a factory that accepts an argument with the
+    replica index (zero-arg factories keep working unchanged), so
+    respawn-after-crash and rolling restarts rebuild each replica on ITS
+    OWN chip group."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    devices = list(jax.devices() if devices is None else devices)
+    mp = int(mp)
+    need = int(num_replicas) * mp
+    if need > len(devices):
+        raise ValueError(
+            f"{num_replicas} mp={mp} replicas need {need} devices, only "
+            f"{len(devices)} available")
+    return [Mesh(np.array(devices[i * mp:(i + 1) * mp]), ("mp",))
+            for i in range(int(num_replicas))]
+
+
 class _Replica:
     """One supervised engine slot: the engine itself is replaceable (it
     dies and respawns), the snapshot manager and heartbeat are not."""
@@ -101,6 +131,7 @@ class ServingSupervisor:
                  tenant_burst=None):
         flags = get_flags()
         self.engine_factory = engine_factory
+        self._factory_arity = None       # lazily inspected (_call_factory)
         self.snapshot_every = snapshot_every
         self.max_restarts = int(
             max_restarts if max_restarts is not None
@@ -189,7 +220,7 @@ class ServingSupervisor:
                                         timeout=float(timeout))
 
     def _spawn_engine(self, rep):
-        eng = self.engine_factory()
+        eng = self._call_factory(rep.idx)
         eng.tag = f"replica{rep.idx}"
         if self._live_params is not None:
             # the fleet was hot-upgraded: every spawn — crash respawn,
@@ -202,6 +233,26 @@ class ServingSupervisor:
         if rep.mgr is not None:
             eng.attach_checkpoint(rep.mgr, every=self.snapshot_every)
         return eng
+
+    def _call_factory(self, idx):
+        """Invoke the engine factory — one-arg factories receive the
+        replica index (the tensor-parallel deployment shape: each replica
+        builds its engine on its OWN mp device group, see
+        ``mp_replica_meshes``); zero-arg factories keep the PR 7
+        contract unchanged."""
+        if self._factory_arity is None:
+            try:
+                import inspect
+                sig = inspect.signature(self.engine_factory)
+                self._factory_arity = sum(
+                    1 for p in sig.parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD))
+            except (TypeError, ValueError):
+                self._factory_arity = 0
+        if self._factory_arity >= 1:
+            return self.engine_factory(idx)
+        return self.engine_factory()
 
     # -- routing -------------------------------------------------------------
     def _up(self):

@@ -447,6 +447,32 @@ def test_dataloader_drop_last_epoch_end_resumable():
     assert dl2._resume_skip == 5  # whole-epoch skip
 
 
+def test_dataloader_drop_last_epoch_end_under_worker_prefetch():
+    """Same completed drop_last epoch but with num_workers>0: the
+    producer-thread generator cannot set _epoch_end (it runs ahead of
+    the consumer), yet completion is verifiable consumer-side from the
+    batch count — the checkpoint must carry epoch_end and resume on
+    another batch size instead of being refused."""
+    dl = DataLoader(list(range(10)), batch_size=3, drop_last=True,
+                    num_workers=1)
+    assert len(list(dl)) == 3
+    st = dl.state_dict()
+    assert st["samples_served"] == 9 and st.get("epoch_end") is True
+    dl2 = DataLoader(list(range(10)), batch_size=2)
+    dl2.load_state_dict(st)
+    assert dl2._resume_skip == 5  # whole-epoch skip
+    # a MID-epoch prefetch snapshot must NOT be marked epoch-end: the
+    # consumer has only seen 1 of 3 batches even if the producer ran
+    # ahead
+    dl3 = DataLoader(list(range(10)), batch_size=3, drop_last=True,
+                     num_workers=1)
+    it = iter(dl3)
+    next(it)
+    assert "epoch_end" not in dl3.state_dict()
+    for _ in it:
+        pass
+
+
 def test_dataloader_legacy_state_still_loads():
     dl = DataLoader(list(range(8)), batch_size=2)
     dl.load_state_dict({"batches_served": 2})  # pre-topology checkpoint

@@ -781,6 +781,76 @@ def test_rolling_restart_sustained_mixed_traffic(ckpt_dir):
     assert len(smetrics._ttft) == len(reqs)
 
 
+# ---------------------------------------------------------------------------
+# tensor-parallel (mp-sharded) engine snapshots
+
+
+@pytest.mark.parametrize("sampled", [False, True])
+def test_mp_kill_resume_bitwise_through_checkpoint(ckpt_dir, sampled,
+                                                   devices8):
+    """Kill-and-resume of an mp=2 SHARDED engine: the state_dict round
+    trips the head-sharded KV pool through the hardened CheckpointManager
+    (device_get gathers the global pool; restore lays the head axis back
+    out across chips), and every mid-decode request resumes bitwise —
+    greedy and sampled."""
+    reqs, steps = _requests("plain", sampled)
+    golden = _golden(reqs)
+
+    def _mp_engine():
+        return serving.Engine(params=_params(), config=CFG, num_slots=3,
+                              max_seq_len=96, page_size=8, prefill_chunk=8,
+                              mp=2, comm_backend="gspmd")
+
+    eng = _mp_engine()
+    mgr = CheckpointManager(ckpt_dir, async_save=False,
+                            site="serving_snapshot")
+    eng.attach_checkpoint(mgr, every=0)
+    for r in reqs:
+        eng.submit(r)
+    for _ in range(steps):
+        eng.step()
+    eng.save_snapshot()
+    pre = eng.pop_results()
+    del eng
+
+    restored = _mp_engine()
+    restored.load_state_dict(mgr.restore())
+    assert restored._kc.sharding.is_equivalent_to(
+        restored._kv_sharding, restored._kc.ndim), \
+        "restored KV pool lost its head sharding"
+    results = restored.run()
+    results.update(pre)
+    for r in reqs:
+        assert results[r.request_id].tokens == golden[r.request_id], \
+            f"mp request {r.request_id} diverged after sharded resume"
+    bal = restored.pool.balance()
+    assert bal["conserved"] and bal["refcounts_accounted"], bal
+
+
+def test_mp_restore_does_not_retrace(devices8):
+    """A restored mp engine re-dispatches the already-compiled sharded
+    fused step — paged trace counters do not move across
+    snapshot/restore (builders are memoized per (config, mesh, rung))."""
+    def _mp_engine():
+        return serving.Engine(params=_params(), config=CFG, num_slots=3,
+                              max_seq_len=96, page_size=8, prefill_chunk=8,
+                              mp=2, comm_backend="gspmd")
+
+    eng = _mp_engine()
+    reqs, steps = _requests("plain", False)
+    for r in reqs:
+        eng.submit(r)
+    for _ in range(steps):
+        eng.step()
+    snap = eng.state_dict()
+    before = profiler.serving_counters()["paged_traces"]
+    restored = _mp_engine()
+    restored.load_state_dict(snap)
+    restored.run()
+    assert profiler.serving_counters()["paged_traces"] == before, \
+        "sharded restore re-traced the fused step"
+
+
 def _load_smoke():
     import importlib.util
     spec = importlib.util.spec_from_file_location(

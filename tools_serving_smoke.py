@@ -19,8 +19,18 @@ workload (asserted by tests/test_serving.py::test_smoke_bench_* [slow]).
 Usage:  JAX_PLATFORMS=cpu python tools_serving_smoke.py [--full]
 """
 import json
+import os
 import sys
 import time
+
+if "--mp" in sys.argv or "--mp-det" in sys.argv:
+    # the mp ladder needs the 8-virtual-device CPU mesh (same rig as
+    # tests/conftest.py); XLA reads this at first backend init, which
+    # must not have happened yet
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np
 
@@ -354,6 +364,109 @@ def run_paged_rung(quick=True, deterministic=False, rate=None, repeats=3):
     return out
 
 
+def run_mp_rung(deterministic=False, backends=("gspmd", "ring"),
+                mps=(2, 4), repeats=2):
+    """Tensor-parallel serving ladder at MEMORY-EQUAL per-chip sizing:
+    the single-chip engine gets a KV budget of P0 pages / S0 slots; an
+    mp-degree engine spends the SAME per-chip bytes, which at 1/mp
+    per-chip KV cost buys mp x the pages and slots — the capacity lever
+    of sharding. Reported per rung: tokens/s (backlogged), inter-token
+    p99, per-chip KV bytes, wire bytes and fused-dispatch counts.
+
+    Timed rungs run gspmd/ring (real XLA collectives over the 8-virtual-
+    device CPU mesh; on TPU the same code times all three). The fused
+    rung runs Pallas kernels in INTERPRET mode on CPU — an emulation
+    whose wall time is meaningless — so it is scored for parity + fused
+    dispatch counts on the deterministic model only.
+
+    Gate (tests/test_mp_serving.py, slow): best mp rung >= 1.4x
+    single-chip tokens/s, outputs bitwise identical everywhere."""
+    from paddle_tpu import profiler
+    from paddle_tpu.ops.pallas_kernels import fused_collectives as fc
+    if deterministic:
+        cfg = GPTConfig(vocab_size=96, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=128, dropout=0.0,
+                        use_flash=False, compute_dtype="float32",
+                        remat=False)
+        smax, ps, S0, n, newr, repeats = 48, 8, 2, 8, (3, 7), 1
+    else:
+        # per-chip compute big enough that sharding it wins on CPU too;
+        # S0=2 is the honest memory-equal regime — a model sized to fill
+        # one chip's HBM leaves almost no single-chip KV room
+        cfg = GPTConfig(vocab_size=512, hidden_size=384, num_layers=4,
+                        num_heads=8, max_seq_len=512, dropout=0.0,
+                        use_flash=False, compute_dtype="float32",
+                        remat=False)
+        smax, ps, S0, n, newr = 256, 16, 2, 40, (8, 20)
+    params = init_gpt_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    work = [{"arrival": 0.0, "long": False,
+             "prompt": rng.integers(0, cfg.vocab_size,
+                                    int(rng.integers(4, smax // 6))),
+             "max_new": int(rng.integers(*newr))} for _ in range(n)]
+    P0 = S0 * smax // ps + 1
+
+    def build(mp, backend):
+        kw = dict(params=params, config=cfg, num_slots=S0 * max(mp, 1),
+                  max_seq_len=smax, page_size=ps,
+                  num_pages=(P0 - 1) * max(mp, 1) + 1,
+                  prefill_chunk=2 * ps, max_queue=n + 2)
+        if mp > 1:
+            kw.update(mp=mp, comm_backend=backend)
+        return serving.Engine(**kw)
+
+    rungs = []
+    base_tokens = None
+    ladder = [(1, "gspmd")] + [(mp, b) for b in backends for mp in mps]
+    for mp, backend in ladder:
+        if backend == "fused" and not deterministic \
+                and jax.default_backend() != "tpu":
+            # interpret-mode emulation: parity-only, timed on TPU
+            rungs.append({"mp": mp, "backend": "fused",
+                          "skipped": "interpret-mode timing meaningless "
+                                     "on CPU (run --mp-det for parity + "
+                                     "dispatch counts)"})
+            continue
+        fc.reset_trace_counts()
+        build(mp, backend).generate(
+            [np.arange(1, ps + 2), np.arange(1, 2 * ps + 2)],
+            max_new_tokens=2)                      # warm both rungs
+        traces = dict(fc.trace_counts())           # trace-time kernel audit
+        best = None
+        for _ in range(max(1, repeats)):
+            eng = build(mp, backend)
+            profiler.reset_serving_counters()
+            toks, wall, stamps = _drive(eng, work)
+            c = profiler.serving_counters()
+            if best is None or wall < best[1]:
+                best = (toks, wall, stamps, c, eng.kv_shard_bytes())
+        toks, wall, stamps, c, shard_bytes = best
+        if base_tokens is None:
+            base_tokens = toks
+        rungs.append({
+            "mp": mp, "backend": backend,
+            "tokens_per_s": round(sum(len(t) for t in toks) / wall, 1),
+            "intertoken_p99_s": round(_intertoken_p99(stamps, work), 4),
+            "slots": S0 * max(mp, 1), "kv_bytes_per_chip": shard_bytes,
+            "wire_mb": round(c["mp_wire_bytes"] / 1e6, 2),
+            "fused_dispatches": c["mp_fused_dispatches"],
+            "kernel_traces": traces,
+            "outputs_match": toks == base_tokens,
+        })
+        print(json.dumps({"bench": "serving_mp_smoke", **rungs[-1]}))
+    out = {"bench": "serving_mp_smoke", "requests": n,
+           "backend": jax.default_backend(), "deterministic": deterministic,
+           "rungs": rungs}
+    timed = [r for r in rungs if "tokens_per_s" in r]
+    if len(timed) > 1:
+        base = timed[0]["tokens_per_s"]
+        out["best_speedup"] = round(
+            max(r["tokens_per_s"] for r in timed[1:]) / base, 2)
+    out["outputs_match"] = all(r.get("outputs_match", True) for r in rungs)
+    print(json.dumps({k: v for k, v in out.items() if k != "rungs"}))
+    return out
+
+
 def run_ladder(quick=True):
     params, cfg = _model(quick)
     n = 24 if quick else 48
@@ -378,6 +491,28 @@ def run_ladder(quick=True):
 
 
 if __name__ == "__main__":
+    if "--mp" in sys.argv or "--mp-det" in sys.argv:
+        # tensor-parallel ladder: memory-equal single-chip vs mp in {2,4}
+        det = "--mp-det" in sys.argv
+        backends = ("gspmd", "ring", "fused") if det else ("gspmd", "ring")
+        out = run_mp_rung(deterministic=det, backends=backends)
+        ok_bw = out["outputs_match"]
+        sp = out.get("best_speedup")
+        if det:
+            # the deterministic model is parity/dispatch-count rig only —
+            # it is far too small to amortize collective overhead
+            print(f"# tensor-parallel serving (deterministic): outputs "
+                  f"bitwise across all rungs incl. fused: "
+                  f"{'PASS' if ok_bw else 'FAIL'}")
+        else:
+            ok_tp = sp is not None and sp >= 1.4
+            print(f"# tensor-parallel serving (memory-equal per chip): "
+                  f"best mp speedup "
+                  f"{'n/a' if sp is None else f'{sp:.2f}x'} tokens/s "
+                  f"({'PASS' if ok_tp else 'FAIL'} >= 1.4x gate), "
+                  f"outputs bitwise across all rungs: "
+                  f"{'PASS' if ok_bw else 'FAIL'}")
+        sys.exit(0)
     if "--paged" in sys.argv:
         # paged vs pooled ladder: backlogged + (full) a Poisson-arrival rung
         quick = "--full" not in sys.argv
