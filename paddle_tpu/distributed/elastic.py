@@ -42,7 +42,15 @@ def _zero_elastic():
     return {"shrinks": 0, "grows": 0, "reforms": 0, "elastic_restores": 0,
             "steps_lost": 0, "resume_latency_s_last": 0.0,
             "resume_latency_s_total": 0.0, "active_dp": 0, "world_size": 0,
-            "failed_ranks": 0}
+            "failed_ranks": 0,
+            # serving fleet (serving/elastic.py): mp-group reforms after a
+            # chip loss, grow-backs to the original degree, live gauges
+            # for groups running below their configured mp / chips
+            # currently lost, and reform latency. Per-replica active-mp
+            # gauges land as dynamic "active_mp_replica{i}" keys.
+            "group_reforms": 0, "grow_backs": 0, "degraded_groups": 0,
+            "serving_chips_lost": 0, "reform_latency_s_last": 0.0,
+            "reform_latency_s_total": 0.0}
 
 
 _elastic_counters = _zero_elastic()

@@ -129,8 +129,31 @@ _FLAGS = {
     # the surviving replicas.
     "FLAGS_serving_max_restarts": 3,
     # Heartbeat staleness threshold (seconds) past which the supervisor
-    # declares a replica frozen and fails it over.
+    # declares a replica frozen and fails it over. In topology-elastic
+    # mode the same threshold applies to the per-CHIP heartbeat files.
     "FLAGS_serving_heartbeat_timeout": 10.0,
+    # -- topology-elastic serving (serving/elastic.py) -----------------------
+    # Grow a degraded mp group back to its configured degree when its
+    # lost chips return (serving_chip_return_at fires / chip heartbeats
+    # recover): a LIVE snapshot handoff — zero drops, zero replays, and
+    # zero new traces (builders memoized per (cfg, mesh, rung)). Off:
+    # chip losses are sticky, groups only shrink.
+    "FLAGS_serving_elastic_grow": True,
+    # Bounded router retries while EVERY replica is mid-reform: the
+    # supervisor's submit() backs off with a deterministic per-request
+    # jitter this many times before raising EngineStoppedError with
+    # reforming=True and a retry_after hint.
+    "FLAGS_serving_reform_retries": 2,
+    # Serving anomaly guard: "off" (default — the fused step and the
+    # token trajectory are byte-identical to the unguarded engine) or
+    # "quarantine" (a traced per-slot all-finite check on the logits
+    # rides the fused paged step; a poisoned slot — NaN/Inf from bad
+    # weights, a corrupted KV page or a flaky chip — resolves
+    # finish_reason="error" at the boundary, its prompt pages are NOT
+    # published to the prefix cache, and its neighbors stay
+    # bitwise-stable: the poison never spreads to the shared batch or a
+    # snapshot).
+    "FLAGS_serving_anomaly_policy": "off",
     # -- SLO-driven multi-tenant serving (serving/slo.py) --------------------
     # Class-aware admission: requests carry priority ("interactive" |
     # "batch" | "best_effort") and a tenant id; admission serves classes

@@ -421,8 +421,17 @@ def reset_elastic_counters():
 
 
 def elastic_summary():
-    """One-line human-readable topology-elastic report."""
+    """One-line human-readable topology-elastic report (training mesh
+    reforms plus, when a topology-elastic serving fleet ran, the serving
+    group-reform segment)."""
     c = elastic_counters()
+    serving = ""
+    if c.get("group_reforms") or c.get("degraded_groups"):
+        serving = (f"  serving: {c['group_reforms']} group-reforms "
+                   f"({c['grow_backs']} grow-backs)  "
+                   f"degraded-groups: {c['degraded_groups']}  "
+                   f"chips-lost: {c['serving_chips_lost']}  "
+                   f"reform: {c['reform_latency_s_last'] * 1e3:.0f}ms")
     return (f"dp: {c['active_dp']}/{c['world_size']}  "
             f"failed-ranks: {c['failed_ranks']}  "
             f"shrinks: {c['shrinks']}  grows: {c['grows']}  "
@@ -430,7 +439,7 @@ def elastic_summary():
             f"resharded-loads: {c['resharded_loads']} "
             f"({c['resharded_leaves']} leaves)  "
             f"steps-lost: {c['steps_lost']}  "
-            f"resume: {c['resume_latency_s_last'] * 1e3:.0f}ms")
+            f"resume: {c['resume_latency_s_last'] * 1e3:.0f}ms" + serving)
 
 
 def benchmark():

@@ -32,6 +32,19 @@ mp=gspmd | ring | fused Pallas GEMM+collective kernels). Snapshots are
 mp-portable; a supervisor replica is an mp group
 (``mp_replica_meshes``).
 
+Topology-elastic serving (elastic.py): ``ServingSupervisor(mp=N)``
+watches every CHIP of every mp group (injected
+``FaultPlan.serving_chip_loss_at`` schedules + per-chip heartbeats) —
+one lost chip re-forms its group over the surviving chips at the
+largest viable mp degree via the mp-portable snapshot path (bitwise
+resume, zero drops), the fleet runs degraded (router backs off
+mid-reform with typed ``retry_after``; shed/autoscale read live
+routable capacity), and returning chips grow the group back with zero
+drops and zero new traces. A traced per-slot anomaly guard
+(``FLAGS_serving_anomaly_policy=quarantine``) resolves a slot whose
+logits went non-finite as ``finish_reason="error"`` without poisoning
+the shared batch, the prefix cache or a snapshot.
+
 SLO traffic management (slo.py; all default-off, host-side policy over
 the machinery above): priority classes with WFQ tenant fairness and
 deadline-driven preemption (``FLAGS_serving_priority_classes``),
@@ -44,7 +57,7 @@ zero-downtime weight swaps (``rolling_restart(new_params=)`` /
 from .request import (  # noqa: F401
     Request, GenerationResult,
     QUEUED, RUNNING, FINISHED, STOP, LENGTH, EXPIRED, CANCELLED, DROPPED,
-    SHED,
+    SHED, ERROR,
 )
 from .scheduler import Scheduler, QueueFullError, ShedError  # noqa: F401
 from .slo import (  # noqa: F401
@@ -53,7 +66,10 @@ from .slo import (  # noqa: F401
 from .paged_kv import PagedKVPool, PagePoolExhausted, pages_for  # noqa: F401
 from .engine import Engine, EngineStoppedError  # noqa: F401
 from .mp_forward import replica_mesh  # noqa: F401
-from .supervisor import ServingSupervisor, mp_replica_meshes  # noqa: F401
+from .elastic import FleetTopology, viable_mp  # noqa: F401
+from .supervisor import (  # noqa: F401
+    ChipLossError, ServingSupervisor, mp_replica_meshes,
+)
 from .metrics import (  # noqa: F401
     serving_counters, reset_serving_counters, serving_summary,
 )

@@ -1,0 +1,257 @@
+"""Topology-elastic serving fleet support: per-chip failure detection and
+mesh re-forming for tensor-parallel replica GROUPS.
+
+PR 12 made a supervisor replica an mp *group* — one lost chip takes a
+whole multi-chip replica with it, and a respawn pinned to the original
+devices would strand the fleet on a real chip failure. This module closes
+the gap the way PR 11's ``ElasticMeshSupervisor`` did for training:
+
+  * **per-chip detection** — ``FleetTopology`` watches every chip of the
+    fleet individually: the deterministic injected schedule
+    (``fault_injection.lost_serving_chips`` — serving-scoped
+    ``serving_chip_loss_at``/``serving_chip_return_at`` with a sticky
+    watermark) plus, with a heartbeat dir configured, per-CHIP heartbeat
+    files (``distributed.elastic.Heartbeat`` at chip granularity) whose
+    staleness marks the chip down. Any lost chip marks its whole group
+    down deterministically.
+  * **mesh re-forming** — ``plan()`` recomputes a group's mesh over its
+    SURVIVING chips (non-contiguous survivors included) at the LARGEST
+    viable mp degree: the largest divisor of the configured mp that the
+    survivors can host (a divisor of mp always divides hidden/heads/ffn,
+    because mp itself does). The supervisor respawns the replica on that
+    mesh through the PR 12 mp-portable snapshot path — pool geometry is
+    global and the gather-only schedule is bitwise at every degree, so
+    an mp=4 snapshot resumes bitwise on mp=2 or a single chip.
+  * **grow-back** — when chips return (``serving_chip_return_at`` fires /
+    heartbeats recover), ``plan()`` reports the restored degree and the
+    supervisor re-forms UP from a live snapshot; engine builders are
+    memoized per (cfg, mesh, rung), so growing back to a topology seen
+    before reuses its compiled executables (zero new traces).
+
+Every event lands in the observability registry's "elastic" family
+(``group_reforms``/``grow_backs``/``degraded_groups``/
+``serving_chips_lost``/``reform_latency_*`` plus per-replica
+``active_mp_replica{i}`` gauges) → the Prometheus endpoint.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from ..distributed.elastic import (
+    Heartbeat, HeartbeatMonitor, _ecount, _egauge,
+)
+from ..utils import fault_injection as _fi
+
+
+def mp_replica_meshes(num_replicas, mp, devices=None):
+    """Partition ``devices`` (default: all) into ``num_replicas`` DISJOINT
+    1-D ('mp',) meshes of ``mp`` chips each — under tensor-parallel
+    serving a replica is an mp GROUP, not a chip. The device set may be
+    arbitrary and non-contiguous (the survivors of a chip loss partition
+    exactly like a fresh fleet). ``num_replicas=None`` derives the count
+    from the device set, which must then divide evenly::
+
+        meshes = serving.mp_replica_meshes(2, mp=4)      # 8 chips
+        sup = ServingSupervisor(
+            lambda i: serving.Engine(params=p, config=cfg,
+                                     mesh=meshes[i]),
+            num_replicas=2)
+
+    Validates the n/mp/device combination up front with the offending
+    numbers named (a bad combination used to surface as a deep
+    mesh-construction error)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    devices = list(jax.devices() if devices is None else devices)
+    mp = int(mp)
+    if mp < 1:
+        raise ValueError(f"mp_replica_meshes needs mp >= 1, got mp={mp}")
+    if num_replicas is None:
+        if len(devices) % mp:
+            raise ValueError(
+                f"cannot partition {len(devices)} devices into mp={mp} "
+                f"groups: {len(devices)} % {mp} != 0 (pass num_replicas "
+                f"explicitly to leave spares)")
+        num_replicas = len(devices) // mp
+    num_replicas = int(num_replicas)
+    if num_replicas < 1:
+        raise ValueError(
+            f"mp_replica_meshes needs num_replicas >= 1, got "
+            f"num_replicas={num_replicas}")
+    need = num_replicas * mp
+    if need > len(devices):
+        raise ValueError(
+            f"{num_replicas} mp={mp} replicas need {need} devices, only "
+            f"{len(devices)} available")
+    return [Mesh(np.array(devices[i * mp:(i + 1) * mp]), ("mp",))
+            for i in range(num_replicas)]
+
+
+def viable_mp(mp, available):
+    """Largest viable mp degree ``m`` for a group with ``available``
+    surviving chips: the largest divisor of the configured ``mp`` that
+    the survivors can host. A divisor of mp always divides
+    hidden/heads/ffn (the configured mp does — the original engine
+    exists), so every degree this returns builds a valid sharded engine.
+    Returns 0 when no chip survives."""
+    mp, available = int(mp), int(available)
+    for m in range(min(mp, available), 0, -1):
+        if mp % m == 0:
+            return m
+    return 0
+
+
+class FleetTopology:
+    """Chip-level view of a serving fleet: ``num_replicas`` home groups of
+    ``mp`` chips each over ``devices`` (global chip rank = index into
+    ``devices``). Owns per-chip liveness (injected loss schedule + per-chip
+    heartbeats) and the reform plan for each group.
+
+    Single-process notes: ``beat()`` writes a heartbeat for EVERY fleet
+    chip each boundary — the single-controller simulation of per-host
+    heartbeat daemons (``FaultPlan.stale_heartbeat_ranks`` freezes
+    individual chips, so their files age and ``lost_chips`` reports
+    them). On a real pod each host beats for its own chips; only the
+    monitoring half applies."""
+
+    def __init__(self, devices, mp, num_replicas, heartbeat_dir=None,
+                 heartbeat_timeout=None):
+        import jax
+        self.mp = int(mp)
+        devices = list(jax.devices() if devices is None else devices)
+        need = int(num_replicas) * self.mp
+        # validate through the same path users hit (names n/mp/devices)
+        mp_replica_meshes(num_replicas, self.mp, devices)
+        self.devices = devices[:need]
+        self.num_replicas = int(num_replicas)
+        self.monitor = None
+        self._beats = {}
+        self._beat_interval = 0.0
+        self._last_beat = None
+        self._last_poll = None
+        self._stale = set()
+        if heartbeat_dir is not None:
+            from ..flags import get_flags
+            chips_dir = os.path.join(os.fspath(heartbeat_dir), "chips")
+            timeout = (get_flags().get("FLAGS_serving_heartbeat_timeout",
+                                       10.0)
+                       if heartbeat_timeout is None else heartbeat_timeout)
+            self.monitor = HeartbeatMonitor(chips_dir, len(self.devices),
+                                            timeout=float(timeout))
+            self._beats = {r: Heartbeat(chips_dir, rank=r)
+                           for r in range(len(self.devices))}
+            # freshness only has to beat the staleness timeout, not the
+            # boundary rate: a boundary is roughly one decoded token, so
+            # an unthrottled beat would json+rename every chip's file
+            # ~1000x more often than detection needs
+            self._beat_interval = float(timeout) / 3.0
+        _egauge("serving_chips_lost", 0)
+
+    def home(self, i):
+        """Replica ``i``'s home chip ranks (global indices)."""
+        return tuple(range(i * self.mp, (i + 1) * self.mp))
+
+    def beat(self, step):
+        """Heartbeat every fleet chip (the fault plan silently drops
+        frozen chips' writes, so their files go stale). Throttled to a
+        third of the staleness timeout: detection is time-based, so
+        rewriting every file at every boundary buys nothing."""
+        now = time.monotonic()
+        if self._last_beat is not None \
+                and now - self._last_beat < self._beat_interval:
+            return
+        self._last_beat = now
+        for hb in self._beats.values():
+            try:
+                hb.beat(step=step)
+            except OSError:
+                # transient heartbeat-file IO is NOT chip death (same
+                # policy as the supervisor's per-replica beat): the file
+                # just ages, and only the staleness timeout may fail the
+                # chip — one flaky write must not crash the supervising
+                # loop or starve the other chips' beats
+                pass
+
+    def lost_chips(self, step):
+        """Global ranks of chips lost as of supervisor step ``step``:
+        the injected serving-scoped schedule (sticky watermark) plus
+        chips whose heartbeat is stale. The file sweep is throttled to
+        the same timeout/3 cadence as ``beat()`` (staleness is
+        time-based — N opens + JSON parses per decoded token buy no
+        detection latency); the injected schedule stays per-step, so
+        tests remain deterministic."""
+        lost = set(_fi.lost_serving_chips(step))
+        lost &= set(range(len(self.devices)))
+        if self.monitor is not None:
+            now = time.monotonic()
+            if self._last_poll is None \
+                    or now - self._last_poll >= self._beat_interval:
+                self._stale = set(self.monitor.failed_ranks(
+                    list(range(len(self.devices)))))
+                self._last_poll = now
+            lost |= self._stale
+        _egauge("serving_chips_lost", len(lost))
+        return frozenset(lost)
+
+    def plan(self, i, lost):
+        """(mp_degree, chip ranks) replica ``i`` should run on given the
+        ``lost`` chip set: its surviving home chips (home order, so the
+        plan — and therefore the mesh the builders memoize on — is
+        deterministic) at the largest viable degree. None when no home
+        chip survives. Pure arithmetic — cheap enough for every boundary;
+        the mesh is built by ``mesh_for`` only when a reform actually
+        runs."""
+        alive = [c for c in self.home(i) if c not in lost]
+        m = viable_mp(self.mp, len(alive))
+        if m < 1:
+            return None
+        return m, tuple(alive[:m])
+
+    def mesh_for(self, ranks):
+        """The 1-D ('mp',) mesh over ``ranks`` (global chip indices) — a
+        re-created mesh over the same devices hashes equal, so the
+        memoized engine builders hit on a grow-back."""
+        return mp_replica_meshes(1, len(ranks),
+                                 [self.devices[c] for c in ranks])[0]
+
+
+def record_reform(kind, latency_s):
+    """Ledger one group reform into the "elastic" family: ``kind`` is
+    "loss" (chip-loss shrink / degraded respawn) or "grow" (grow-back to
+    a higher degree)."""
+    _ecount("group_reforms")
+    if kind == "grow":
+        _ecount("grow_backs")
+    _egauge("reform_latency_s_last", latency_s)
+    _ecount("reform_latency_s_total", latency_s)
+
+
+def degraded_count(replicas, configured_mp):
+    """Groups running below their configured degree — down/reforming
+    groups count too (zero capacity is as degraded as it gets). Retired
+    ones don't, and neither do draining ones: a rolling restart takes a
+    replica out of rotation on purpose with its chips healthy — an
+    operator alerting on this gauge must not get paged by routine
+    upgrades. THE shared definition: the elastic-family gauge and the
+    supervisor's telemetry() both read it, so they can never diverge."""
+    n = 0
+    for rep in replicas:
+        if rep.state in ("retired", "draining"):
+            continue
+        mp = int(getattr(rep, "mp", 0) or 0) if rep.state == "up" else 0
+        if mp < int(configured_mp):
+            n += 1
+    return n
+
+
+def set_group_gauges(replicas, configured_mp):
+    """Refresh the live fleet-shape gauges: per-replica active mp and the
+    degraded-group count (``degraded_count``)."""
+    for rep in replicas:
+        mp = int(getattr(rep, "mp", 0) or 0)
+        if rep.state != "up":
+            mp = 0
+        _egauge(f"active_mp_replica{rep.idx}", mp)
+    _egauge("degraded_groups", degraded_count(replicas, configured_mp))

@@ -70,8 +70,8 @@ from . import metrics
 from .paged_attention import paged_forward, paged_kernel_supported
 from .paged_kv import PagedKVPool, pages_for
 from .request import (
-    CANCELLED, EXPIRED, FINISHED, LENGTH, QUEUED, RUNNING, SHED, STOP,
-    GenerationResult, Request,
+    CANCELLED, ERROR, EXPIRED, FINISHED, LENGTH, QUEUED, RUNNING, SHED,
+    STOP, GenerationResult, Request,
 )
 from .scheduler import QueueFullError, Scheduler, ShedError
 from .slo import ShedPolicy
@@ -82,12 +82,23 @@ class EngineStoppedError(RuntimeError):
     handed back so a router can act instead of guessing: ``queue_depth``
     (requests the drain requeued and still unclaimed) and ``requeued``
     (their request ids — resubmit them, or this new request, to a live
-    replica or to an engine restored from this one's last snapshot)."""
+    replica or to an engine restored from this one's last snapshot).
 
-    def __init__(self, message, queue_depth=0, requeued=()):
+    ``reforming=True`` means the stop is TEMPORARY: the replica's mp
+    group is mid-reform after a chip loss/return and will come back (on
+    fewer or more chips) momentarily — back off for ``retry_after``
+    seconds and retry, rather than declaring the replica dead. The
+    supervisor router treats reforming replicas as temporarily
+    unroutable and spills elsewhere; only an all-reforming fleet
+    surfaces this error to the caller, retry_after attached."""
+
+    def __init__(self, message, queue_depth=0, requeued=(),
+                 reforming=False, retry_after=None):
         super().__init__(message)
         self.queue_depth = int(queue_depth)
         self.requeued = tuple(requeued)
+        self.reforming = bool(reforming)
+        self.retry_after = retry_after
 
 
 # Both builders are memoized on (cfg, top_k, donate): every Engine with the
@@ -146,7 +157,7 @@ def _make_decode(cfg, top_k, donate):
 
 @lru_cache(maxsize=None)
 def _make_paged_step(cfg, top_k, page_size, use_kernel, donate,
-                     mp_key=None):
+                     mp_key=None, anomaly=False):
     """Build the FUSED chunk/decode executable over the paged pool: every
     batch row is a slot processing a T-token window (ids' second dim) at
     its own offset. The engine dispatches it at exactly two steady-state
@@ -163,7 +174,15 @@ def _make_paged_step(cfg, top_k, page_size, use_kernel, donate,
     ``mp_key`` = (mesh, ServingMPConfig) routes the forward through the
     mp-sharded schedule (serving/mp_forward.py) — same signature, same
     traced operands, bitwise-identical logits — so the host loop, trace
-    gates and snapshot machinery are mp-blind."""
+    gates and snapshot machinery are mp-blind.
+
+    ``anomaly=True`` (FLAGS_serving_anomaly_policy != "off") additionally
+    returns a per-slot all-finite verdict over the logits ([B] bool,
+    fused into the step — no extra dispatch or host sync beyond the
+    fetch the host loop already does): the serving anomaly guard. The
+    healthy-path math is untouched (one extra reduction output), and
+    with the flag off this builder key is byte-identical to the PR 12
+    executable."""
     config = _cfg_view(cfg)
 
     def fn(params, kc, vc, ids, start, valid, emit, table, do_sample,
@@ -189,6 +208,9 @@ def _make_paged_step(cfg, top_k, page_size, use_kernel, donate,
         nxt = jnp.where(do_sample & emit, sampled, greedy)
         new_keys = jnp.where(emit[:, None], jax.random.key_data(pair[:, 0]),
                              key_data)
+        if anomaly:
+            ok = jnp.all(jnp.isfinite(logits), axis=-1)     # [B] per-slot
+            return kc, vc, nxt, new_keys, ok
         return kc, vc, nxt, new_keys
 
     return jax.jit(fn, donate_argnums=donate)
@@ -231,7 +253,7 @@ class Engine:
                  num_pages=None, prefill_chunk=None, prefix_cache=None,
                  tag=None, trace=None, priority=None, tenant_weights=None,
                  shed=None, params_version=0, mesh=None, mp=None,
-                 comm_backend=None):
+                 comm_backend=None, anomaly=None):
         if model is not None:
             params = _collect_params(model)
             config = model.config
@@ -343,6 +365,27 @@ class Engine:
         # the version its tokens are produced under
         self.params_version = int(params_version)
         self._resolved_total = 0          # feeds the shed drain-rate EWMA
+        # serving anomaly guard (FLAGS_serving_anomaly_policy): "off"
+        # (default — the fused step and its trajectory are byte-identical
+        # to the unguarded engine) or "quarantine" (a per-slot all-finite
+        # check on the logits rides the fused step; a poisoned slot is
+        # resolved finish_reason="error" at the boundary — freed WITHOUT
+        # publishing its prompt pages to the prefix cache — while its
+        # neighbors stay bitwise-stable, so a NaN from bad weights or a
+        # flaky chip never poisons the shared batch or a snapshot)
+        policy = (flags.get("FLAGS_serving_anomaly_policy", "off")
+                  if anomaly is None else anomaly)
+        if policy not in ("off", "quarantine"):
+            raise ValueError(
+                f"FLAGS_serving_anomaly_policy must be 'off' or "
+                f"'quarantine', got {policy!r}")
+        if policy != "off" and self.kv_layout != "paged":
+            raise ValueError(
+                "the serving anomaly guard rides the fused paged step; "
+                "use kv_layout='paged' (the pooled layout is the "
+                "unguarded parity baseline)")
+        self.anomaly_policy = policy
+        self._anomaly = policy != "off"
         self.top_k = (None if top_k in (None, 0)
                       else min(int(top_k), config.vocab_size))
 
@@ -391,11 +434,12 @@ class Engine:
                 self._paged_step = _make_paged_step(
                     cfg, self.top_k, self.page_size, use_kernel,
                     (1, 2) if donate_ok else (),
-                    mp_key=(self._mesh, self._mp_cfg))
+                    mp_key=(self._mesh, self._mp_cfg),
+                    anomaly=self._anomaly)
             else:
                 self._paged_step = _make_paged_step(
                     cfg, self.top_k, self.page_size, use_kernel,
-                    (1, 2) if donate_ok else ())
+                    (1, 2) if donate_ok else (), anomaly=self._anomaly)
             self._page_copy = _make_page_copy((0, 1) if donate_ok else ())
             shape = (config.num_layers, self.pool.num_pages, self.page_size,
                      nh, d)
@@ -433,6 +477,8 @@ class Engine:
         self.tag = "engine" if tag is None else str(tag)
         self._step_count = 0
         self._stopped = False
+        self._reforming = False           # stop_for_reform: temporary stop
+        self._reform_retry_after = None
         self._ckpt = None
         self._snapshot_every = 0
         self._drained = []                # requests the last drain() handed back
@@ -442,6 +488,16 @@ class Engine:
         if self._stopped:
             pending = [r for r in self._drained
                        if r.state not in (FINISHED,)]
+            if self._reforming:
+                hint = self._reform_retry_after
+                raise EngineStoppedError(
+                    f"engine {self.tag!r} is mid-reform (its mp group is "
+                    f"being re-formed after a chip loss/return); the "
+                    f"replica comes back momentarily — retry"
+                    f"{f' in ~{hint:.2f}s' if hint is not None else ''}",
+                    queue_depth=len(pending),
+                    requeued=[r.request_id for r in pending],
+                    reforming=True, retry_after=hint)
             raise EngineStoppedError(
                 f"engine {self.tag!r} is stopped (drained"
                 f"{' after preemption' if self._ckpt is not None and self._ckpt.preempted else ''}); "
@@ -450,6 +506,23 @@ class Engine:
                 f"waiting to be requeued)",
                 queue_depth=len(pending),
                 requeued=[r.request_id for r in pending])
+
+    def stop_for_reform(self, retry_after=None):
+        """Mark this engine TEMPORARILY stopped for a group reform: the
+        supervisor is rebuilding the replica on a different chip set and
+        every piece of state moves with it (live snapshot or disk
+        restore), so — unlike ``drain()`` — nothing is requeued or
+        mutated here. ``submit()`` raises ``EngineStoppedError`` with
+        ``reforming=True`` and the ``retry_after`` hint; the router
+        treats the replica as temporarily unroutable, not dead."""
+        # publish the reform markers BEFORE the stop (same ordering
+        # discipline as rep.state vs rep.engine in the supervisor): a
+        # concurrent submit that sees stopped must never read a
+        # not-yet-reforming engine and write the replica off as dead
+        self._reform_retry_after = (None if retry_after is None
+                                    else float(retry_after))
+        self._reforming = True
+        self._stopped = True
 
     def submit(self, request):
         """Queue a request (FCFS). Raises QueueFullError past max_queue,
@@ -786,13 +859,19 @@ class Engine:
         for b in decoding:
             self._cow(b, int(self._pos[b]), int(self._pos[b]) + 1)
         t0 = time.perf_counter()
-        self._kc, self._vc, nxt, keys = self._paged_step(
+        out = self._paged_step(
             self.params, self._kc, self._vc,
             jnp.asarray(self._tok[:, None]), jnp.asarray(self._pos),
             jnp.asarray(valid), jnp.asarray(emit),
             jnp.asarray(self.pool.table), jnp.asarray(self._do_sample),
             jnp.asarray(self._temp), jnp.asarray(self._top_p),
             jnp.asarray(self._keys))
+        if self._anomaly:
+            self._kc, self._vc, nxt, keys, ok = out
+            ok = np.asarray(ok)
+        else:
+            self._kc, self._vc, nxt, keys = out
+            ok = None
         nxt = np.asarray(nxt)
         self._keys = np.array(keys)
         now = time.perf_counter()
@@ -806,6 +885,9 @@ class Engine:
         metrics.observe_token_latency(now - t_boundary, 1)
         for b in decoding:
             req = self._slots[b]
+            if ok is not None and not ok[b]:
+                self._quarantine(req, b)
+                continue
             if req.trace is not None:
                 # the span covers the whole boundary (chunks + CoW + the
                 # fused dispatch): that IS this stream's inter-token gap
@@ -833,7 +915,7 @@ class Engine:
         ids[0, :v] = req.prompt[off:off + v]
         self._cow(b, off, off + v)
         t0 = time.perf_counter()
-        self._kc, self._vc, nxt, keys = self._paged_step(
+        out = self._paged_step(
             self.params, self._kc, self._vc, jnp.asarray(ids),
             jnp.asarray([off], np.int32), jnp.asarray([v], np.int32),
             jnp.asarray([last]), jnp.asarray(self.pool.table[b:b + 1]),
@@ -841,6 +923,14 @@ class Engine:
             jnp.asarray(self._temp[b:b + 1]),
             jnp.asarray(self._top_p[b:b + 1]),
             jnp.asarray(self._keys[b:b + 1]))
+        if self._anomaly:
+            # the verdict is only consulted on the emitting (final) chunk
+            # — fetch it there, not per chunk (no extra host sync on the
+            # interleaved bulk-prefill path)
+            self._kc, self._vc, nxt, keys, ok_dev = out
+        else:
+            self._kc, self._vc, nxt, keys = out
+            ok_dev = None
         t1 = time.perf_counter()
         self._record_mp_comm(1, C, t0, t1, [req])
         metrics.bump("paged_steps")
@@ -856,6 +946,13 @@ class Engine:
             self._pos[b] = plen               # next decode writes here
             # only the final chunk is padded: waste < chunk per request
             metrics.observe_prefill_waste(C - v)
+            ok = True if ok_dev is None else bool(np.asarray(ok_dev)[0])
+            if not ok:
+                # poisoned already at first-token time (bad weights or a
+                # corrupted prompt page): quarantine before anything is
+                # emitted or published
+                self._quarantine(req, b)
+                return
             tok = int(np.asarray(nxt)[0])
             self._emit_token(req, b, tok, first=True)
         else:
@@ -1088,9 +1185,27 @@ class Engine:
         self._temp[b] = float(req.temperature)
         self._top_p[b] = 1.0 if req.top_p is None else float(req.top_p)
 
-    def _free_slot(self, b):
+    def _quarantine(self, req, b):
+        """Anomaly-guard resolution (``FLAGS_serving_anomaly_policy=
+        quarantine``): the fused step's per-slot all-finite check flagged
+        this slot's logits — a NaN/Inf from bad weights, a corrupted KV
+        page or a flaky chip. The token is NOT emitted (it would be
+        garbage), the slot is freed WITHOUT publishing its prompt pages
+        to the prefix cache (poisoned KV must never be reused), and the
+        request resolves ``finish_reason="error"`` at this boundary.
+        Neighbors are bitwise-stable — batch rows never interact — and
+        the freed slot/pages are re-written before any future read, so
+        neither the shared batch nor the next snapshot carries the
+        poison forward."""
+        pos = int(self._pos[b])
+        self._free_slot(b, register=False)
+        if req.trace is not None:
+            req.trace.instant("anomaly", pos=pos)
+        self._resolve(req, ERROR, count="anomalies_quarantined")
+
+    def _free_slot(self, b, register=True):
         req = self._slots[b]
-        if self.kv_layout == "paged" and req is not None \
+        if self.kv_layout == "paged" and req is not None and register \
                 and int(self._chunk_off[b]) >= req.prompt_len:
             # publish the prompt's pages for prefix reuse ON RELEASE
             # (vLLM-style cache-on-free): the slot never decodes into a
@@ -1407,6 +1522,8 @@ class Engine:
             metrics.import_state(state["metrics"])
         metrics.bump("snapshot_restores")
         self._stopped = False
+        self._reforming = False
+        self._reform_retry_after = None
         self._drained = []
         return self
 

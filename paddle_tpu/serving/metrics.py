@@ -47,6 +47,10 @@ def _zero():
         "snapshots": 0, "snapshot_restores": 0, "preempt_drains": 0,
         "requeued": 0, "replayed": 0, "respawns": 0,
         "stale_failovers": 0, "rolling_restarts": 0, "dropped": 0,
+        # serving anomaly guard (FLAGS_serving_anomaly_policy=quarantine):
+        # slots whose logits went non-finite, resolved "error" at the
+        # boundary with neighbors bitwise-stable
+        "anomalies_quarantined": 0,
         # SLO traffic management (serving/slo.py): queued work shed under
         # sustained overload, running slots preempted for an interactive
         # deadline, router-side rate-limit refusals, autoscale actions and
@@ -280,14 +284,17 @@ def serving_summary():
     heal = ""
     if any(c[k] for k in ("snapshots", "snapshot_restores", "preempt_drains",
                           "requeued", "replayed", "respawns",
-                          "stale_failovers", "rolling_restarts", "dropped")):
+                          "stale_failovers", "rolling_restarts", "dropped",
+                          "anomalies_quarantined")):
         heal = (f"  self-heal: {c['snapshots']} snap / "
                 f"{c['snapshot_restores']} restore  "
                 f"drains: {c['preempt_drains']}  "
                 f"requeued/replayed: {c['requeued']}/{c['replayed']}  "
                 f"respawns: {c['respawns']} "
                 f"({c['stale_failovers']} stale-hb)  "
-                f"dropped: {c['dropped']}")
+                f"dropped: {c['dropped']}"
+                + (f"  anomalies-quarantined: {c['anomalies_quarantined']}"
+                   if c["anomalies_quarantined"] else ""))
     mp = ""
     if c["mp_steps"]:
         with _lock:

@@ -31,6 +31,8 @@ EXPIRED = "expired"    # deadline passed before/while running
 CANCELLED = "cancelled"
 DROPPED = "dropped"    # supervisor had no live replica left to replay on
 SHED = "shed"          # load-shed under sustained overload (retry_after set)
+ERROR = "error"        # anomaly guard quarantined the slot (non-finite
+                       # logits — bad weights / corrupted KV / flaky chip)
 
 
 @dataclass(eq=False)  # identity equality: deque.remove/cancel compare BY

@@ -40,40 +40,20 @@ from ..incubate.checkpoint import CheckpointManager, Preempted
 from ..distributed.elastic import Heartbeat, HeartbeatMonitor
 from ..utils.fault_injection import Preemption
 from . import metrics
+from .elastic import (  # noqa: F401  (mp_replica_meshes re-exported here)
+    FleetTopology, degraded_count, mp_replica_meshes, record_reform,
+    set_group_gauges,
+)
 from .engine import EngineStoppedError
 from .request import CANCELLED, DROPPED, FINISHED, Request
 from .scheduler import QueueFullError, ShedError
 from .slo import Autoscaler, TokenBucket
 
 
-def mp_replica_meshes(num_replicas, mp, devices=None):
-    """Partition the device set into ``num_replicas`` DISJOINT 1-D ('mp',)
-    meshes of ``mp`` chips each — under tensor-parallel serving a replica
-    is an mp GROUP, not a chip. Hand each mesh to its replica's engine via
-    a one-arg factory::
-
-        meshes = serving.mp_replica_meshes(2, mp=4)      # 8 chips
-        sup = ServingSupervisor(
-            lambda i: serving.Engine(params=p, config=cfg,
-                                     mesh=meshes[i]),
-            num_replicas=2)
-
-    The supervisor calls a factory that accepts an argument with the
-    replica index (zero-arg factories keep working unchanged), so
-    respawn-after-crash and rolling restarts rebuild each replica on ITS
-    OWN chip group."""
-    import jax
-    import numpy as np
-    from jax.sharding import Mesh
-    devices = list(jax.devices() if devices is None else devices)
-    mp = int(mp)
-    need = int(num_replicas) * mp
-    if need > len(devices):
-        raise ValueError(
-            f"{num_replicas} mp={mp} replicas need {need} devices, only "
-            f"{len(devices)} available")
-    return [Mesh(np.array(devices[i * mp:(i + 1) * mp]), ("mp",))
-            for i in range(int(num_replicas))]
+class ChipLossError(RuntimeError):
+    """A chip of this replica's mp group was lost (injected schedule or
+    stale chip heartbeat): the whole group is down and must be re-formed
+    over the survivors."""
 
 
 class _Replica:
@@ -86,23 +66,45 @@ class _Replica:
         self.hb = hb                # persistent Heartbeat or None
         self.engine = None
         # "up" | "down" | "draining" (rolling restart mid-drain: alive but
-        # UNROUTABLE — submit/spill/replay must not target it) | "retired"
-        # (scaled down: permanently out of rotation, indices stay stable)
+        # UNROUTABLE — submit/spill/replay must not target it) |
+        # "reforming" (topology-elastic: the mp group is being re-formed
+        # over surviving chips — TEMPORARILY unroutable, comes back) |
+        # "retired" (scaled down: permanently out of rotation, indices
+        # stay stable)
         self.state = "down"
         self.restarts = 0
         self.last_error = None
+        # topology-elastic state (None/0 when the supervisor is not in
+        # elastic mode): the mesh the CURRENT engine runs on, its mp
+        # degree and its global chip ranks
+        self.mesh = None
+        self.mp = 0
+        self.group = ()
+        self.chip_lost = False      # down specifically for lost chips
+        # spaced retry of a reform whose spawn/restore keeps failing:
+        # boundaries left to skip, and the (doubling) next skip length
+        self.reform_wait = 0
+        self.reform_backoff = 0
 
     @property
     def routable(self):
         """Safe as a routing/replay target: up AND its engine accepts
         work (a drained engine raises EngineStoppedError on submit even
         while the replica object still says "up")."""
-        return (self.state == "up" and self.engine is not None
-                and not self.engine.stopped)
+        # single read of engine: a reform on the supervising thread
+        # nulls it concurrently with router threads
+        eng = self.engine
+        return self.state == "up" and eng is not None and not eng.stopped
 
     @property
     def load(self):
-        return self.engine.queue_depth + self.engine.active_slots
+        # single read of engine: a reform on the supervising thread nulls
+        # it concurrently with router threads sorting by load — a nulled
+        # replica sorts last and the router's in-loop None guard skips it
+        eng = self.engine
+        if eng is None:
+            return float("inf")
+        return eng.queue_depth + eng.active_slots
 
 
 class ServingSupervisor:
@@ -123,16 +125,61 @@ class ServingSupervisor:
     ``heartbeat_timeout`` is failed over even though its process never
     raised). ``max_restarts`` bounds respawns per replica; past it the
     replica stays down and its work is replayed on the survivors.
+
+    ``mp=N`` turns the supervisor TOPOLOGY-ELASTIC (serving/elastic.py):
+    each replica is an mp GROUP of N chips (``devices`` defaults to the
+    first ``num_replicas * N`` of ``jax.devices()``), watched at CHIP
+    granularity — injected ``FaultPlan.serving_chip_loss_at`` schedules
+    and, with ``heartbeat_dir``, per-chip heartbeat staleness. One lost
+    chip marks its whole group down; the group re-forms over its
+    surviving chips at the largest viable mp degree and respawns through
+    the MP-PORTABLE snapshot path (mid-decode requests resume bitwise on
+    the smaller group; the rest replays — zero drops). When the chips
+    return the group grows back from a live snapshot
+    (``FLAGS_serving_elastic_grow``) with zero drops and zero new traces
+    (engine builders are memoized per (cfg, mesh, rung)). The factory
+    must take ``(replica_idx, mesh)``. While a group is mid-reform the
+    router treats it as temporarily unroutable; shed watermarks and the
+    autoscaler read live ROUTABLE capacity, so a degraded fleet sheds
+    and scales against what can actually serve.
     """
 
     def __init__(self, engine_factory, num_replicas=2, *, snapshot_dir=None,
                  snapshot_every=None, max_restarts=None, heartbeat_dir=None,
                  heartbeat_timeout=None, autoscale=None, tenant_rate=None,
-                 tenant_burst=None):
+                 tenant_burst=None, mp=None, devices=None,
+                 elastic_grow=None):
         flags = get_flags()
         self.engine_factory = engine_factory
         self._factory_arity = None       # lazily inspected (_call_factory)
         self.snapshot_every = snapshot_every
+        # -- topology-elastic mode (serving/elastic.py): ``mp`` makes each
+        # replica an mp GROUP the supervisor watches at CHIP granularity.
+        # A lost chip (injected schedule or stale per-chip heartbeat)
+        # marks its whole group down; the group is re-formed over its
+        # surviving chips at the largest viable mp degree and respawned
+        # through the mp-portable snapshot path (bitwise resume). When
+        # chips return the group grows back from a LIVE snapshot (zero
+        # drops, memoized builders → zero new traces). With ``mp`` unset
+        # the supervisor is the plain PR 7/10 fleet, byte-identical.
+        self._topology = None
+        self._topo_step = 0
+        self._configured_mp = 0
+        self._elastic_grow = (bool(flags.get("FLAGS_serving_elastic_grow",
+                                             True))
+                              if elastic_grow is None else bool(elastic_grow))
+        self._reform_retries = int(
+            flags.get("FLAGS_serving_reform_retries", 2))
+        if mp is not None:
+            self._configured_mp = int(mp)
+            self._topology = FleetTopology(
+                devices, self._configured_mp, num_replicas,
+                heartbeat_dir=heartbeat_dir,
+                heartbeat_timeout=heartbeat_timeout)
+            # liveness is per-CHIP in elastic mode (the topology monitor
+            # supersedes per-replica heartbeats: a stale chip takes its
+            # group down through the reform path, not the failover path)
+            heartbeat_dir = None
         self.max_restarts = int(
             max_restarts if max_restarts is not None
             else flags.get("FLAGS_serving_max_restarts", 3))
@@ -199,6 +246,15 @@ class ServingSupervisor:
         if self._heartbeat_dir is not None:
             hb = Heartbeat(self._heartbeat_dir, rank=i)
         rep = _Replica(i, mgr, hb)
+        if self._topology is not None:
+            if i >= self._topology.num_replicas:
+                raise ValueError(
+                    f"cannot grow replica {i}: the elastic fleet topology "
+                    f"was sized for {self._topology.num_replicas} mp="
+                    f"{self._configured_mp} groups (autoscale growth needs "
+                    f"spare chips the topology does not have)")
+            rep.mp, rep.group = self._topology.plan(i, frozenset())
+            rep.mesh = self._topology.mesh_for(rep.group)
         rep.engine = self._spawn_engine(rep)
         rep.state = "up"
         if hb is not None:
@@ -220,7 +276,7 @@ class ServingSupervisor:
                                         timeout=float(timeout))
 
     def _spawn_engine(self, rep):
-        eng = self._call_factory(rep.idx)
+        eng = self._call_factory(rep)
         eng.tag = f"replica{rep.idx}"
         if self._live_params is not None:
             # the fleet was hot-upgraded: every spawn — crash respawn,
@@ -234,12 +290,14 @@ class ServingSupervisor:
             eng.attach_checkpoint(rep.mgr, every=self.snapshot_every)
         return eng
 
-    def _call_factory(self, idx):
+    def _call_factory(self, rep):
         """Invoke the engine factory — one-arg factories receive the
         replica index (the tensor-parallel deployment shape: each replica
         builds its engine on its OWN mp device group, see
         ``mp_replica_meshes``); zero-arg factories keep the PR 7
-        contract unchanged."""
+        contract unchanged. In topology-elastic mode the factory MUST
+        take ``(idx, mesh)`` — the mesh changes across reforms, so a
+        factory that bakes its own mesh cannot follow the topology."""
         if self._factory_arity is None:
             try:
                 import inspect
@@ -250,8 +308,15 @@ class ServingSupervisor:
                                   p.POSITIONAL_OR_KEYWORD))
             except (TypeError, ValueError):
                 self._factory_arity = 0
+            if self._topology is not None and self._factory_arity < 2:
+                raise TypeError(
+                    "a topology-elastic supervisor (mp=...) needs a "
+                    "two-arg engine factory (replica_idx, mesh): the mesh "
+                    "changes when the group re-forms over surviving chips")
+        if self._factory_arity >= 2 and self._topology is not None:
+            return self.engine_factory(rep.idx, rep.mesh)
         if self._factory_arity >= 1:
-            return self.engine_factory(idx)
+            return self.engine_factory(rep.idx)
         return self.engine_factory()
 
     # -- routing -------------------------------------------------------------
@@ -308,28 +373,65 @@ class ServingSupervisor:
                 max_queue=self.fleet_max_queue(), retry_after=wait)
 
     def fleet_queue_depth(self):
-        return sum(r.engine.queue_depth for r in self._replicas
-                   if r.engine is not None)
+        # single read of rep.engine per replica: a reform on the
+        # supervising thread nulls it concurrently with router threads
+        engines = [r.engine for r in self._replicas]
+        return sum(e.queue_depth for e in engines if e is not None)
 
     def fleet_max_queue(self):
-        return sum(r.engine.scheduler.max_queue for r in self._routable())
+        engines = [r.engine for r in self._routable()]
+        return sum(e.scheduler.max_queue for e in engines if e is not None)
+
+    def _reform_hint(self):
+        """retry-after estimate while the fleet is mid-reform: the last
+        observed reform latency (elastic ledger), floored/capped to a
+        sane backoff window. None when nothing is reforming."""
+        reforming = False
+        for r in self._replicas:
+            eng = r.engine            # single read (reform race, above)
+            if r.state == "reforming" or (eng is not None
+                                          and eng._reforming):
+                reforming = True
+                break
+        if not reforming:
+            return None
+        return self._last_reform_latency()
 
     def submit(self, request):
         """Route a request to the least-loaded routable replica (spilling
         to the next when its queue is full; ``QueueFullError`` — with
         FLEET-WIDE ``qsize``/``max_queue`` totals as its back-off hints —
         only once EVERY replica is saturated). Draining/stopped replicas
-        are never targeted. Raises ``EngineStoppedError`` when no replica
-        is routable, ``ShedError`` when the tenant is over its rate
-        limit."""
+        are never targeted; a replica MID-REFORM is temporarily
+        unroutable, not dead — with every replica reforming the router
+        backs off (bounded retries with a deterministic per-request
+        jitter) and only then raises ``EngineStoppedError`` with
+        ``reforming=True`` and a ``retry_after`` hint. Raises plain
+        ``EngineStoppedError`` when the fleet is genuinely dead,
+        ``ShedError`` when the tenant is over its rate limit."""
         if not isinstance(request, Request):
             request = Request(request)
-        ups = sorted(self._routable(), key=lambda r: (r.load, r.idx))
-        if not ups:
-            raise EngineStoppedError(
-                "no live serving replica", queue_depth=0, requeued=())
+        for attempt in range(self._reform_retries + 1):
+            ups = sorted(self._routable(), key=lambda r: (r.load, r.idx))
+            if ups:
+                break
+            hint = self._reform_hint()
+            if hint is None:
+                raise EngineStoppedError(
+                    "no live serving replica", queue_depth=0, requeued=())
+            if attempt >= self._reform_retries:
+                raise EngineStoppedError(
+                    f"every replica is mid-reform (chip loss/return); "
+                    f"retry in ~{hint:.2f}s", queue_depth=0, requeued=(),
+                    reforming=True, retry_after=hint)
+            # bounded jittered backoff: deterministic per request (id-
+            # derived jitter in [0.5, 1.0)), so a thundering herd of
+            # routers desynchronizes without wall-clock randomness
+            time.sleep(min(hint, 0.25)
+                       * (0.5 + (request.request_id % 8) / 16.0))
         self._rate_limit(request)
         shedding = []
+        stopped_midway = 0
         for rep in ups:
             # saturation probes, not trial submits: a failed Engine.submit
             # bumps the global submitted/rejected/shed ledger, so spilling
@@ -337,15 +439,70 @@ class ServingSupervisor:
             # (or shed-latched) replica and skew the SLO surface. Shed
             # state is PER-ENGINE — a replica latched in overload is
             # skipped and the request spills to a healthy one.
-            shed = rep.engine._shed
+            eng = rep.engine
+            if eng is None:
+                # a reform nulled the engine after the routable snapshot
+                # (router threads vs the supervising thread): temporarily
+                # unroutable, same as a mid-reform stop
+                stopped_midway += 1
+                continue
+            shed = eng._shed
             if shed is not None and shed.shedding \
                     and request.class_rank >= 2:
-                shedding.append(rep)
+                shedding.append(eng)    # the engine object: reform-safe
                 continue
-            if rep.engine.queue_depth < rep.engine.scheduler.max_queue:
-                rep.engine.submit(request)
+            if eng.queue_depth < eng.scheduler.max_queue:
+                rid = request.request_id
+                # register ownership BEFORE the engine accepts the work: a
+                # group reform landing between a successful submit and a
+                # later owner-map write could not see this request in
+                # _unacked_of and would restore a snapshot predating it —
+                # owned by nobody, hosted by nobody, pending forever
+                with self._lock:
+                    self._requests[rid] = request
+                    self._owner[rid] = rep.idx
+                try:
+                    eng.submit(request)
+                except EngineStoppedError:
+                    # stopped between the probe and the submit (a reform/
+                    # drain on another thread): temporarily unroutable,
+                    # not dead — spill to the next candidate. Undo only
+                    # OUR registration: a reform that already saw it has
+                    # replayed a copy and re-homed the maps, and that
+                    # copy IS the routed request.
+                    with self._lock:
+                        rerouted = not (
+                            self._requests.get(rid) is request
+                            and self._owner.get(rid) == rep.idx)
+                        if not rerouted:
+                            del self._requests[rid]
+                            del self._owner[rid]
+                    if rerouted:
+                        break
+                    stopped_midway += 1
+                    continue
+                except BaseException:
+                    with self._lock:
+                        if self._requests.get(rid) is request \
+                                and self._owner.get(rid) == rep.idx:
+                            del self._requests[rid]
+                            del self._owner[rid]
+                    raise
                 break
         else:
+            if ups and stopped_midway == len(ups):
+                # EVERY candidate stopped between the routable() snapshot
+                # and its submit (the fleet went mid-reform under us):
+                # surface the typed temporary error, never a bogus
+                # saturation hint computed from now-empty queues
+                hint = self._reform_hint()
+                if hint is not None:
+                    raise EngineStoppedError(
+                        f"every replica went mid-reform while routing; "
+                        f"retry in ~{hint:.2f}s", queue_depth=0,
+                        requeued=(), reforming=True, retry_after=hint)
+                raise EngineStoppedError(
+                    "no live serving replica", queue_depth=0, requeued=())
             # fleet-wide totals: the backoff a client derives from the
             # hint must reflect every queue it competes with, not whatever
             # replica happened to be probed last
@@ -359,15 +516,16 @@ class ServingSupervisor:
                     f"({qsize}/{cap} waiting); retry later",
                     qsize=qsize, max_queue=cap,
                     retry_after=max(
-                        r.engine._shed.retry_after(r.engine.queue_depth)
-                        for r in shedding))
+                        e._shed.retry_after(e.queue_depth)
+                        for e in shedding))
+            # the hint must not claim pure saturation when part of the
+            # fleet is actually mid-reform and about to come back
+            reform_note = (f"; {stopped_midway} replica(s) mid-reform"
+                           if stopped_midway else "")
             raise QueueFullError(
-                f"all {len(ups)} replica queues full ({qsize}/{cap} "
-                f"waiting fleet-wide); retry later",
-                qsize=qsize, max_queue=cap)
-        with self._lock:
-            self._requests[request.request_id] = request
-            self._owner[request.request_id] = rep.idx
+                f"all {len(ups) - stopped_midway} routable replica queues "
+                f"full ({qsize}/{cap} waiting fleet-wide{reform_note}); "
+                f"retry later", qsize=qsize, max_queue=cap)
         return request
 
     def _acked(self, rid):
@@ -385,8 +543,13 @@ class ServingSupervisor:
         with self._lock:
             live = self._requests.get(rid, request)
             owner = self._owner.get(rid)
-        if owner is not None and self._replicas[owner].state == "up":
-            self._replicas[owner].engine.cancel(live)
+        rep = self._replicas[owner] if owner is not None else None
+        # single read of .engine: a group reform (or crash failover) on
+        # the supervising thread nulls it between a state check and the
+        # dereference — fall through to the direct resolve instead
+        eng = rep.engine if rep is not None and rep.state == "up" else None
+        if eng is not None:
+            eng.cancel(live)
         elif live.state != FINISHED:
             # owner down / mid-replay: resolve directly so pending() drains
             live._finish(CANCELLED)
@@ -400,6 +563,8 @@ class ServingSupervisor:
         iteration (heartbeating it), fail over replicas that died or went
         stale, collect results. Returns True while undelivered requests
         remain."""
+        if self._topology is not None:
+            self._poll_topology()
         for rep in self._replicas:
             if rep.state != "up":
                 continue
@@ -438,8 +603,15 @@ class ServingSupervisor:
         (queue depth, slot occupancy, TTFT p99 — the PR 9 surface) and
         apply at most one action. Runs on the supervising thread at a step
         boundary, so growth/shrink can never tear an engine mid-dispatch;
-        hysteresis windows and the cooldown live in the policy object."""
-        ups = self._up()
+        hysteresis windows and the cooldown live in the policy object.
+
+        Counts LIVE ROUTABLE capacity, not the configured replica count:
+        a fleet degraded by a chip loss (groups down or mid-reform) has
+        genuinely less capacity, and the policy must see the queue
+        pressure against what can actually serve right now."""
+        ups = self._routable()
+        if not ups:
+            return
         action = self.autoscaler.decide(
             alive=len(ups),
             queue_depth=sum(r.engine.queue_depth for r in ups),
@@ -453,7 +625,13 @@ class ServingSupervisor:
 
     def _grow_replica(self):
         """Scale up: append a fresh replica (same snapshot/heartbeat
-        wiring, live weights) and extend the liveness monitor over it."""
+        wiring, live weights) and extend the liveness monitor over it.
+        A topology-elastic fleet cannot grow past the chip groups it was
+        sized for — growth there is the chips RETURNING (grow-back), not
+        new replicas."""
+        if self._topology is not None \
+                and len(self._replicas) >= self._topology.num_replicas:
+            return
         rep = self._new_replica(len(self._replicas))
         self._replicas.append(rep)
         self._remake_monitor()
@@ -463,7 +641,15 @@ class ServingSupervisor:
         """Scale down: drain the least-loaded replica (its in-flight work
         requeued on the survivors with ORIGINAL arrival — the rolling-
         restart machinery, zero drops) and retire the slot. Indices stay
-        stable, so owner bookkeeping and heartbeat ranks never shift."""
+        stable, so owner bookkeeping and heartbeat ranks never shift.
+
+        A topology-elastic fleet never retires a chip group this way:
+        _grow_replica cannot re-create one past the topology (retirement
+        would be IRREVERSIBLE — healthy chips pinned idle forever), so
+        there capacity follows the chips (reform/grow-back), and the
+        autoscaler's shrink decision is a no-op."""
+        if self._topology is not None:
+            return
         ups = self._up()
         if len(ups) <= 1:
             return
@@ -507,19 +693,30 @@ class ServingSupervisor:
         rep.state = "down"
         rep.last_error = err
         rep.engine = None
+        unacked = self._unacked_of(rep)
+        rep.restarts += 1
+        if rep.restarts > self.max_restarts:
+            self._replay(unacked)
+            return
+        self._respawn_from_snapshot(rep, unacked)
+
+    def _unacked_of(self, rep):
         with self._lock:
-            unacked = [rid for rid, owner in self._owner.items()
-                       if owner == rep.idx and not self._acked(rid)]
+            return [rid for rid, owner in self._owner.items()
+                    if owner == rep.idx and not self._acked(rid)]
+
+    def _respawn_from_snapshot(self, rep, unacked):
+        """Shared respawn core (crash failover AND chip-loss reform):
+        spawn a fresh engine on the replica's CURRENT mesh, restore its
+        last disk snapshot when one loads, reconcile restored work
+        against delivery/ownership, replay the remainder. Returns True
+        when the snapshot restored."""
         snap = None
         if rep.mgr is not None:
             try:
                 snap = rep.mgr.restore(None)   # quarantines corrupt steps
             except Exception:
                 snap = None
-        rep.restarts += 1
-        if rep.restarts > self.max_restarts:
-            self._replay(unacked)
-            return
         eng = self._spawn_engine(rep)
         restored = False
         if snap is not None:
@@ -534,31 +731,228 @@ class ServingSupervisor:
         if rep.hb is not None:
             rep.hb.beat(status="running")
         if restored:
-            # the snapshot may predate request movement: anything already
-            # delivered, or since reassigned to ANOTHER replica (e.g. by a
-            # rolling-restart drain), must not be recomputed here — cancel
-            # is neighbor-stable, so the resumed slots stay bitwise intact
-            for req in list(eng.live_requests()):
-                rid = req.request_id
-                if self._acked(rid) or self._owner.get(rid) != rep.idx:
-                    # hygiene, not a user cancellation: skip the ledger
-                    eng.cancel(req, count=None)
-                else:
-                    with self._lock:
-                        self._requests[rid] = req  # live handle for cancel()
-            # and purge stale results for moved/delivered requests (the
-            # cancels above just minted CANCELLED results; a snapshot can
-            # also carry pre-save ones): _collect must never deliver them
-            # ahead of — or instead of — the real owner's stream
-            for rid in list(eng._results):
-                if self._acked(rid) or self._owner.get(rid) != rep.idx:
-                    del eng._results[rid]
-            recomputes = {r.request_id for r in eng.live_requests()}
-            recomputes.update(eng._results)
-            self._replay([rid for rid in unacked if rid not in recomputes],
+            hosted = self._reconcile_restored(rep, eng)
+            self._replay([rid for rid in unacked if rid not in hosted],
                          prefer=rep)
         else:
             self._replay(unacked)
+        return restored
+
+    def _reconcile_restored(self, rep, eng):
+        """Reconcile a restored engine's work against delivery/ownership
+        (shared by crash/loss respawn AND grow-back). The snapshot may
+        predate request movement: anything already delivered, cancelled,
+        or since reassigned to ANOTHER replica (e.g. by a rolling-restart
+        drain) must not be recomputed here — cancel is neighbor-stable,
+        so the resumed slots stay bitwise intact. Stale results for
+        moved/delivered requests are purged (the cancels just minted
+        CANCELLED results; a snapshot can also carry pre-save ones):
+        _collect must never deliver them ahead of — or instead of — the
+        real owner's stream. Returns the rids the engine still hosts."""
+        for req in list(eng.live_requests()):
+            rid = req.request_id
+            if self._acked(rid) or self._owner.get(rid) != rep.idx:
+                # hygiene, not a user cancellation: skip the ledger
+                eng.cancel(req, count=None)
+            else:
+                with self._lock:
+                    self._requests[rid] = req  # live handle for cancel()
+        for rid in list(eng._results):
+            if self._acked(rid) or self._owner.get(rid) != rep.idx:
+                del eng._results[rid]
+        hosted = {r.request_id for r in eng.live_requests()}
+        hosted.update(eng._results)
+        return hosted
+
+    # -- topology-elastic: chip loss, group reform, grow-back ----------------
+    def _poll_topology(self):
+        """One chip-liveness round (elastic mode): beat the per-chip
+        heartbeats, read the lost-chip set (injected serving schedule +
+        stale chips), and reconcile every group against its plan — a
+        group that lost a chip re-forms over its survivors at the largest
+        viable mp degree; a degraded group whose chips returned grows
+        back. Runs BEFORE the replicas step, so a group is marked down
+        deterministically at the boundary the loss fires on — the dead
+        engine is never stepped past the loss point."""
+        topo = self._topology
+        step = self._topo_step
+        self._topo_step += 1
+        topo.beat(step)
+        lost = topo.lost_chips(step)
+        for rep in self._replicas:
+            if rep.state in ("retired", "draining"):
+                continue
+            hit = any(c in lost for c in rep.group)
+            degraded = rep.state != "up" or rep.mp < self._configured_mp
+            if not hit and not degraded:
+                continue            # healthy full-degree group: no plan
+            plan = topo.plan(rep.idx, lost)
+            try:
+                if rep.state == "up" and hit:
+                    self._reform_group(rep, plan, lost)
+                elif rep.state in ("down", "reforming") and rep.chip_lost \
+                        and plan is not None \
+                        and (rep.mp > 0 or self._elastic_grow):
+                    # every chip of the group had died (or a prior reform
+                    # attempt failed); chips are available now — bring the
+                    # group back at whatever degree they support. A failed
+                    # reform attempt (mp > 0: it was mid-shrink at a viable
+                    # degree) retries regardless of the grow flag; a FULLY
+                    # dead group (mp == 0) coming back is a grow-back and
+                    # honors FLAGS_serving_elastic_grow=False ("chip
+                    # losses are sticky, groups only shrink")
+                    if rep.reform_wait > 0:
+                        # spaced retry: a persistently-failing spawn/
+                        # restore must not cost the healthy groups a full
+                        # spawn attempt at EVERY boundary
+                        rep.reform_wait -= 1
+                    else:
+                        self._reform_group(rep, plan, lost)
+                elif self._elastic_grow and rep.state == "up" \
+                        and plan is not None and plan[0] > rep.mp:
+                    self._grow_group(rep, plan)
+            except Exception as e:  # noqa: BLE001 — a failed spawn/restore
+                # mid-reform must neither kill the supervising loop nor
+                # wedge the replica in "reforming": the group goes down,
+                # its work replays on the survivors (zero drops), and the
+                # resurrect branch above retries it — with a DOUBLING
+                # boundary backoff, so a survivor set that can never host
+                # the engine does not stall the fleet with per-token
+                # spawn attempts
+                rep.state = "down"
+                rep.engine = None
+                rep.chip_lost = True
+                rep.last_error = e
+                rep.reform_backoff = min(max(1, rep.reform_backoff * 2), 32)
+                rep.reform_wait = rep.reform_backoff
+                self._replay(self._unacked_of(rep))
+        set_group_gauges(self._replicas, self._configured_mp)
+
+    def _reform_group(self, rep, plan, lost):
+        """Chip-loss reform: the group lost at least one chip, so the
+        whole replica is down (its device state — sharded weights and KV
+        — is gone with the chip). Re-form over the surviving chips at the
+        largest viable mp degree and respawn through the MP-PORTABLE
+        snapshot path: the pool geometry is global and the gather-only
+        schedule is bitwise at every degree, so mid-decode requests
+        resume bitwise on the smaller group; anything newer than the
+        snapshot (or everything, with no snapshot) replays — zero drops
+        either way. Does NOT burn the crash-restart budget: a topology
+        event is not an engine fault."""
+        t0 = time.perf_counter()
+        # a dead group whose chips came back, or the retry of a reform
+        # attempt that failed mid-spawn (engine already gone either way)
+        returning = rep.state in ("down", "reforming")
+        # state flips BEFORE the engine is nulled (same order as
+        # _on_failure): a router thread reading state=="up" must never
+        # then find engine None mid-dereference
+        rep.state = "reforming"
+        if not returning:
+            dead = [c for c in rep.group if c in lost]
+            rep.chip_lost = True
+            rep.last_error = ChipLossError(
+                f"replica {rep.idx} lost chip(s) {dead} of mp={rep.mp} "
+                f"group {list(rep.group)}")
+            if rep.engine is not None:
+                # late submissions from router threads see a TYPED
+                # temporary stop (reforming + retry_after), not a bare
+                # dead engine
+                rep.engine.stop_for_reform(self._last_reform_latency())
+            rep.engine = None
+        unacked = self._unacked_of(rep)
+        if plan is None:
+            # no home chip survives: the group stays down (degraded to
+            # zero capacity) until chips return; its work replays on the
+            # surviving groups
+            rep.state = "down"
+            rep.mp, rep.mesh, rep.group = 0, None, ()
+            self._replay(unacked)
+            # no record_reform: nothing re-formed — counting this as a
+            # group_reform (and clobbering reform_latency_s_last with the
+            # microseconds it took to mark the group down) would skew
+            # every later retry_after hint and the ladder's latency p99;
+            # the loss itself shows in degraded_groups / chips-lost
+            return
+        prev_mp = rep.mp
+        rep.mp, rep.group = plan
+        rep.mesh = self._topology.mesh_for(rep.group)
+        if returning:
+            rep.chip_lost = False
+        self._respawn_from_snapshot(rep, unacked)
+        rep.reform_wait = rep.reform_backoff = 0   # spawn worked again
+        self._mark_reform_hop(rep)
+        # "grow" only when the degree actually rose (a fully-dead group
+        # coming back): the RETRY of a loss-reform that failed mid-spawn
+        # also arrives with returning=True but lands at the same-or-lower
+        # degree and must not inflate the grow_backs audit trail
+        record_reform("grow" if returning and plan[0] > prev_mp else "loss",
+                      time.perf_counter() - t0)
+
+    def _grow_group(self, rep, plan):
+        """Grow-back: chips returned (``serving_chip_return_at`` fired /
+        heartbeats recovered) and the group can host a higher mp degree
+        again. The replica is HEALTHY, so the reform is a live handoff:
+        snapshot the running engine in memory (slots intact), rebuild on
+        the bigger mesh, restore — zero drops, zero replays, bitwise
+        (the mp-portable snapshot contract), and zero new traces: the
+        engine builders are memoized per (cfg, mesh, rung), so the
+        original topology's executables are still warm."""
+        t0 = time.perf_counter()
+        eng_old = rep.engine
+        rep.state = "reforming"
+        # stop FIRST, snapshot second: a router-thread submit landing in
+        # eng_old after the snapshot would exist only in the engine about
+        # to be discarded (owned but on no engine — a silent drop). Once
+        # stopped, late submits get the typed reforming error and spill.
+        eng_old.stop_for_reform(self._last_reform_latency())
+        state = eng_old.state_dict()      # live, boundary-consistent
+        prev = (rep.mp, rep.group, rep.mesh)
+        rep.mp, rep.group = plan
+        rep.mesh = self._topology.mesh_for(rep.group)
+        rep.chip_lost = False
+        try:
+            eng = self._spawn_engine(rep)
+            eng.load_state_dict(state)    # mp-portable: bitwise resume
+        except BaseException:
+            # a failed grow must not leave the replica claiming the
+            # TARGET degree: the retry's prev_mp comparison would
+            # misrecord the eventual grow-back as a loss-reform, and
+            # gauges would report capacity the group does not have
+            rep.mp, rep.group, rep.mesh = prev
+            raise
+        rep.engine = eng
+        rep.state = "up"
+        rep.reform_wait = rep.reform_backoff = 0   # spawn worked again
+        # same reconciliation as the loss path: the handoff minted FRESH
+        # Request objects (from_state), so live handles must be refreshed
+        # for cancel() identity-routing; a request cancelled MID-grow
+        # (acked directly while the engine was nulled) must not be
+        # resurrected and decoded to completion on the grown engine; and
+        # a router thread that passed eng_old's stopped check just before
+        # stop_for_reform can land its request in eng_old AFTER the state
+        # snapshot (submit registers ownership BEFORE the engine accepts,
+        # so it is visible here) — anything owned but hosted by neither
+        # the snapshot nor a result replays on the grown engine
+        hosted = self._reconcile_restored(rep, eng)
+        self._replay([rid for rid in self._unacked_of(rep)
+                      if rid not in hosted], prefer=rep)
+        self._mark_reform_hop(rep)
+        record_reform("grow", time.perf_counter() - t0)
+
+    def _last_reform_latency(self):
+        from ..distributed.elastic import elastic_counters
+        last = elastic_counters().get("reform_latency_s_last", 0.0)
+        return min(1.0, max(0.02, 2.0 * last))
+
+    def _mark_reform_hop(self, rep):
+        """Traced requests crossing a reform carry a "reform" hop on
+        their timeline (like the requeue/replay/restore hops)."""
+        if rep.engine is None:
+            return
+        for req in rep.engine.live_requests():
+            if req.trace is not None:
+                req.trace.instant("reform", mp=rep.mp,
+                                  group=list(rep.group))
 
     def _replay(self, rids, prefer=None):
         """Resubmit lost requests as fresh copies — same request_id, seed,
@@ -740,9 +1134,14 @@ class ServingSupervisor:
         fleet-level pending count."""
         out = {"replicas": len(self._replicas),
                "alive": len(self._up()),
+               "routable": len(self._routable()),
                "pending": self.pending(),
                "params_version": (self._live_params[1]
                                   if self._live_params is not None else 0)}
+        if self._topology is not None:
+            out["configured_mp"] = int(self._configured_mp)
+            out["degraded_groups"] = degraded_count(self._replicas,
+                                                    self._configured_mp)
         for rep in self._replicas:
             eng = rep.engine
             out[f"replica{rep.idx}"] = {
@@ -755,4 +1154,7 @@ class ServingSupervisor:
                 "params_version": (0 if eng is None
                                    else int(eng.params_version)),
             }
+            if self._topology is not None:
+                out[f"replica{rep.idx}"]["mp"] = int(rep.mp)
+                out[f"replica{rep.idx}"]["group"] = list(rep.group)
         return out

@@ -49,6 +49,15 @@ Serving chaos (the self-healing serving ladder):
                           step after detecting the loss keeps seeing the
                           rank as lost until a ``chip_return_at`` entry at
                           a step the run has reached re-admits it).
+  * ``serving_chip_loss_at`` / ``serving_chip_return_at`` — the SERVING
+                          twin of the schedule above, keyed by the serving
+                          supervisor's step counter and walked through
+                          ``lost_serving_chips(step)`` with its OWN sticky
+                          watermark, so serving chaos composes with (and
+                          is countable independently of) training chip
+                          loss in one plan. Ranks are GLOBAL chip indices
+                          into the fleet's device list — losing one chip
+                          marks its whole mp group down.
   * ``surge``             — an ``ArrivalSurge``: a deterministic per-step
                           arrival-count schedule (seeded Poisson base rate
                           with a surge window at a multiplied rate). The
@@ -115,6 +124,17 @@ class ArrivalSurge:
                 f"total_steps={self.total_steps}, seed={self.seed})")
 
 
+# single source of truth for the stat keys: FaultPlan.__init__ and the
+# no-active-plan stats() both copy it, so a new counter can never exist
+# in one and not the other
+_ZERO_STATS = {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
+               "writes_seen": 0, "serving_kills": 0,
+               "snapshot_writes_seen": 0, "snapshot_io_errors": 0,
+               "heartbeats_dropped": 0, "surged_arrivals": 0,
+               "chip_losses": 0, "chip_returns": 0,
+               "serving_chip_losses": 0, "serving_chip_returns": 0}
+
+
 class FaultPlan:
     """Deterministic schedule of injected faults."""
 
@@ -122,7 +142,8 @@ class FaultPlan:
                  preempt_at_step=None, kill_at_decode_step=None,
                  kill_engine_tag=None, io_error_on_snapshots=(),
                  stale_heartbeat_ranks=(), surge=None,
-                 chip_loss_at=None, chip_return_at=None):
+                 chip_loss_at=None, chip_return_at=None,
+                 serving_chip_loss_at=None, serving_chip_return_at=None):
         self.nan_at_steps = frozenset(int(s) for s in nan_at_steps)
         self.io_error_on_writes = frozenset(int(n) for n in io_error_on_writes)
         self.preempt_at_step = (None if preempt_at_step is None
@@ -147,18 +168,19 @@ class FaultPlan:
 
         self.chip_loss_at = _ranks_by_step(chip_loss_at)
         self.chip_return_at = _ranks_by_step(chip_return_at)
-        # high-water mark of steps the run has REACHED: a restore that
-        # rewinds the step counter must keep already-fired losses visible
+        self.serving_chip_loss_at = _ranks_by_step(serving_chip_loss_at)
+        self.serving_chip_return_at = _ranks_by_step(serving_chip_return_at)
+        # high-water marks of steps each run has REACHED: a restore that
+        # rewinds the step counter must keep already-fired losses visible.
+        # Training and serving walk SEPARATE watermarks — their step
+        # counters tick independently.
         self._chip_watermark = -1
+        self._serving_chip_watermark = -1
         # one-shot: a respawned/replayed engine re-walks the same step
         # indices — re-firing the kill would loop the recovery forever
         self._kill_fired = False
         # observability: what actually fired
-        self.stats = {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
-                      "writes_seen": 0, "serving_kills": 0,
-                      "snapshot_writes_seen": 0, "snapshot_io_errors": 0,
-                      "heartbeats_dropped": 0, "surged_arrivals": 0,
-                      "chip_losses": 0, "chip_returns": 0}
+        self.stats = dict(_ZERO_STATS)
 
     def __repr__(self):
         return (f"FaultPlan(nan_at_steps={sorted(self.nan_at_steps)}, "
@@ -170,7 +192,9 @@ class FaultPlan:
                 f"stale_heartbeat_ranks={sorted(self.stale_heartbeat_ranks)}, "
                 f"surge={self.surge!r}, "
                 f"chip_loss_at={dict(sorted((k, sorted(v)) for k, v in self.chip_loss_at.items()))}, "
-                f"chip_return_at={dict(sorted((k, sorted(v)) for k, v in self.chip_return_at.items()))})")
+                f"chip_return_at={dict(sorted((k, sorted(v)) for k, v in self.chip_return_at.items()))}, "
+                f"serving_chip_loss_at={dict(sorted((k, sorted(v)) for k, v in self.serving_chip_loss_at.items()))}, "
+                f"serving_chip_return_at={dict(sorted((k, sorted(v)) for k, v in self.serving_chip_return_at.items()))})")
 
 
 _plan: FaultPlan | None = None
@@ -290,6 +314,29 @@ def surge_arrivals(step):
     return n
 
 
+def _walk_chip_schedule(step, loss_at, return_at, wm_attr, stat_prefix):
+    """Shared sticky-watermark walk of a chip loss/return schedule: apply
+    entries in step order up to the HIGHEST step ever queried, so a
+    restore that rewinds the step counter keeps already-fired losses
+    visible, exactly like a real dead chip."""
+    wm = getattr(_plan, wm_attr)
+    step = int(step)
+    if step > wm:
+        for s in range(wm + 1, step + 1):
+            _plan.stats[f"{stat_prefix}_losses"] += len(loss_at.get(s, ()))
+            _plan.stats[f"{stat_prefix}_returns"] += len(
+                return_at.get(s, ()))
+        setattr(_plan, wm_attr, step)
+        wm = step
+    lost = set()
+    for s in sorted(set(loss_at) | set(return_at)):
+        if s > wm:
+            break
+        lost |= loss_at.get(s, frozenset())
+        lost -= return_at.get(s, frozenset())
+    return frozenset(lost)
+
+
 def lost_ranks(step):
     """Cumulative set of lost (and not yet returned) ranks as of ``step``
     under the active plan's chip-loss schedule — the injected-device-
@@ -301,21 +348,24 @@ def lost_ranks(step):
     Zero-cost inactive (one attribute check); returns a frozenset."""
     if _plan is None or not (_plan.chip_loss_at or _plan.chip_return_at):
         return frozenset()
-    wm = _plan._chip_watermark
-    step = int(step)
-    if step > wm:
-        for s in range(wm + 1, step + 1):
-            _plan.stats["chip_losses"] += len(_plan.chip_loss_at.get(s, ()))
-            _plan.stats["chip_returns"] += len(
-                _plan.chip_return_at.get(s, ()))
-        _plan._chip_watermark = wm = step
-    lost = set()
-    for s in sorted(set(_plan.chip_loss_at) | set(_plan.chip_return_at)):
-        if s > wm:
-            break
-        lost |= _plan.chip_loss_at.get(s, frozenset())
-        lost -= _plan.chip_return_at.get(s, frozenset())
-    return frozenset(lost)
+    return _walk_chip_schedule(step, _plan.chip_loss_at,
+                               _plan.chip_return_at, "_chip_watermark",
+                               "chip")
+
+
+def lost_serving_chips(step):
+    """Serving-scoped twin of ``lost_ranks``: the cumulative lost chip set
+    as of the serving supervisor's step ``step`` under the plan's
+    ``serving_chip_loss_at``/``serving_chip_return_at`` schedule, with its
+    own sticky watermark (the serving and training step counters tick
+    independently). Ranks are global chip indices into the serving
+    fleet's device list. Zero-cost inactive; returns a frozenset."""
+    if _plan is None or not (_plan.serving_chip_loss_at
+                             or _plan.serving_chip_return_at):
+        return frozenset()
+    return _walk_chip_schedule(step, _plan.serving_chip_loss_at,
+                               _plan.serving_chip_return_at,
+                               "_serving_chip_watermark", "serving_chip")
 
 
 def maybe_drop_heartbeat(rank):
@@ -331,9 +381,5 @@ def stats():
     """Stats of the active (or last active) plan; zeros when never active."""
     plan = _plan or _last_plan
     if plan is None:
-        return {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
-                "writes_seen": 0, "serving_kills": 0,
-                "snapshot_writes_seen": 0, "snapshot_io_errors": 0,
-                "heartbeats_dropped": 0, "surged_arrivals": 0,
-                "chip_losses": 0, "chip_returns": 0}
+        return dict(_ZERO_STATS)
     return dict(plan.stats)

@@ -58,6 +58,18 @@ each seeded, injected chip loss, zero wall-clock dependence):
                              accumulator + micro counter continue the
                              window on the dp=4 mesh
 
+Serving-elastic ladder (run_serving_elastic_ladder; chip-loss reform of
+mp groups on mp-portable snapshots):
+
+ 13. serve-chip-kill-reform — 2 mp=2 groups on 4 devices; one chip dies,
+                              the group re-forms over the survivor (mp=1)
+                              from its last snapshot — zero drops,
+                              bitwise, reform-latency p99 over trials
+ 14. serve-degraded-shed-grow-back — the degraded fleet sheds lowest-
+                              class backlog with live retry hints, the
+                              chip returns, the group grows back with
+                              zero drops and ZERO new traces
+
   python tools_fault_smoke.py [--steps N] [--kill-step K] [--seed S]
                               [--skip-serving] [--skip-elastic]
 
@@ -287,7 +299,30 @@ def _serving_fixture():
         return out
 
     _SERVING = (serving, factory, ref, traffic, golden)
+    _SERVING_PC.update(params=params, cfg=cfg)
     return _SERVING
+
+
+_SERVING_PC = {}
+
+
+def _mp_factory(**kw):
+    """Two-arg (idx, mesh) factory over the shared fixture params — the
+    topology-elastic supervisor's deployment shape (a replica = an mp
+    group whose mesh changes across reforms)."""
+    from paddle_tpu import serving
+    _serving_fixture()
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+
+    def factory(i, mesh):
+        return serving.Engine(params=_SERVING_PC["params"],
+                              config=_SERVING_PC["cfg"], mesh=mesh,
+                              comm_backend="gspmd", **kw)
+
+    return factory
 
 
 def _check_bitwise(results, reqs, golden):
@@ -627,6 +662,155 @@ def run_elastic_ladder(deterministic=False, seed=7):
     return out
 
 
+def leg_serve_chip_kill_reform(trials, n_reqs, seed):
+    """One chip of an mp=2 group dies mid-traffic: the supervisor marks
+    the whole group down deterministically, re-forms it over the
+    surviving chip through the MP-PORTABLE snapshot path and completes
+    every request bitwise with zero drops. Recovery latency is the
+    elastic ledger's measured reform wall time."""
+    import jax as _jax
+
+    from paddle_tpu import profiler
+    from paddle_tpu.serving.supervisor import ServingSupervisor
+    from paddle_tpu.utils import fault_injection as fi
+
+    serving, _, _, traffic, golden = _serving_fixture()
+    factory = _mp_factory()
+    dropped, bitwise, degraded_ok, lat = 0, True, True, []
+    for t in range(trials):
+        reqs = traffic(n_reqs, seed + t)
+        gold = golden(reqs)
+        d = tempfile.mkdtemp(prefix="serve_elastic_")
+        try:
+            with fi.inject(fi.FaultPlan(
+                    serving_chip_loss_at={3 + t: (1,)})):
+                sup = ServingSupervisor(factory, num_replicas=2, mp=2,
+                                        devices=_jax.devices()[:4],
+                                        snapshot_dir=d, snapshot_every=2)
+                results = sup.run(reqs)
+                degraded_ok &= sup.telemetry()["replica0"]["mp"] == 1
+                sup.shutdown()
+            lat.append(
+                profiler.elastic_counters()["reform_latency_s_last"])
+            miss, ok = _check_bitwise(results, reqs, gold)
+            dropped += miss
+            bitwise &= ok
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    p99 = float(np.percentile(lat, 99)) if lat else 0.0
+    return {"bitwise": bitwise and degraded_ok, "dropped": dropped,
+            "recovery_p99_s": p99, "trials": trials}
+
+
+def leg_serve_degraded_shed_grow_back(seed, n_reqs=16):
+    """Degraded-capacity operation end to end: a chip loss halves group
+    0, the sustained backlog sheds lowest-class work with live
+    retry_after hints, the chip returns and the group grows back with
+    ZERO new traces (memoized builders); every non-shed request
+    completes bitwise, zero drops."""
+    import jax as _jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.serving.supervisor import ServingSupervisor
+    from paddle_tpu.utils import fault_injection as fi
+
+    serving, _, ref, _, _ = _serving_fixture()
+    _shed_keys = ("FLAGS_serving_shed_high", "FLAGS_serving_shed_low",
+                  "FLAGS_serving_shed_window")
+    _saved = {k: paddle.get_flags()[k] for k in _shed_keys}
+    paddle.set_flags({"FLAGS_serving_shed_high": 0.3,
+                      "FLAGS_serving_shed_low": 0.1,
+                      "FLAGS_serving_shed_window": 2})
+    factory = _mp_factory(max_queue=12, shed=True)
+    rng = np.random.default_rng(seed)
+    reqs = [serving.Request(rng.integers(0, 97, 5 + (i % 3)),
+                            max_new_tokens=6 + (i % 3),
+                            priority="best_effort" if i % 2 else "batch")
+            for i in range(n_reqs)]
+    d = tempfile.mkdtemp(prefix="serve_elastic_")
+    try:
+        # loss only — NO scheduled return: the whole run serves degraded,
+        # so the traces baseline below is captured BEFORE the grow-back
+        # (a return firing inside run() would grow early and make the
+        # zero-retraces comparison vacuously compare post-grow to itself)
+        with fi.inject(fi.FaultPlan(serving_chip_loss_at={2: (1,)})):
+            sup = ServingSupervisor(factory, num_replicas=2, mp=2,
+                                    devices=_jax.devices()[:4],
+                                    snapshot_dir=d, snapshot_every=2)
+            results = sup.run(reqs)
+            degraded = sup.telemetry()["replica0"]["mp"] == 1
+        # plan deactivated = the chip came back: grow in the guard loop
+        traces = profiler.serving_counters()["paged_traces"]
+        guard = 0
+        while sup.telemetry()["replica0"]["mp"] != 2 and guard < 64:
+            sup.step()
+            guard += 1
+        grown = degraded and sup.telemetry()["replica0"]["mp"] == 2
+        no_retrace = \
+            profiler.serving_counters()["paged_traces"] == traces
+        sup.shutdown()
+        miss = [r for r in reqs if r.request_id not in results]
+        shed = [r for r in reqs if r.request_id in results
+                and results[r.request_id].finish_reason == "shed"]
+        done = [r for r in reqs if r.request_id in results
+                and results[r.request_id].finish_reason
+                in ("stop", "length")]
+        bitwise = all(results[r.request_id].tokens
+                      == ref(r.prompt, r.max_new_tokens) for r in done)
+        hints = all(results[r.request_id].retry_after is not None
+                    for r in shed)
+        return {"ok": (bitwise and hints and grown and no_retrace
+                       and not miss and len(shed) > 0),
+                "dropped": len(miss), "shed": len(shed),
+                "completed": len(done), "bitwise": bitwise,
+                "retry_hints": hints, "grew_back": grown,
+                "zero_retraces": no_retrace}
+    finally:
+        paddle.set_flags(_saved)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def run_serving_elastic_ladder(deterministic=False, seed=7):
+    """The topology-elastic SERVING ladder (chip-loss reform of mp groups
+    on mp-portable snapshots). ``deterministic=True`` is the fast tier-1
+    sub-rung: one chip-kill-reform trial + the degraded-shed-grow-back
+    leg at tiny traffic. The full ladder runs several kill trials and
+    reports the reform recovery-latency p99. Every leg is injected chip
+    loss — zero wall-clock dependence; requests_dropped must be 0."""
+    from paddle_tpu import profiler
+
+    profiler.reset_serving_counters()
+    if deterministic:
+        ck = leg_serve_chip_kill_reform(trials=1, n_reqs=4, seed=seed)
+        gb = leg_serve_degraded_shed_grow_back(seed + 40, n_reqs=10)
+        dropped = ck["dropped"] + gb["dropped"]
+        return {"chip_kill_reform": ck, "shed_grow_back": gb,
+                "requests_dropped": dropped,
+                "ok": ck["bitwise"] and gb["ok"] and dropped == 0,
+                "elastic": profiler.elastic_counters()}
+    ck = leg_serve_chip_kill_reform(trials=3, n_reqs=6, seed=seed)
+    print(f"FAULT_SMOKE serve-chip-kill-reform: "
+          f"{'OK' if ck['bitwise'] and not ck['dropped'] else 'FAIL'}  "
+          f"trials={ck['trials']} dropped={ck['dropped']} "
+          f"reform-p99={ck['recovery_p99_s'] * 1e3:.0f}ms "
+          f"bitwise-equal-degraded")
+    gb = leg_serve_degraded_shed_grow_back(seed + 40, n_reqs=16)
+    print(f"FAULT_SMOKE serve-degraded-shed-grow-back: "
+          f"{'OK' if gb['ok'] else 'FAIL'}  shed={gb['shed']} "
+          f"completed={gb['completed']} dropped={gb['dropped']} "
+          f"grew-back={gb['grew_back']} zero-retraces={gb['zero_retraces']}")
+    dropped = ck["dropped"] + gb["dropped"]
+    out = {"chip_kill_reform": ck, "shed_grow_back": gb,
+           "requests_dropped": dropped,
+           "ok": ck["bitwise"] and gb["ok"] and dropped == 0,
+           "elastic": profiler.elastic_counters()}
+    print(f"FAULT_SMOKE serving-elastic-ladder: "
+          f"{'OK' if out['ok'] else 'FAIL'}  "
+          f"requests-dropped={dropped}  {profiler.elastic_summary()}")
+    return out
+
+
 def run_serving_ladder(quick=True, deterministic=False, seed=7):
     """The serving chaos ladder. ``deterministic=True`` is the fast tier-1
     sub-rung: kill-resume + rolling-restart only, tiny traffic, no
@@ -712,6 +896,8 @@ def main():
     if not args.skip_serving:
         out = run_serving_ladder(quick=False, seed=args.seed)
         assert out["requests_dropped"] == 0, out
+        out = run_serving_elastic_ladder(seed=args.seed)
+        assert out["ok"], out
     print("FAULT_SMOKE all: OK")
 
 
