@@ -116,6 +116,35 @@ _FLAGS = {
     # fused"); an explicit Engine(mesh=/mp=/comm_backend=) overrides both
     # flags. 0/1 = single chip.
     "FLAGS_serving_mp": 0,
+    # -- quantized serving (serving/quant.py + ops/pallas_kernels/
+    # quant_gemm.py) -------------------------------------------------------
+    # Weight storage dtype of the serving engine: "bf16" (= today's
+    # full-precision bitwise-exact path, untouched), "int8" or "fp8"
+    # (weight-only quantization: per-output-channel scales computed at
+    # engine build or imported from a PTQ calibration via
+    # Engine(quant=QuantSpec), dequant fused into the GEMM epilogue — on
+    # the mp rungs the int8/fp8 shard feeds fused_gemm_ag directly, no fp
+    # weight copy anywhere). The exactness contract becomes "exact at a
+    # given dtype config": order-invariant, kill-and-resume bitwise, and
+    # mp output bitwise identical to single-chip QUANTIZED output.
+    "FLAGS_serving_weight_dtype": "bf16",
+    # KV-pool storage dtype: "bf16" (full precision) | "int8" | "fp8".
+    # Quantized pools hold ~4x/~4x the pages in the same HBM (fp32
+    # compute) with per-PAGE dequant scales stored beside the page table;
+    # CoW, prefix sharing, chunked prefill and snapshots operate on
+    # quantized pages unchanged. Requires calibration (QuantSpec KV clip
+    # ranges) or accepts the engine's automatic one-forward calibration.
+    "FLAGS_serving_kv_dtype": "bf16",
+    # Route quantized weight GEMMs through the Pallas quant kernel
+    # (dequant in the kernel epilogue, fp32 accumulation). TPU-only with
+    # Mosaic-friendly shapes, single-chip engines only; everywhere else
+    # the same algebra runs as jnp that XLA fuses into the MXU epilogue.
+    # Like FLAGS_serving_paged_kernel, the kernel is numerically
+    # equivalent but NOT bitwise identical to the jnp epilogue (tiled
+    # fp32 accumulation, one rounding under bf16 compute) — disable it
+    # when auditing cross-mp-degree bitwise parity of a quantized config
+    # on TPU (e.g. restoring an mp snapshot onto a single chip).
+    "FLAGS_serving_quant_kernel": True,
     # -- self-healing serving (serving/engine.py + serving/supervisor.py) ---
     # Engine-snapshot cadence: with a CheckpointManager attached
     # (Engine.attach_checkpoint), every N step boundaries the FULL engine

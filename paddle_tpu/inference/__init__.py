@@ -361,7 +361,13 @@ class Predictor:
         Engine kwargs pass through — including the tensor-parallel ones
         (``mp=``, ``mesh=``, ``comm_backend=``): ``serve(cfg, mp=4)``
         shards the rebuilt tree and the paged KV pool over a 4-chip mp
-        mesh at construction."""
+        mesh at construction — and ``quant=`` (a ``serving.QuantSpec``,
+        e.g. from ``serving.quant.calibrate``, or a dtype string):
+        ``serve(cfg, quant=spec)`` deploys the artifact int8/fp8
+        weight-only with a quantized paged KV pool. A spec whose
+        calibrated scale shapes don't match the artifact's params tree
+        is rejected up front with a ``QuantSpecError`` naming the
+        offending leaf — before any device placement happens."""
         params = _gpt_functional_params(self._params, gpt_config)
         from ..serving import Engine
         return Engine(params=params, config=gpt_config, **engine_kwargs)
@@ -402,7 +408,12 @@ def serve(model=None, *, params=None, config=None, **engine_kwargs):
     from single-shot `Predictor.run` to request traffic. An mp-trained
     ``HybridTrainStep`` tree serves directly (``serve(params=step.params,
     config=step.config, mp=4)``): head-major sharded weights are
-    device_put straight to the serving layout, no host round trip."""
+    device_put straight to the serving layout, no host round trip.
+    ``quant=`` accepts a ``serving.QuantSpec`` (PTQ-calibrated via
+    ``serving.quant.calibrate``) or a dtype string for int8/fp8
+    weight-only serving over a quantized paged KV pool; a spec that
+    doesn't match the params tree raises ``QuantSpecError`` naming the
+    leaf, up front."""
     from ..serving import Engine
     return Engine(model, params=params, config=config, **engine_kwargs)
 

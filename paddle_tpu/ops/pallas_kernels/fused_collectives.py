@@ -66,7 +66,7 @@ _SMEM = pl.BlockSpec(memory_space=pltpu.SMEM)
 # distinct Mosaic collective ids per kernel family (barrier semaphores of
 # concurrently-compiled kernels must not alias)
 _CID = {"ag_gemm": 0, "gemm_rs": 1, "ag_accum": 2, "rs_bucket": 3,
-        "ag_bucket": 4, "gemm_ag": 5}
+        "ag_bucket": 4, "gemm_ag": 5, "gemm_ag_q": 6}
 
 
 def interpret_default():
@@ -494,6 +494,52 @@ def _gemm_ag_kernel(nbr_ref, x_ref, w_ref, o_ref, comm_ref, send_sem,
     lax.fori_loop(0, n, step, 0)
 
 
+def _gemm_ag_q_kernel(nbr_ref, x_ref, w_ref, s_ref, o_ref, comm_ref,
+                      send_sem, recv_sem, cap_sem, *, n, out_dtype,
+                      interpret):
+    """Quantized-weight variant of ``_gemm_ag_kernel``: w is the raw
+    int8/fp8 column shard and s its per-output-channel fp32 dequant
+    scale — the convert + scale multiply live in the GEMM epilogue, so
+    the fp weight block never exists (not in HBM, not on the wire).
+    Same algebra as the jnp path ``(x @ wq.astype(dt)) * s`` — the
+    quantized serving rungs' bitwise contract."""
+    idx, right, left = nbr_ref[0], nbr_ref[1], nbr_ref[2]
+    barrier = _barrier(interpret)
+    if barrier:
+        barrier(left, right)
+    comm_ref[0] = ((x_ref[...] @ w_ref[...].astype(out_dtype)) *
+                   s_ref[...].astype(out_dtype)).astype(out_dtype)
+
+    def step(t, _):
+        t = t.astype(jnp.int32)
+        cur = lax.rem(t, jnp.int32(2))
+        nxt = lax.rem(t + jnp.int32(1), jnp.int32(2))
+        src = lax.rem(idx - t + jnp.int32(n), jnp.int32(n))
+        dma = _rdma(comm_ref.at[cur], comm_ref.at[nxt], send_sem.at[cur],
+                    recv_sem.at[nxt], right)
+
+        @pl.when(t < n - 1)
+        def _():
+            if not interpret:
+                @pl.when(t > 0)
+                def _():
+                    pltpu.semaphore_wait(cap_sem, 1)
+            dma.start()
+
+        o_ref[src] = comm_ref[cur]
+
+        @pl.when(t < n - 1)
+        def _():
+            dma.wait()
+            if not interpret:
+                pltpu.semaphore_signal(
+                    cap_sem, inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(0, n, step, 0)
+
+
 # ---------------------------------------------------------------------------
 # kernel-call wrappers (per-device shards, inside full-manual shard_map)
 
@@ -627,15 +673,19 @@ def fused_ag_bucket(meta, row):
     )(_nbr(meta), row)
 
 
-def fused_gemm_ag(meta, x, w):
+def fused_gemm_ag(meta, x, w, scale=None):
     """Column-parallel GEMM + in-kernel ring all-gather of the output:
     x [..., K] replicated rows, w [K, F/n] column shard -> [..., F] with
     feature blocks in ring (= logical) order. Every block is a
     full-contraction GEMM, so the result is BITWISE identical to
     ``x @ w_full`` — the gather moves data, never changes math. The
     serving engine's out/down/lm-head projections ride this kernel under
-    the ``fused`` rung."""
-    _count("gemm_ag")
+    the ``fused`` rung.
+
+    ``scale`` [F/n] (quantized serving): ``w`` is an int8/fp8 shard whose
+    per-output-channel dequant multiply runs in the GEMM epilogue before
+    the block enters the ring — the quantized mp engine's weights never
+    exist at full precision anywhere."""
     n = meta.n
     lead = x.shape[:-1]
     K = x.shape[-1]
@@ -643,15 +693,29 @@ def fused_gemm_ag(meta, x, w):
     R = 1
     for s in lead:
         R *= int(s)
-    out = pl.pallas_call(
-        functools.partial(_gemm_ag_kernel, n=n, out_dtype=x.dtype,
-                          interpret=meta.interpret),
-        out_shape=jax.ShapeDtypeStruct((n, R, F), x.dtype),
-        in_specs=[_SMEM, _VMEM, _VMEM],
-        scratch_shapes=[pltpu.VMEM((2, R, F), x.dtype)] + _sems(),
-        interpret=meta.interpret,
-        **_compiler_params("gemm_ag", meta.interpret),
-    )(_nbr(meta), x.reshape(R, K), w)
+    if scale is None:
+        _count("gemm_ag")
+        out = pl.pallas_call(
+            functools.partial(_gemm_ag_kernel, n=n, out_dtype=x.dtype,
+                              interpret=meta.interpret),
+            out_shape=jax.ShapeDtypeStruct((n, R, F), x.dtype),
+            in_specs=[_SMEM, _VMEM, _VMEM],
+            scratch_shapes=[pltpu.VMEM((2, R, F), x.dtype)] + _sems(),
+            interpret=meta.interpret,
+            **_compiler_params("gemm_ag", meta.interpret),
+        )(_nbr(meta), x.reshape(R, K), w)
+    else:
+        _count("gemm_ag_q")
+        out = pl.pallas_call(
+            functools.partial(_gemm_ag_q_kernel, n=n, out_dtype=x.dtype,
+                              interpret=meta.interpret),
+            out_shape=jax.ShapeDtypeStruct((n, R, F), x.dtype),
+            in_specs=[_SMEM, _VMEM, _VMEM, _VMEM],
+            scratch_shapes=[pltpu.VMEM((2, R, F), x.dtype)] + _sems(),
+            interpret=meta.interpret,
+            **_compiler_params("gemm_ag_q", meta.interpret),
+        )(_nbr(meta), x.reshape(R, K), w,
+          scale.reshape(1, F).astype(jnp.float32))
     # [n, R, F] -> [R, n*F]: block j lands at columns j*F..(j+1)*F (chip
     # order == logical feature order for contiguous column shards)
     return out.transpose(1, 0, 2).reshape(lead + (n * F,))

@@ -77,7 +77,8 @@ class QuantizedLinear(Layer):
 
 # full observer/quanter/config QAT+PTQ framework (ref quantization/*)
 from .observers import (ObserverFactory, BaseObserver, AbsmaxObserver,  # noqa: E402
-                        MovingAverageAbsmaxObserver, PerChannelAbsmaxObserver)
+                        MovingAverageAbsmaxObserver, PerChannelAbsmaxObserver,
+                        PercentileObserver)
 from .quanters import (QuanterFactory, quanter, BaseQuanter,  # noqa: E402
                        FakeQuanterWithAbsMaxObserver,
                        FakeQuanterChannelWiseAbsMax)

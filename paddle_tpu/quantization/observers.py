@@ -99,6 +99,58 @@ class MovingAverageAbsmaxObserver(BaseObserver):
         return max(s, 1e-9) / (2.0 ** (self._quant_bits - 1) - 1)
 
 
+class PercentileObserver(BaseObserver):
+    """Clip range = the given percentile of |x| over everything observed
+    (ref: PTQ percentile/hist observers). Where absmax lets one outlier
+    blow up the scale — and with it the quantization error of every
+    normal value — percentile trades a bounded clip of the outlier tail
+    for a much finer grid. The serving KV calibration
+    (``serving.quant.kv_ranges(observer_factory=...)``) uses this to clip
+    activation outliers out of the per-page scales. Samples are
+    reservoir-downsampled host-side to ``max_samples``."""
+
+    def __init__(self, percentile=99.9, quant_bits=8, max_samples=1 << 20,
+                 layer=None):
+        super().__init__(quant_bits, layer)
+        if not 0 < percentile <= 100:
+            raise ValueError(f"percentile must be in (0, 100], got "
+                             f"{percentile}")
+        self._percentile = float(percentile)
+        self._max_samples = int(max_samples)
+        self._samples = []
+        self._n_seen = 0
+        self._threshold = None
+
+    def observe(self, x):
+        a = np.abs(np.asarray(as_tensor_data(x), np.float32)).ravel()
+        self._n_seen += a.size
+        if a.size > self._max_samples:
+            # deterministic stride downsample: unbiased enough for a
+            # range statistic, reproducible across runs
+            a = a[:: a.size // self._max_samples + 1]
+        self._samples.append(a)
+        total = sum(s.size for s in self._samples)
+        if total > self._max_samples:
+            # cap the TOTAL retained across calls, not just each batch —
+            # a long calibration loop must stay bounded-memory
+            allv = np.concatenate(self._samples)
+            self._samples = [allv[:: allv.size // self._max_samples + 1]]
+        self._threshold = None
+
+    def cal_thresholds(self):
+        if not self._samples:
+            self._threshold = 1e-9
+            return
+        allv = np.concatenate(self._samples)
+        self._threshold = max(float(np.percentile(allv, self._percentile)),
+                              1e-9)
+
+    def scales(self):
+        if self._threshold is None:
+            self.cal_thresholds()
+        return self._threshold / (2.0 ** (self._quant_bits - 1) - 1)
+
+
 class PerChannelAbsmaxObserver(BaseObserver):
     """Per-channel |x| max along `quant_axis` (weights), ref channel-wise
     abs-max observer capability."""

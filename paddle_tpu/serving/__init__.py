@@ -45,6 +45,21 @@ drops and zero new traces. A traced per-slot anomaly guard
 logits went non-finite as ``finish_reason="error"`` without poisoning
 the shared batch, the prefix cache or a snapshot.
 
+Quantized serving (quant.py + ops/pallas_kernels/quant_gemm.py;
+default-OFF behind ``FLAGS_serving_weight_dtype`` /
+``FLAGS_serving_kv_dtype`` = bf16|int8|fp8): weight-only int8/fp8 GEMMs
+with per-output-channel scales dequantized in the GEMM epilogue (Pallas
+quant kernel on TPU; the mp rungs feed the quantized shard straight into
+``fused_gemm_ag``), and a quantized paged KV pool with per-PAGE scales
+stored beside the page table — the same HBM holds ~2-4x the pages/slots.
+Calibrate through the ``paddle_tpu.quantization`` package
+(``quant.calibrate`` -> ``QuantSpec`` -> ``Engine(quant=...)``). The
+exactness contract becomes "exact at a given dtype config": order
+invariance, bitwise kill-and-resume and mp==single-chip bitwise all hold
+per config; bf16/bf16 stays bitwise identical to the unquantized engine,
+and a dtype-mismatched snapshot restore raises the typed
+``QuantDtypeMismatchError`` naming both configs.
+
 SLO traffic management (slo.py; all default-off, host-side policy over
 the machinery above): priority classes with WFQ tenant fairness and
 deadline-driven preemption (``FLAGS_serving_priority_classes``),
@@ -72,4 +87,8 @@ from .supervisor import (  # noqa: F401
 )
 from .metrics import (  # noqa: F401
     serving_counters, reset_serving_counters, serving_summary,
+)
+from . import quant  # noqa: F401
+from .quant import (  # noqa: F401
+    QuantSpec, QuantSpecError, QuantDtypeMismatchError,
 )

@@ -67,6 +67,7 @@ from ..models.generation import (
     _forward_decode_slots, _logical_qkv, _mask_logits,
 )
 from . import metrics
+from . import quant as _squant
 from .paged_attention import paged_forward, paged_kernel_supported
 from .paged_kv import PagedKVPool, pages_for
 from .request import (
@@ -157,7 +158,8 @@ def _make_decode(cfg, top_k, donate):
 
 @lru_cache(maxsize=None)
 def _make_paged_step(cfg, top_k, page_size, use_kernel, donate,
-                     mp_key=None, anomaly=False):
+                     mp_key=None, anomaly=False, quant=None,
+                     qkernel=False):
     """Build the FUSED chunk/decode executable over the paged pool: every
     batch row is a slot processing a T-token window (ids' second dim) at
     its own offset. The engine dispatches it at exactly two steady-state
@@ -182,22 +184,33 @@ def _make_paged_step(cfg, top_k, page_size, use_kernel, donate,
     fetch the host loop already does): the serving anomaly guard. The
     healthy-path math is untouched (one extra reduction output), and
     with the flag off this builder key is byte-identical to the PR 12
-    executable."""
+    executable.
+
+    ``quant`` = (weight_dtype, kv_dtype) (serving/quant.py) keys the
+    quantized variants: quantized weights ride scale leaves inside the
+    params tree (same signature), a quantized KV pool appends the
+    per-page ``ksc``/``vsc`` [L, P] traced scale operands AFTER
+    ``key_data`` (donate indices untouched). quant=None is byte-identical
+    to the PR 13 builder."""
     config = _cfg_view(cfg)
+    kvq = quant is not None and quant[1] != "bf16"
 
     def fn(params, kc, vc, ids, start, valid, emit, table, do_sample,
-           temperature, top_p, key_data):
+           temperature, top_p, key_data, *kv_scales):
         metrics.bump("paged_traces")  # body runs only when traced
+        scales = tuple(kv_scales) if kvq else None
         if mp_key is None:
             logits, kc, vc = paged_forward(params, config, ids, kc, vc,
                                            start, valid, table, page_size,
-                                           use_kernel)
+                                           use_kernel, kv_scales=scales,
+                                           wq_kernel=qkernel)
         else:
             from .mp_forward import mp_paged_forward
             logits, kc, vc = mp_paged_forward(params, config, ids, kc, vc,
                                               start, valid, table,
                                               page_size, use_kernel,
-                                              mp_key[0], mp_key[1])
+                                              mp_key[0], mp_key[1],
+                                              kv_scales=scales)
         keys = jax.random.wrap_key_data(key_data)           # [B] keys
         pair = jax.vmap(jax.random.split)(keys)             # [B, 2] keys
         subs = pair[:, 1]
@@ -253,7 +266,7 @@ class Engine:
                  num_pages=None, prefill_chunk=None, prefix_cache=None,
                  tag=None, trace=None, priority=None, tenant_weights=None,
                  shed=None, params_version=0, mesh=None, mp=None,
-                 comm_backend=None, anomaly=None):
+                 comm_backend=None, anomaly=None, quant=None):
         if model is not None:
             params = _collect_params(model)
             config = model.config
@@ -262,6 +275,21 @@ class Engine:
                              "params= (init_gpt_params layout) + config=")
         self.config = config
         flags = get_flags()
+
+        # -- quantized serving (serving/quant.py): resolve the dtype
+        # config FIRST — it decides the stored weight leaves, the KV
+        # pool's storage dtype and the per-page scale tables. quant=None
+        # + bf16 flags resolves to None and every quantized code path
+        # below is skipped: the engine is byte-identical to the
+        # unquantized one (the flags-off parity contract).
+        self._quant = _squant.resolve(quant, flags)
+        if self._quant is not None:
+            _squant.validate(self._quant, params, config)
+            # fill missing KV clip ranges by the automatic one-forward
+            # calibration over the deterministic token sample — the
+            # flags-only path where no PTQ artifact exists
+            self._quant = _squant.ensure_kv_clips(self._quant, params,
+                                                  config)
 
         # -- tensor-parallel serving (serving/mp_forward.py): resolve the
         # mp mesh FIRST — it decides the param layout (head-major sharded
@@ -288,16 +316,23 @@ class Engine:
         if self.mp > 1:
             # head-major + column-sharded placement; an already-mp-sharded
             # HybridTrainStep tree (config.qkv_head_major) is device_put
-            # straight to the serving shardings — no host round trip
+            # straight to the serving shardings — no host round trip.
+            # A quant spec quantizes BEFORE placement (per-channel
+            # quantization is column-independent, so the shards are
+            # bitwise the single-chip engine's column slices).
             from .mp_forward import shard_serving_params
             self.params = shard_serving_params(params, config, self._mesh,
-                                               self._mp_cfg)
+                                               self._mp_cfg,
+                                               quant_spec=self._quant)
             metrics.set_mp_info(self.mp, self._mp_cfg.backend)
         else:
             # undo head-major qkv storage (sequence-parallel
             # HybridTrainStep) once at construction — single-chip decode
             # splits qkv logically
             params = _logical_qkv(params, config)
+            if self._quant is not None and self._quant.quantizes_weights:
+                params = _squant.quantize_params(params, config,
+                                                 self._quant)
             self.params = jax.tree_util.tree_map(jnp.asarray, params)
         # per-request span tracing (observability/tracing.py): host-side
         # only — recording sites are gated on `req.trace is not None`, so
@@ -319,6 +354,12 @@ class Engine:
                 "tensor-parallel serving shards the PAGED pool (the "
                 "pooled layout is the single-chip parity baseline); use "
                 "kv_layout='paged' with mp > 1")
+        if self._quant is not None and self.kv_layout != "paged":
+            raise ValueError(
+                "quantized serving rides the paged layout (pages are the "
+                "KV quantization block; the pooled layout is the "
+                "full-precision parity baseline); use kv_layout='paged' "
+                "with FLAGS_serving_weight_dtype/kv_dtype != 'bf16'")
         self.num_slots = int(num_slots or flags.get("FLAGS_serving_slots", 8))
         self.max_seq_len = int(max_seq_len or
                                flags.get("FLAGS_serving_max_seq_len", 0) or
@@ -395,6 +436,7 @@ class Engine:
         nh = config.num_heads
         d = config.hidden_size // nh
         compute = jnp.dtype(config.compute_dtype or "float32")
+        self._kv_quant = False
 
         if self.kv_layout == "pooled":
             self._prefill = _make_prefill(cfg, self.top_k,
@@ -421,30 +463,59 @@ class Engine:
             if prefix_cache is None:
                 prefix_cache = bool(
                     flags.get("FLAGS_serving_prefix_cache", True))
+            kv_dtype = (self._quant.kv_dtype if self._quant is not None
+                        else "bf16")
+            pool_kw = {}
+            if kv_dtype != "bf16":
+                pool_kw = dict(kv_dtype=kv_dtype,
+                               num_layers=config.num_layers,
+                               k_clip=self._quant.kv_k_clip,
+                               v_clip=self._quant.kv_v_clip,
+                               qmax=_squant.QMAX[kv_dtype])
             self.pool = PagedKVPool(
                 B, self.max_seq_len, self.page_size,
                 num_pages=int(num_pages or
                               flags.get("FLAGS_serving_num_pages", 0) or 0),
-                prefix_cache=prefix_cache)
+                prefix_cache=prefix_cache, **pool_kw)
+            self._kv_quant = kv_dtype != "bf16"
             use_kernel = bool(flags.get("FLAGS_serving_paged_kernel", True)
                               ) and paged_kernel_supported(
                                   nh // self.mp, d, self.page_size,
                                   why="serving engine")
+            quant_key = None if self._quant is None else self._quant.key()
+            qkernel = (self._quant is not None
+                       and self._quant.quantizes_weights
+                       and self.mp == 1
+                       and bool(flags.get("FLAGS_serving_quant_kernel",
+                                          True))
+                       and jax.default_backend() == "tpu")
             if self.mp > 1:
                 self._paged_step = _make_paged_step(
                     cfg, self.top_k, self.page_size, use_kernel,
                     (1, 2) if donate_ok else (),
                     mp_key=(self._mesh, self._mp_cfg),
-                    anomaly=self._anomaly)
+                    anomaly=self._anomaly, quant=quant_key,
+                    qkernel=qkernel)
             else:
                 self._paged_step = _make_paged_step(
                     cfg, self.top_k, self.page_size, use_kernel,
-                    (1, 2) if donate_ok else (), anomaly=self._anomaly)
+                    (1, 2) if donate_ok else (), anomaly=self._anomaly,
+                    quant=quant_key, qkernel=qkernel)
             self._page_copy = _make_page_copy((0, 1) if donate_ok else ())
             shape = (config.num_layers, self.pool.num_pages, self.page_size,
                      nh, d)
+            if self._kv_quant:
+                compute = _squant.STORE_DTYPES[kv_dtype]
         self._kc = jnp.zeros(shape, compute)
         self._vc = jnp.zeros(shape, compute)
+        if self._quant is not None:
+            metrics.set_quant_info(
+                self._quant.weight_dtype, self._quant.kv_dtype,
+                scale_bytes=_squant.scale_bytes(self.params)
+                + (0 if not self._kv_quant
+                   else int(self.pool.k_scale.nbytes
+                            + self.pool.v_scale.nbytes)),
+                kv_bytes_per_token=self.kv_bytes_per_token())
         if self.mp > 1:
             # the pool's GLOBAL geometry is mp-independent (the page table
             # addresses it identically at every mp); only the HEAD axis is
@@ -803,6 +874,16 @@ class Engine:
                 req.trace.span("mp_comm", t0, t1, bytes=wire,
                                backend=self._mp_cfg.backend, mp=self.mp)
 
+    def _kv_scale_args(self):
+        """Per-page dequant scale operands of a quantized pool: host-
+        authoritative like the page table, uploaded with every dispatch
+        ([L, P] fp32 — tiny). Empty for a full-precision pool, so the
+        unquantized dispatch signature is untouched."""
+        if not self._kv_quant:
+            return ()
+        return (jnp.asarray(self.pool.k_scale),
+                jnp.asarray(self.pool.v_scale))
+
     def _cow(self, b, start, end):
         """Copy-on-write guard: a slot may only WRITE pages it exclusively
         owns — split any shared page in [start, end) to a fresh physical
@@ -865,7 +946,7 @@ class Engine:
             jnp.asarray(valid), jnp.asarray(emit),
             jnp.asarray(self.pool.table), jnp.asarray(self._do_sample),
             jnp.asarray(self._temp), jnp.asarray(self._top_p),
-            jnp.asarray(self._keys))
+            jnp.asarray(self._keys), *self._kv_scale_args())
         if self._anomaly:
             self._kc, self._vc, nxt, keys, ok = out
             ok = np.asarray(ok)
@@ -922,7 +1003,7 @@ class Engine:
             jnp.asarray(self._do_sample[b:b + 1]),
             jnp.asarray(self._temp[b:b + 1]),
             jnp.asarray(self._top_p[b:b + 1]),
-            jnp.asarray(self._keys[b:b + 1]))
+            jnp.asarray(self._keys[b:b + 1]), *self._kv_scale_args())
         if self._anomaly:
             # the verdict is only consulted on the emitting (final) chunk
             # — fetch it there, not per chunk (no extra host sync on the
@@ -1292,14 +1373,27 @@ class Engine:
             raise RuntimeError(
                 "swap_params on a non-idle engine: drain() first (the "
                 "drained requests requeue and recompute single-version)")
+        swap_spec = None
+        if self._quant is not None and self._quant.quantizes_weights:
+            # re-quantize ON DEVICE with FRESH per-channel scales (the
+            # incoming weights' own absmax — a calibration pinned to the
+            # OLD weights would clip channels that grew since); the KV
+            # clip ranges stay the engine's (pool scales are untouched).
+            # Same leaf dtypes/shapes as the served tree -> the shape
+            # gate below passes and the swap stays zero-retrace.
+            from dataclasses import replace as _dc_replace
+            swap_spec = _dc_replace(self._quant, weight_scales=None)
         if self.mp > 1:
             # same prep as construction: head-major + column-sharded
             # placement (an already-sharded tree reshards on device)
             from .mp_forward import shard_serving_params
             new = shard_serving_params(params, self.config, self._mesh,
-                                       self._mp_cfg)
+                                       self._mp_cfg, quant_spec=swap_spec)
         else:
             params = _logical_qkv(params, self.config)
+            if swap_spec is not None:
+                params = _squant.quantize_params(params, self.config,
+                                                 swap_spec)
             new = jax.tree_util.tree_map(jnp.asarray, params)
         old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
         new_leaves, new_def = jax.tree_util.tree_flatten(new)
@@ -1377,7 +1471,14 @@ class Engine:
         meta = {"kv_layout": self.kv_layout, "num_slots": self.num_slots,
                 "max_seq_len": self.max_seq_len, "top_k": self.top_k,
                 "params_version": int(self.params_version),
-                "cfg": _cfg_key(self.config)}
+                "cfg": _cfg_key(self.config),
+                # dtype config: part of the restore contract — quantized
+                # KV bytes do not reinterpret across dtypes, so a
+                # mismatched restore is REFUSED (typed) up front
+                "weight_dtype": (self._quant.weight_dtype
+                                 if self._quant is not None else "bf16"),
+                "kv_dtype": (self._quant.kv_dtype
+                             if self._quant is not None else "bf16")}
         if self.kv_layout == "paged":
             meta.update(page_size=self.page_size,
                         prefill_chunk=self.prefill_chunk,
@@ -1411,10 +1512,17 @@ class Engine:
         unpopped results, and the serving metrics ledger. Safe for
         ``CheckpointManager``/``framework.io`` round trips; pair with
         ``load_state_dict`` for bitwise mid-decode resume."""
+        kc_np = np.asarray(jax.device_get(self._kc))
+        vc_np = np.asarray(jax.device_get(self._vc))
+        if kc_np.dtype not in (np.int8, np.float32, np.float64, np.float16):
+            # fp8/bf16 pools: numpy IO paths don't all speak ml_dtypes —
+            # snapshot the raw bytes; meta's kv dtype restores the view
+            kc_np = kc_np.view(np.uint8)
+            vc_np = vc_np.view(np.uint8)
         state = {
             "meta": self._snapshot_meta(),
-            "kc": np.asarray(jax.device_get(self._kc)),
-            "vc": np.asarray(jax.device_get(self._vc)),
+            "kc": kc_np,
+            "vc": vc_np,
             "pos": self._pos.copy(), "tok": self._tok.copy(),
             "keys": self._keys.copy(), "temp": self._temp.copy(),
             "top_p": self._top_p.copy(),
@@ -1460,15 +1568,31 @@ class Engine:
         timestamp shifts so the snapshot instant maps to ``now - outage``.
         Deadlines therefore keep ticking through the outage on any host;
         a same-process restore shifts by ~0."""
-        meta = state["meta"]
+        meta = dict(state["meta"])
+        # pre-quant snapshots carry no dtype fields: they are bf16/bf16
+        meta.setdefault("weight_dtype", "bf16")
+        meta.setdefault("kv_dtype", "bf16")
         mine = self._snapshot_meta()
+        snap_q = (meta["weight_dtype"], meta["kv_dtype"])
+        mine_q = (mine["weight_dtype"], mine["kv_dtype"])
+        if snap_q != mine_q:
+            # typed refusal BEFORE any state is touched: quantized KV
+            # bytes (and the scale tables) do not reinterpret across
+            # dtype configs — deserializing them would be garbage
+            raise _squant.QuantDtypeMismatchError(snap_q, mine_q)
         if meta != mine:
             raise ValueError(
                 f"engine snapshot meta {meta} does not match this engine "
                 f"{mine}; build the restoring Engine with the same config")
         compute = self._kc.dtype
-        self._kc = jnp.asarray(np.asarray(state["kc"]), compute)
-        self._vc = jnp.asarray(np.asarray(state["vc"]), compute)
+        kc_np = np.asarray(state["kc"])
+        vc_np = np.asarray(state["vc"])
+        if kc_np.dtype == np.uint8 and compute != jnp.uint8:
+            # raw-byte snapshot of an fp8 pool: restore the dtype view
+            kc_np = kc_np.view(compute)
+            vc_np = vc_np.view(compute)
+        self._kc = jnp.asarray(kc_np, compute)
+        self._vc = jnp.asarray(vc_np, compute)
         if self._kv_sharding is not None:
             # snapshots hold the GLOBAL pool (mp-independent geometry, and
             # the gather-only schedule makes its contents bitwise equal at
@@ -1644,10 +1768,28 @@ class Engine:
         return [results[r.request_id] for r in reqs]
 
     # -- introspection -------------------------------------------------------
+    def kv_bytes_per_token(self):
+        """Per-chip KV bytes one token position costs at this engine's
+        dtype config: K + V across all layers for the chip's head shard,
+        plus the amortized per-page scale bytes on a quantized pool — the
+        bytes-per-token-by-dtype gauge of the capacity story (int8 ~4x
+        fewer than fp32, fp8 likewise)."""
+        cfg = self.config
+        nh_l = cfg.num_heads // self.mp
+        d = cfg.hidden_size // cfg.num_heads
+        item = int(self._kc.dtype.itemsize)
+        per_tok = 2 * cfg.num_layers * nh_l * d * item
+        if self._kv_quant:
+            # two fp32 scales per (layer, page), shared by page_size
+            # tokens — rounded UP so the gauge never underreports to 0
+            per_tok += -(-2 * cfg.num_layers * 4 // self.page_size)
+        return per_tok
+
     def kv_shard_bytes(self):
-        """Per-chip bytes of ONE of the two KV pool arrays: the whole pool
-        on a single-chip engine, 1/mp of it (the head shard) under mp —
-        the memory gate of the sharded engine."""
+        """Per-chip bytes of ONE of the two KV pool arrays at the pool's
+        STORAGE dtype (int8/fp8 pools report their quantized footprint):
+        the whole pool on a single-chip engine, 1/mp of it (the head
+        shard) under mp — the memory gate of the sharded engine."""
         if self._kv_sharding is None:
             return int(self._kc.nbytes)
         shape = self._kv_sharding.shard_shape(self._kc.shape)

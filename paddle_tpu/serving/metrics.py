@@ -64,6 +64,14 @@ def _zero():
         # the total shed/expired tallies
         "shed_queue_wait_s": 0.0, "shed_queue_waits": 0,
         "expired_queue_wait_s": 0.0, "expired_queue_waits": 0,
+        # quantized serving (serving/quant.py): scale-table footprint,
+        # per-chip KV bytes one token costs at the engine's dtype config
+        # (the capacity-per-chip gauge), and the max logit drift the gate
+        # harness measured against the fp engine (0.0 until a harness
+        # runs). Dtype LABELS live in _quant_info (counters stay numeric
+        # so the Prometheus family export is untouched).
+        "quant_scale_bytes": 0, "quant_kv_bytes_per_token": 0,
+        "quant_logit_drift_max": 0.0,
         # tensor-parallel serving (serving/mp_forward.py): per-dispatch
         # STATIC collective schedule of the mp rung — wire bytes moved,
         # collectives issued, Pallas fused-kernel dispatches (fused rung
@@ -85,6 +93,8 @@ _C = _zero()
 # mp rung labels (summary display only — counters stay numeric so the
 # Prometheus family export is untouched): set by the last mp engine built
 _mp_info = {}
+# quant dtype labels (summary display): set by the last quantized engine
+_quant_info = {}
 # ring buffers: percentiles track the LAST window of traffic, not the
 # first — a long-running server must surface a late latency regression
 _MAX_SAMPLES = 65536
@@ -107,6 +117,28 @@ def set_mp_info(mp, backend):
     with _lock:
         _mp_info["mp"] = int(mp)
         _mp_info["backend"] = str(backend)
+
+
+def set_quant_info(weight_dtype, kv_dtype, scale_bytes=0,
+                   kv_bytes_per_token=0):
+    """Record the serving dtype config (labels) plus its numeric gauges
+    (scale-table bytes, per-chip KV bytes/token) — set at engine build,
+    visible in ``serving_summary()`` and, numerically, through the
+    registry/Prometheus export."""
+    with _lock:
+        _quant_info["weight_dtype"] = str(weight_dtype)
+        _quant_info["kv_dtype"] = str(kv_dtype)
+        _C["quant_scale_bytes"] = int(scale_bytes)
+        _C["quant_kv_bytes_per_token"] = int(kv_bytes_per_token)
+
+
+def observe_logit_drift(drift):
+    """Max-track the logit drift a gate harness measured (fp engine vs
+    the quantized engine on the same input) — the ``serving_summary()``
+    "quant:" segment surfaces it next to the dtype config."""
+    with _lock:
+        _C["quant_logit_drift_max"] = max(_C["quant_logit_drift_max"],
+                                          float(drift))
 
 
 def add_time(name, dt):
@@ -295,6 +327,16 @@ def serving_summary():
                 f"dropped: {c['dropped']}"
                 + (f"  anomalies-quarantined: {c['anomalies_quarantined']}"
                    if c["anomalies_quarantined"] else ""))
+    quant = ""
+    with _lock:
+        qinfo = dict(_quant_info)
+    if qinfo:
+        drift = (f"  drift-max: {c['quant_logit_drift_max']:.2e}"
+                 if c["quant_logit_drift_max"] else "")
+        quant = (f"  quant: w={qinfo.get('weight_dtype', '?')} "
+                 f"kv={qinfo.get('kv_dtype', '?')}  "
+                 f"scales: {c['quant_scale_bytes']}B  "
+                 f"kv-bytes/tok: {c['quant_kv_bytes_per_token']}{drift}")
     mp = ""
     if c["mp_steps"]:
         with _lock:
@@ -323,4 +365,4 @@ def serving_summary():
             f"queue: {c['queue_depth_mean']:.1f} avg/{c['queue_depth_max']} max  "
             f"executables: {c['prefill_traces']} prefill + "
             f"{c['decode_traces']} decode + {c['paged_traces']} paged"
-            f"{paged}{mp}{waste}{slo}{heal}")
+            f"{paged}{quant}{mp}{waste}{slo}{heal}")

@@ -57,12 +57,16 @@ from .paged_attention import paged_attention_read, paged_kv_scatter
 KV_SPEC = P(None, None, None, "mp", None)   # [L, P, page, nh@mp, d]
 
 
-def serving_param_specs(mp_cfg):
+def serving_param_specs(mp_cfg, quant_weights=False):
     """Per-leaf PartitionSpecs of the serving layout (init_gpt_params
     structure, stacked [L, ...] blocks, HEAD-MAJOR qkv storage so a
     contiguous column shard is whole heads). Every matmul weight shards
     its OUTPUT dim; norms and the biases added after an output gather
-    stay replicated."""
+    stay replicated. With ``quant_weights`` the int8/fp8 leaves carry
+    per-output-channel ``<name>_s`` fp32 scales that shard WITH their
+    channels — a chip's scale shard dequantizes exactly its own weight
+    columns, which is what keeps mp quantized output bitwise identical
+    to single-chip quantized output."""
     mpx = "mp"
     blocks = {
         "ln1_g": P(None, None), "ln1_b": P(None, None),
@@ -72,29 +76,55 @@ def serving_param_specs(mp_cfg):
         "up_w": P(None, None, mpx), "up_b": P(None, mpx),
         "down_w": P(None, None, mpx), "down_b": P(None, None),
     }
-    return {
+    out = {
         "wte": P(None, mpx),            # feature-sharded: local lookup + AG
         "wpe": P(None, None),
         "lnf_g": P(None), "lnf_b": P(None),
         "head_w": P(None, mpx) if mp_cfg.shard_vocab else P(None, None),
         "blocks": blocks,
     }
+    if quant_weights:
+        for name in ("qkv_w", "out_w", "up_w", "down_w"):
+            blocks[name + "_s"] = P(None, mpx)
+        out["head_w_s"] = P(mpx) if mp_cfg.shard_vocab else P(None)
+    return out
 
 
-def shard_serving_params(params, config, mesh, mp_cfg):
+def shard_serving_params(params, config, mesh, mp_cfg, quant_spec=None):
     """Place a GPT param tree onto the serving mp layout. Accepts the
     LOGICAL qkv layout (permuted to head-major here) or params already in
     head-major storage (``config.qkv_head_major`` — what HybridTrainStep
     trains under the explicit mp schedule): those are device_put straight
     to the serving shardings, so an already-mp-sharded trained tree moves
-    chip-to-chip without a host gather + re-shard round trip."""
+    chip-to-chip without a host gather + re-shard round trip.
+
+    ``quant_spec`` (serving/quant.py) quantizes the GEMM weights BEFORE
+    placement: per-output-channel quantization is column-independent, so
+    quantize-then-shard equals shard-then-quantize and the mp engine
+    serves bit-identical int8/fp8 blocks to the single-chip engine's
+    column slices. Pinned calibration scales (recorded on the logical
+    layout) relabel head-major together with the qkv columns."""
+    perm = None
     if not getattr(config, "qkv_head_major", False):
-        from ..distributed.tp_overlap import to_qkv_head_major
+        from ..distributed.tp_overlap import (qkv_head_major_perm,
+                                              to_qkv_head_major)
         params = {**params,
                   "blocks": to_qkv_head_major(params["blocks"],
                                               config.hidden_size,
                                               config.num_heads)}
-    specs = serving_param_specs(mp_cfg)
+        perm = qkv_head_major_perm(config.hidden_size, config.num_heads)
+    quant_weights = quant_spec is not None and quant_spec.quantizes_weights
+    if quant_weights:
+        from . import quant as _sq
+        if perm is None and getattr(config, "qkv_head_major", False):
+            # already-head-major tree: pinned calibration scales (logical
+            # layout) still need the column relabeling
+            from ..distributed.tp_overlap import qkv_head_major_perm
+            perm = qkv_head_major_perm(config.hidden_size,
+                                       config.num_heads)
+        params = _sq.quantize_params(params, config, quant_spec,
+                                     qkv_perm=perm)
+    specs = serving_param_specs(mp_cfg, quant_weights=quant_weights)
     return jax.tree_util.tree_map(
         lambda a, s: jax.device_put(jnp.asarray(a), NamedSharding(mesh, s)),
         params, specs)
@@ -137,17 +167,29 @@ def ag_last(x, axis, n, backend, meta):
     return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
 
 
-def gemm_ag(x, w, axis, n, backend, meta):
+def gemm_ag(x, w, axis, n, backend, meta, scale=None):
     """Column-parallel projection: full-contraction local block
     ``x @ w_shard`` + all-gather of the output blocks. Bitwise equal to
     ``x @ w_full`` on every rung (the fused rung's GEMM epilogue feeds
-    the ring directly — ``fused_collectives.fused_gemm_ag``)."""
+    the ring directly — ``fused_collectives.fused_gemm_ag``).
+
+    ``scale`` (quantized serving): ``w`` is the raw int8/fp8 shard and
+    ``scale`` its per-output-channel fp32 dequant shard — the dequant
+    multiply rides the local GEMM epilogue (inside the Pallas kernel on
+    the fused rung), so the mp engine never materializes an fp weight
+    copy, and the scaled block equals the column slice of the single-chip
+    quantized product bitwise."""
     if n == 1:
+        if scale is not None:
+            return (x @ w.astype(x.dtype)) * scale.astype(x.dtype)
         return x @ w
     if backend == "fused":
         from ..ops.pallas_kernels import fused_collectives as _fc
-        return _fc.fused_gemm_ag(meta, x, w)
-    y = x @ w
+        return _fc.fused_gemm_ag(meta, x, w, scale=scale)
+    if scale is not None:
+        y = (x @ w.astype(x.dtype)) * scale.astype(x.dtype)
+    else:
+        y = x @ w
     if backend == "ring":
         return _ring_ag_last(y, axis, n)
     return lax.all_gather(y, axis, axis=y.ndim - 1, tiled=True)
@@ -157,56 +199,82 @@ def gemm_ag(x, w, axis, n, backend, meta):
 # the per-device block + forward
 
 
+def _local_proj(h, p, name):
+    """Local column-block projection (output stays sharded): fp leaf, or
+    int8/fp8 leaf + per-channel scale shard with the dequant multiply in
+    the epilogue — the scaled block is bitwise the column slice of the
+    single-chip quantized GEMM."""
+    s = p.get(name + "_s")
+    if s is None:
+        return h @ p[name].astype(h.dtype)
+    return (h @ p[name].astype(h.dtype)) * s.astype(h.dtype)
+
+
 def _mp_block(p, h, kc_l, vc_l, table, pos, valid, nh, n, eps, page_size,
-              use_kernel, axis, backend, meta):
+              use_kernel, axis, backend, meta, ksc_l=None, vsc_l=None):
     """One transformer block on PER-CHIP shards: h [B, T, H] replicated,
     weights column-sharded (qkv head-major: the local contiguous shard is
     nh/n whole heads), KV pool holding the local heads only. Every op is
     either replicated elementwise math, a full-contraction GEMM block, a
     per-head attention (head subsets are bitwise-independent), or an
     exact gather — so the block output is bitwise identical to
-    paged_attention._layer_paged on one chip."""
+    paged_attention._layer_paged on one chip, at EVERY dtype config
+    (quantized weights dequantize in the epilogue against their own
+    column-scale shard; the quantized KV pool's per-page scales are
+    replicated and head-independent)."""
     B, T, H = h.shape
     nh_l = nh // n
     d = H // nh
 
     h1 = ln_fp32(h, p["ln1_g"], p["ln1_b"], eps)
-    qkv = h1 @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+    qkv = _local_proj(h1, p, "qkv_w") + p["qkv_b"].astype(h.dtype)
     qkv4 = qkv.reshape(B, T, nh_l, 3, d)        # head-major local columns
     q, k, v = qkv4[..., 0, :], qkv4[..., 1, :], qkv4[..., 2, :]
 
     kc_l, vc_l = paged_kv_scatter(kc_l, vc_l, k, v, table, pos, valid,
-                                  page_size)
+                                  page_size, ksc_l, vsc_l)
     ctx = paged_attention_read(q, kc_l, vc_l, table, pos, page_size,
-                               use_kernel, h.dtype)             # [B,T,nh_l,d]
+                               use_kernel, h.dtype, ksc_l,
+                               vsc_l)                           # [B,T,nh_l,d]
     # gather the context heads (chip order == logical head order), then
     # the out projection keeps the FULL contraction against its column
     # shard — the one arrangement that is bitwise under sharding
     ctx_full = ag_last(ctx.reshape(B, T, nh_l * d), axis, n, backend, meta)
-    attn = gemm_ag(ctx_full, p["out_w"].astype(h.dtype), axis, n, backend,
-                   meta) + p["out_b"].astype(h.dtype)
+    out_s = p.get("out_w_s")
+    attn = gemm_ag(ctx_full,
+                   p["out_w"] if out_s is not None
+                   else p["out_w"].astype(h.dtype),
+                   axis, n, backend, meta, scale=out_s) + \
+        p["out_b"].astype(h.dtype)
     h = h + attn
     h2 = ln_fp32(h, p["ln2_g"], p["ln2_b"], eps)
-    up = h2 @ p["up_w"].astype(h.dtype) + p["up_b"].astype(h.dtype)
+    up = _local_proj(h2, p, "up_w") + p["up_b"].astype(h.dtype)
     up = jax.nn.gelu(up, approximate=True)
     act = ag_last(up, axis, n, backend, meta)                   # [B, T, I]
-    down = gemm_ag(act, p["down_w"].astype(h.dtype), axis, n, backend, meta)
+    down_s = p.get("down_w_s")
+    down = gemm_ag(act,
+                   p["down_w"] if down_s is not None
+                   else p["down_w"].astype(h.dtype),
+                   axis, n, backend, meta, scale=down_s)
     return h + down + p["down_b"].astype(h.dtype), kc_l, vc_l
 
 
 def mp_paged_forward(params, config, ids, kc, vc, start, valid, table,
-                     page_size, use_kernel, mesh, mp_cfg):
+                     page_size, use_kernel, mesh, mp_cfg, kv_scales=None):
     """Fused chunk/decode forward over the mp-sharded engine: same
     signature and semantics as ``paged_attention.paged_forward`` but with
     params/KV sharded over ``mesh``'s 1-D mp axis. Returns replicated
-    logits [B, V] plus the updated head-sharded pools."""
+    logits [B, V] plus the updated head-sharded pools. ``kv_scales`` =
+    (k_scale, v_scale) [L, P] per-page dequant scales of a quantized
+    pool, replicated (a page's scale applies to every head shard)."""
     compute = jnp.dtype(config.compute_dtype or "float32")
     n, axis, backend = mp_cfg.n, mp_cfg.axis, mp_cfg.backend
     meta = mp_cfg.kernel_meta(mesh)
     nh = config.num_heads
     eps = config.layer_norm_epsilon
+    quant_weights = "head_w_s" in params
 
-    def device_fn(params, kc, vc, ids, start, valid, table):
+    def device_fn(params, kc, vc, ids, start, valid, table, *scales):
         B, T = ids.shape
         pos = start[:, None] + jnp.arange(T)[None, :]           # [B, T]
         x = ag_last(params["wte"].astype(compute)[ids], axis, n, backend,
@@ -214,32 +282,49 @@ def mp_paged_forward(params, config, ids, kc, vc, start, valid, table,
             jnp.take(params["wpe"].astype(compute), pos, axis=0)
 
         def layer_fn(h, xs):
-            p_l, kc_l, vc_l = xs
+            if scales:
+                p_l, kc_l, vc_l, ksc_l, vsc_l = xs
+            else:
+                p_l, kc_l, vc_l = xs
+                ksc_l = vsc_l = None
             h, kc_l, vc_l = _mp_block(p_l, h, kc_l, vc_l, table, pos,
                                       valid, nh, n, eps, page_size,
-                                      use_kernel, axis, backend, meta)
+                                      use_kernel, axis, backend, meta,
+                                      ksc_l, vsc_l)
             return h, (kc_l, vc_l)
 
-        x, (kc2, vc2) = jax.lax.scan(layer_fn, x,
-                                     (params["blocks"], kc, vc))
+        xs = ((params["blocks"], kc, vc) if not scales
+              else (params["blocks"], kc, vc) + tuple(scales))
+        x, (kc2, vc2) = jax.lax.scan(layer_fn, x, xs)
         idx = jnp.maximum(valid - 1, 0)
         xlast = jax.vmap(
             lambda xb, i: jax.lax.dynamic_slice_in_dim(xb, i, 1, axis=0))(
                 x, idx)[:, 0]                                   # [B, H]
         xn = _final_ln(params, config, xlast)
+        head_s = params.get("head_w_s")
         if mp_cfg.shard_vocab:
-            logits = gemm_ag(xn, params["head_w"].astype(jnp.float32),
-                             axis, n, backend, meta)
+            logits = gemm_ag(xn,
+                             params["head_w"] if head_s is not None
+                             else params["head_w"].astype(jnp.float32),
+                             axis, n, backend, meta, scale=head_s)
+        elif head_s is not None:
+            logits = (xn @ params["head_w"].astype(jnp.float32)) * \
+                head_s.astype(jnp.float32)
         else:
             logits = xn @ params["head_w"].astype(jnp.float32)
         return logits, kc2, vc2
 
+    in_specs = [serving_param_specs(mp_cfg, quant_weights), KV_SPEC,
+                KV_SPEC, P(None, None), P(None), P(None), P(None, None)]
+    args = [params, kc, vc, ids, start, valid, table]
+    if kv_scales is not None:
+        in_specs += [P(None, None), P(None, None)]
+        args += [kv_scales[0], kv_scales[1]]
     mapped = shard_map_compat(
         device_fn, mesh,
-        in_specs=(serving_param_specs(mp_cfg), KV_SPEC, KV_SPEC,
-                  P(None, None), P(None), P(None), P(None, None)),
+        in_specs=tuple(in_specs),
         out_specs=(P(None, None), KV_SPEC, KV_SPEC))
-    return mapped(params, kc, vc, ids, start, valid, table)
+    return mapped(*args)
 
 
 def replica_mesh(mp, devices=None):

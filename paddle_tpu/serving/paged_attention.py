@@ -24,6 +24,19 @@ processes a ``T``-token window at its own offset (``T=1`` pure decode;
 ``valid`` / ``emit`` as traced operands. Padding lanes and inactive slots
 scatter their K/V to physical page 0 (the trash page) and are never read
 back unmasked.
+
+Quantized serving (serving/quant.py, default-OFF): when the engine's kv
+dtype is int8/fp8 the pool stores quantized values and ``kv_scales`` =
+(k_scale, v_scale) ``[L, P]`` per-PAGE traced operands ride along —
+writes quantize in ``paged_kv_scatter``, reads dequantize here (scores
+are computed against the quantized keys and multiplied by the per-page
+scale AFTER the dot, identically in every read branch, so all branches
+— and every mp shard — stay bitwise consistent with each other at a
+given dtype config). Quantized WEIGHT leaves carry a ``<name>_s``
+per-output-channel scale companion consumed by ``quant_gemm`` (dequant
+in the GEMM epilogue — no fp weight copy). With both dtypes at "bf16"
+none of these operands exist and the math is byte-identical to the
+unquantized engine.
 """
 from __future__ import annotations
 
@@ -36,9 +49,21 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..models.gpt import ln_fp32
-from ..models.generation import _final_logits
+from ..models.generation import _final_ln, _final_logits
+from ..ops.pallas_kernels.quant_gemm import quant_gemm
 
 logger = logging.getLogger("paddle_tpu.paged_attention")
+
+
+def _proj(h, p, name, wq_kernel=False):
+    """One projection GEMM: full-precision ``h @ w`` when the leaf is fp,
+    or the weight-only quantized GEMM (int8/fp8 leaf + per-output-channel
+    ``<name>_s`` scale, dequant fused into the epilogue) when the engine
+    quantized its weights."""
+    s = p.get(name + "_s")
+    if s is None:
+        return h @ p[name].astype(h.dtype)
+    return quant_gemm(h, p[name], s, use_kernel=wq_kernel)
 
 
 def paged_kernel_supported(nh, d, page_size, why=""):
@@ -111,6 +136,97 @@ def _decode_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
+def _decode_kernel_q(table_ref, pos_ref, ksc_ref, vsc_ref, q_ref, k_ref,
+                     v_ref, o_ref, m_ref, l_ref, acc_ref, *, page_size,
+                     scale):
+    """Quantized-KV variant of ``_decode_kernel``: the pool holds int8/
+    fp8 values and the per-PAGE dequant scales arrive as scalar-prefetch
+    operands — the dequant multiply lives INSIDE the online-softmax page
+    sweep (scores scale after the q·k dot, v contributions scale inside
+    the ctx accumulation), so the fp K/V bytes never exist in HBM."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    MP = nj
+    phys = table_ref[b * MP + j]
+    ks = ksc_ref[phys]
+    vs = vsc_ref[phys]
+    q = q_ref[0].astype(jnp.float32)                     # [nh, d]
+    k = k_ref[0].astype(jnp.float32)                     # [ps, nh, d]
+    v = v_ref[0].astype(jnp.float32)
+    s = jnp.einsum("hd,shd->hs", q, k,
+                   preferred_element_type=jnp.float32) * scale * ks
+    key_pos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1)                    # [1, ps]
+    valid = key_pos <= pos_ref[b]
+    s = jnp.where(valid, s, -jnp.inf)
+
+    m_prev = m_ref[:, :1]                                # [nh, 1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.where(m_prev == -jnp.inf, 0.0, jnp.exp(m_prev - m_new))
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)        # [nh, ps]
+    l_ref[:] = jnp.broadcast_to(alpha * l_prev +
+                                jnp.sum(p, axis=-1, keepdims=True),
+                                l_ref.shape)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    pv = jnp.einsum("hs,shd->hd", p, v,
+                    preferred_element_type=jnp.float32) * vs
+    acc_ref[:] = acc_ref[:] * alpha + pv
+
+    @pl.when(j == nj - 1)
+    def _():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_decode_attention_q(q, kc_l, vc_l, table, pos, ksc_l, vsc_l, *,
+                             page_size, interpret=False):
+    """Quantized-pool one-token paged attention: like
+    ``paged_decode_attention`` plus per-page dequant scales ksc_l/vsc_l
+    [P] (fp32) prefetched to SMEM and applied inside the page sweep."""
+    B, nh, d = q.shape
+    MP = table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,      # flat table, pos, k scales, v scales
+        grid=(B, MP),
+        in_specs=[
+            pl.BlockSpec((1, nh, d),
+                         lambda b, j, tab, pos, ks, vs: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, nh, d),
+                         lambda b, j, tab, pos, ks, vs:
+                         (tab[b * MP + j], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, nh, d),
+                         lambda b, j, tab, pos, ks, vs:
+                         (tab[b * MP + j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, d),
+                               lambda b, j, tab, pos, ks, vs: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 128), jnp.float32),      # m (lane-broadcast)
+            pltpu.VMEM((nh, 128), jnp.float32),      # l
+            pltpu.VMEM((nh, d), jnp.float32),        # acc
+        ],
+    )
+    kernel = functools.partial(_decode_kernel_q, page_size=page_size,
+                               scale=1.0 / (d ** 0.5))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nh, d), jnp.float32),
+        interpret=interpret,
+    )(table.reshape(-1).astype(jnp.int32), pos.astype(jnp.int32),
+      ksc_l.astype(jnp.float32), vsc_l.astype(jnp.float32),
+      q.astype(jnp.float32), kc_l, vc_l)
+
+
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
 def paged_decode_attention(q, kc_l, vc_l, table, pos, *, page_size,
                            interpret=False):
@@ -151,34 +267,65 @@ def paged_decode_attention(q, kc_l, vc_l, table, pos, *, page_size,
 # fused step forward (jnp gather path; kernel spliced in for T=1 on TPU)
 
 
-def paged_kv_scatter(kc_l, vc_l, k, v, table, pos, valid, page_size):
+def _quantize_kv(x, sc, dtype):
+    """Quantize one K/V window [B, T, nh', d] with its per-position page
+    scale sc [B, T] into the pool's storage dtype (int8 round+clip; fp8
+    saturating cast). Head-independent, so any head subset (the mp
+    engine's shard) quantizes bitwise-identically to the full write."""
+    scaled = x.astype(jnp.float32) / sc[:, :, None, None]
+    if dtype == jnp.int8:
+        return jnp.clip(jnp.round(scaled), -128, 127).astype(jnp.int8)
+    info = jnp.finfo(dtype)
+    return jnp.clip(scaled, float(info.min), float(info.max)).astype(dtype)
+
+
+def paged_kv_scatter(kc_l, vc_l, k, v, table, pos, valid, page_size,
+                     ksc_l=None, vsc_l=None):
     """Scatter one window's K/V [B, T, nh', d] into the paged pool through
     the slot->page table: logical page -> physical; lanes past valid[b]
     (and whole inactive slots) write to trash page 0. ``nh'`` is whichever
     head count the caller holds — all heads single-chip, the local shard
-    under mp (the table is head-independent)."""
+    under mp (the table is head-independent). With a quantized pool the
+    per-page scales ksc_l/vsc_l [P] quantize the write in place (trash
+    page 0 keeps scale 1.0; its garbage is never read unmasked)."""
     MP = table.shape[1]
     T = pos.shape[1]
     writable = jnp.arange(T)[None, :] < valid[:, None]          # [B, T]
     li = jnp.minimum(pos // page_size, MP - 1)
     phys = jnp.where(writable, jnp.take_along_axis(table, li, axis=1), 0)
     off = pos % page_size
+    if ksc_l is not None:
+        k = _quantize_kv(k, ksc_l[phys], kc_l.dtype)
+        v = _quantize_kv(v, vsc_l[phys], vc_l.dtype)
     kc_l = kc_l.at[phys, off].set(k.astype(kc_l.dtype))
     vc_l = vc_l.at[phys, off].set(v.astype(vc_l.dtype))
     return kc_l, vc_l
 
 
 def paged_attention_read(q, kc_l, vc_l, table, pos, page_size, use_kernel,
-                         out_dtype):
+                         out_dtype, ksc_l=None, vsc_l=None):
     """Paged attention read: q [B, T, nh', d] against the pool's nh' heads
     through the table; returns ctx [B, T, nh', d] in ``out_dtype``. Every
     head's math is independent and mirrors generation._layer_decode_slots
     exactly, so any head SUBSET (the mp engine's per-chip shard) is
-    bitwise identical to the same heads of the full computation."""
+    bitwise identical to the same heads of the full computation.
+
+    Quantized pool (ksc_l/vsc_l [P] per-page scales present): scores are
+    computed against the QUANTIZED keys and multiplied by the key page's
+    scale AFTER the dot — every position of a page shares one scale, so
+    the multiply factors out of the contraction and both read branches
+    below compute bit-identical scores; V dequantizes after its gather.
+    The per-dtype exactness contract (mp == single-chip, order/restore
+    invariance) rides on this branch-consistency."""
     B, T, nh, d = q.shape
     MP = table.shape[1]
 
     if use_kernel and T == 1:
+        if ksc_l is not None:
+            return paged_decode_attention_q(
+                q[:, 0].astype(jnp.float32), kc_l, vc_l, table, pos[:, 0],
+                ksc_l, vsc_l,
+                page_size=page_size)[:, None].astype(out_dtype)
         return paged_decode_attention(
             q[:, 0].astype(jnp.float32), kc_l, vc_l, table, pos[:, 0],
             page_size=page_size)[:, None].astype(out_dtype)     # [B,1,nh,d]
@@ -204,7 +351,15 @@ def paged_attention_read(q, kc_l, vc_l, table, pos, page_size, use_kernel,
         kv_k = kc_l[table].reshape(B, S, nh, d)
         scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
                             kv_k.astype(jnp.float32)) / (d ** 0.5)
-    kv_v = vc_l[table].reshape(B, S, nh, d)
+    if ksc_l is not None:
+        # per-position key scale in virtual order [B, S]: the dequant
+        # multiply lands AFTER the dot in both branches identically
+        k_sc = jnp.repeat(ksc_l[table], page_size, axis=1)      # [B, S]
+        scores = scores * k_sc[:, None, None, :]
+    kv_v = vc_l[table].reshape(B, S, nh, d).astype(jnp.float32)
+    if vsc_l is not None:
+        v_sc = jnp.repeat(vsc_l[table], page_size, axis=1)      # [B, S]
+        kv_v = kv_v * v_sc[:, :, None, None]
     # absolute causal mask; masked keys (incl. trash/unmapped reads)
     # contribute exact zeros, preserving bitwise parity with the
     # contiguous layouts
@@ -212,64 +367,83 @@ def paged_attention_read(q, kc_l, vc_l, table, pos, page_size, use_kernel,
     scores = jnp.where(mask[:, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhts,bshd->bthd", probs,
-                      kv_v.astype(jnp.float32)).astype(out_dtype)
+                      kv_v).astype(out_dtype)
 
 
 def _layer_paged(p, h, kc_l, vc_l, table, pos, valid, nh, eps, page_size,
-                 use_kernel):
+                 use_kernel, ksc_l=None, vsc_l=None, wq_kernel=False):
     """One transformer block over h [B, T, H] where each batch row is a
     serving slot processing the token window at absolute positions
     pos[b, :] (valid[b] of them real). K/V are scattered through the page
     table (padding lanes -> trash page 0); attention reads the gathered
     virtual window with the absolute causal mask. Math mirrors
     generation._layer_decode_slots / _layer_cached exactly, so a slot's
-    stream is bitwise identical to single-request decode."""
+    stream is bitwise identical to single-request decode. Quantized
+    engines route the GEMMs through ``_proj`` (epilogue dequant) and the
+    KV writes/reads through the per-page scales."""
     B, T, H = h.shape
     d = H // nh
 
     h1 = ln_fp32(h, p["ln1_g"], p["ln1_b"], eps)
-    qkv = h1 @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+    qkv = _proj(h1, p, "qkv_w", wq_kernel) + p["qkv_b"].astype(h.dtype)
     q, k, v = jnp.split(qkv.reshape(B, T, 3, nh, d), 3, axis=2)
     q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
 
     kc_l, vc_l = paged_kv_scatter(kc_l, vc_l, k, v, table, pos, valid,
-                                  page_size)
+                                  page_size, ksc_l, vsc_l)
     ctx = paged_attention_read(q, kc_l, vc_l, table, pos, page_size,
-                               use_kernel, h.dtype)
+                               use_kernel, h.dtype, ksc_l, vsc_l)
 
-    attn = ctx.reshape(B, T, H) @ p["out_w"].astype(h.dtype) + \
+    attn = _proj(ctx.reshape(B, T, H), p, "out_w", wq_kernel) + \
         p["out_b"].astype(h.dtype)
     h = h + attn
     h2 = ln_fp32(h, p["ln2_g"], p["ln2_b"], eps)
-    up = h2 @ p["up_w"].astype(h.dtype) + p["up_b"].astype(h.dtype)
+    up = _proj(h2, p, "up_w", wq_kernel) + p["up_b"].astype(h.dtype)
     up = jax.nn.gelu(up, approximate=True)
-    return h + up @ p["down_w"].astype(h.dtype) + p["down_b"].astype(h.dtype), \
-        kc_l, vc_l
+    return h + _proj(up, p, "down_w", wq_kernel) + \
+        p["down_b"].astype(h.dtype), kc_l, vc_l
 
 
 def paged_forward(params, config, ids, kc, vc, start, valid, table,
-                  page_size, use_kernel=False):
+                  page_size, use_kernel=False, kv_scales=None,
+                  wq_kernel=False):
     """Fused chunk/decode forward: ids [B, T] is each slot's token window at
     absolute positions start[b]..start[b]+T-1 (valid[b] real). Returns
     logits at each slot's position valid[b]-1 ([B, V]) plus the updated
-    paged pools [L, P, page_size, nh, d]."""
+    paged pools [L, P, page_size, nh, d]. ``kv_scales`` = (k_scale,
+    v_scale) [L, P] traced per-page dequant scales when the pool is
+    quantized; ``wq_kernel`` routes quantized weight GEMMs through the
+    Pallas quant kernel (TPU)."""
     compute = jnp.dtype(config.compute_dtype or "float32")
     B, T = ids.shape
     pos = start[:, None] + jnp.arange(T)[None, :]               # [B, T]
     x = params["wte"].astype(compute)[ids] + \
         jnp.take(params["wpe"].astype(compute), pos, axis=0)
     nh = config.num_heads
+    ksc, vsc = kv_scales if kv_scales is not None else (None, None)
 
     def layer_fn(h, xs):
-        p_l, kc_l, vc_l = xs
+        if kv_scales is not None:
+            p_l, kc_l, vc_l, ksc_l, vsc_l = xs
+        else:
+            p_l, kc_l, vc_l = xs
+            ksc_l = vsc_l = None
         h, kc_l, vc_l = _layer_paged(p_l, h, kc_l, vc_l, table, pos, valid,
                                      nh, config.layer_norm_epsilon,
-                                     page_size, use_kernel)
+                                     page_size, use_kernel, ksc_l, vsc_l,
+                                     wq_kernel)
         return h, (kc_l, vc_l)
 
-    x, (kc, vc) = jax.lax.scan(layer_fn, x, (params["blocks"], kc, vc))
+    xs = ((params["blocks"], kc, vc) if kv_scales is None
+          else (params["blocks"], kc, vc, ksc, vsc))
+    x, (kc, vc) = jax.lax.scan(layer_fn, x, xs)
     idx = jnp.maximum(valid - 1, 0)
     xlast = jax.vmap(
         lambda xb, i: jax.lax.dynamic_slice_in_dim(xb, i, 1, axis=0))(
             x, idx)[:, 0]                                       # [B, H]
+    if "head_w_s" in params:
+        xn = _final_ln(params, config, xlast)
+        logits = quant_gemm(xn, params["head_w"], params["head_w_s"],
+                            use_kernel=wq_kernel)
+        return logits, kc, vc
     return _final_logits(params, config, xlast), kc, vc

@@ -24,6 +24,17 @@ Sharing is bitwise-safe because the KV of a token depends only on the
 token prefix before it: two requests whose prompts agree on ``m`` tokens
 compute bit-identical K/V for those positions, so reading the cached pages
 is indistinguishable from recomputing them.
+
+Quantized pool (``kv_dtype`` int8/fp8, serving/quant.py): the pool
+additionally owns per-PAGE dequant scales ``k_scale``/``v_scale``
+``[L, P]`` float32, stored host-side beside the page table and uploaded
+as traced operands each step. Pages are the quantization block: a CoW
+split copies the source page's scale entries with its bytes, prefix
+sharing shares a page and its scale, and the trash page keeps scale 1.0
+(its garbage is never read unmasked). The values come from calibrated
+per-layer |K|/|V| clip ranges divided by the dtype's qmax. All the
+sharing arguments above carry over verbatim — two requests with the same
+prefix quantize bit-identical pages (same values, same scales).
 """
 from __future__ import annotations
 
@@ -47,7 +58,8 @@ class PagedKVPool:
     WHICH physical page each (slot, logical page) maps to."""
 
     def __init__(self, num_slots, max_seq_len, page_size, num_pages=0,
-                 prefix_cache=True):
+                 prefix_cache=True, kv_dtype="bf16", num_layers=0,
+                 k_clip=None, v_clip=None, qmax=127.0):
         self.page_size = int(page_size)
         if self.page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
@@ -58,6 +70,23 @@ class PagedKVPool:
         if self.num_pages < 2:
             raise ValueError("need at least 2 pages (one is the trash page)")
         P = self.num_pages
+        # quantized pool: per-PAGE dequant scales beside the table (the
+        # page is the quantization block). Static calibration seeds every
+        # page of a layer with clip/qmax; the trash page keeps 1.0.
+        self.kv_dtype = str(kv_dtype)
+        self.k_scale = self.v_scale = None
+        if self.kv_dtype != "bf16":
+            if not num_layers or k_clip is None or v_clip is None:
+                raise ValueError(
+                    "a quantized pool needs num_layers and per-layer "
+                    "k_clip/v_clip ranges (calibrate via serving.quant)")
+            from .quant import page_scales
+            k_clip = np.broadcast_to(np.asarray(k_clip, np.float64),
+                                     (int(num_layers),))
+            v_clip = np.broadcast_to(np.asarray(v_clip, np.float64),
+                                     (int(num_layers),))
+            self.k_scale = page_scales(k_clip, P, qmax)
+            self.v_scale = page_scales(v_clip, P, qmax)
         # slot -> physical page, logical order; 0 = unmapped/trash
         self.table = np.zeros((self.num_slots, self.slot_pages), np.int32)
         self.ref = np.zeros(P, np.int64)
@@ -171,6 +200,12 @@ class PagedKVPool:
             copies.append((phys, dst))
             self.table[b, li] = dst
             self.decref([phys])
+            if self.k_scale is not None:
+                # the CoW destination inherits the source page's dequant
+                # scales with its bytes (identical under static
+                # calibration; the invariant is maintained regardless)
+                self.k_scale[:, dst] = self.k_scale[:, phys]
+                self.v_scale[:, dst] = self.v_scale[:, phys]
         return copies
 
     # -- prefix cache --------------------------------------------------------
@@ -255,14 +290,15 @@ class PagedKVPool:
                 "num_pages": self.num_pages,
                 "num_slots": self.num_slots,
                 "slot_pages": self.slot_pages,
-                "prefix_cache": self.prefix_cache_enabled}
+                "prefix_cache": self.prefix_cache_enabled,
+                "kv_dtype": self.kv_dtype}
 
     def state_dict(self):
         """Serializable snapshot of the WHOLE allocator: slot->page table,
         refcounts, free list, CoW spares, prefix-cache entries (in LRU
         order) and the leak-audit counters. Paired with the engine's device
         KV arrays this reconstructs the paged pool exactly."""
-        return {
+        state = {
             "meta": self._meta(),
             "table": self.table.copy(),
             "ref": self.ref.copy(),
@@ -272,17 +308,25 @@ class PagedKVPool:
             "allocated": int(self.allocated),
             "freed": int(self.freed),
         }
+        if self.k_scale is not None:
+            state["k_scale"] = self.k_scale.copy()
+            state["v_scale"] = self.v_scale.copy()
+        return state
 
     def load_state_dict(self, state):
         """Restore a ``state_dict()`` snapshot. The pool geometry must
         match — a snapshot indexes PHYSICAL pages, so restoring into a
         differently-sized pool would alias them."""
-        meta = state["meta"]
+        meta = dict(state["meta"])
+        meta.setdefault("kv_dtype", "bf16")   # pre-quant snapshots
         mine = self._meta()
         if meta != mine:
             raise ValueError(
                 f"paged-pool snapshot geometry {meta} does not match this "
                 f"pool {mine}")
+        if self.k_scale is not None:
+            self.k_scale = np.asarray(state["k_scale"], np.float32).copy()
+            self.v_scale = np.asarray(state["v_scale"], np.float32).copy()
         self.table = np.asarray(state["table"], np.int32).copy()
         self.ref = np.asarray(state["ref"], np.int64).copy()
         self._free = [int(p) for p in state["free"]]

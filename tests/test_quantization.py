@@ -56,6 +56,68 @@ def test_per_channel_observer():
     assert obs.quant_axis() == 1
 
 
+def test_percentile_observer_clips_outliers():
+    """PercentileObserver's clip range sits at the percentile of |x|:
+    outliers fall OUTSIDE the range (finer grid for the bulk), while
+    absmax is dragged to the outlier."""
+    from paddle_tpu.quantization import PercentileObserver
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(10000).astype(np.float32)
+    x[0] = 1000.0                              # one wild outlier
+    obs = PercentileObserver(percentile=99.0)
+    obs(paddle.to_tensor(x))
+    obs.cal_thresholds()
+    clip = obs.scales() * 127.0
+    ref = np.percentile(np.abs(x), 99.0)
+    np.testing.assert_allclose(clip, ref, rtol=1e-5)
+    assert clip < 10.0                         # outlier clipped away
+    amax = AbsmaxObserver()
+    amax(paddle.to_tensor(x))
+    assert amax.scales() * 127.0 > 900.0       # absmax dragged to it
+    # percentile=100 degenerates to absmax
+    p100 = PercentileObserver(percentile=100.0)
+    p100(paddle.to_tensor(x))
+    np.testing.assert_allclose(p100.scales() * 127.0, np.abs(x).max(),
+                               rtol=1e-5)
+    with pytest.raises(ValueError):
+        PercentileObserver(percentile=0.0)
+
+
+def test_percentile_observer_accumulates_batches():
+    from paddle_tpu.quantization import PercentileObserver
+    obs = PercentileObserver(percentile=50.0)
+    obs(paddle.to_tensor(np.full(100, 2.0, np.float32)))
+    obs(paddle.to_tensor(np.full(100, 4.0, np.float32)))
+    obs.cal_thresholds()
+    # the median over BOTH batches sits between the two plateaus
+    assert 2.0 <= obs.scales() * 127.0 <= 4.0
+
+
+def test_percentile_observer_bounded_memory():
+    """The retained sample count stays capped across MANY observe calls
+    (a long calibration loop must not grow host memory linearly)."""
+    from paddle_tpu.quantization import PercentileObserver
+    obs = PercentileObserver(percentile=99.0, max_samples=1000)
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        obs(paddle.to_tensor(rng.standard_normal(5000).astype(np.float32)))
+    assert sum(s.size for s in obs._samples) <= 1000
+    assert obs._n_seen == 250000
+    obs.cal_thresholds()
+    # the downsampled percentile still tracks the true one
+    assert 1.5 <= obs.scales() * 127.0 <= 3.5
+
+
+def test_absmax_observer_range_over_batches():
+    """The absmax range is the running max over EVERYTHING observed —
+    later smaller batches never shrink it."""
+    obs = AbsmaxObserver()
+    obs(paddle.to_tensor(np.array([5.0, -1.0], np.float32)))
+    obs(paddle.to_tensor(np.array([0.25], np.float32)))
+    np.testing.assert_allclose(obs.scales(), 5.0 / 127.0, rtol=1e-6)
+    assert obs.zero_points() == 0.0            # symmetric
+
+
 # ---------------------------------------------------------------------------
 # quanters
 
@@ -85,6 +147,50 @@ def test_channelwise_quanter_tracks_weight():
     # quantization error bounded by scale/2 per channel
     err = np.abs(out.numpy() - w.numpy())
     assert (err <= q.scales() / 2 + 1e-7).all()
+
+
+def test_per_channel_vs_per_tensor_roundtrip_error():
+    """Round-trip error bounds: per-channel quantization is bounded by
+    EACH channel's scale/2, per-tensor by the GLOBAL scale/2 — on a
+    weight whose channel magnitudes differ wildly, per-channel error on
+    the small channel beats per-tensor by the magnitude ratio."""
+    from paddle_tpu.quantization import quantize_weight, dequantize_weight
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((64, 2)).astype(np.float32)
+    w[:, 0] *= 100.0                           # loud channel
+    w[:, 1] *= 0.01                            # quiet channel
+    # per-channel (axis=1: per output column)
+    q, scale = quantize_weight(w, axis=1)
+    assert q.dtype == np.int8 and scale.shape == (1, 2)
+    back = np.asarray(dequantize_weight(q, scale))
+    err_pc = np.abs(back - w)
+    assert (err_pc <= np.asarray(scale) / 2 + 1e-9).all()
+    # per-tensor: one scale for everything
+    amax = np.abs(w).max()
+    s_pt = amax / 127.0
+    q_pt = np.clip(np.round(w / s_pt), -128, 127)
+    err_pt = np.abs(q_pt * s_pt - w)
+    assert err_pt.max() <= s_pt / 2 + 1e-9
+    # the quiet channel: per-channel error is ~10^4 smaller
+    quiet_pc = err_pc[:, 1].max()
+    quiet_pt = err_pt[:, 1].max()
+    assert quiet_pc * 100 < quiet_pt
+    # round trip through int8 is idempotent: re-quantizing the
+    # dequantized weight with the same scale returns the same codes
+    q2, scale2 = quantize_weight(back, axis=1)
+    np.testing.assert_allclose(np.asarray(scale2), np.asarray(scale),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(q2), np.asarray(q))
+
+
+def test_fake_quanter_roundtrip_error_bound():
+    """The fake quanter's forward lands on the int8 grid: |fq(x) - x|
+    <= scale/2 everywhere inside the clip range (per-tensor)."""
+    q = FakeQuanterWithAbsMaxObserver(moving_rate=0.9)
+    x = paddle.to_tensor(np.linspace(-3, 3, 257).astype(np.float32))
+    y = q(x)
+    err = np.abs(y.numpy() - x.numpy())
+    assert err.max() <= q.scales() / 2 + 1e-7
 
 
 # ---------------------------------------------------------------------------
