@@ -183,6 +183,31 @@ _FLAGS = {
     # bitwise-stable: the poison never spreads to the shared batch or a
     # snapshot).
     "FLAGS_serving_anomaly_policy": "off",
+    # -- disaggregated serving (serving/kv_transfer.py) ----------------------
+    # Engine role: "both" (default — the classic single-engine loop that
+    # prefills AND decodes), "prefill" (runs only the big-chunk rungs of
+    # the chunked-prefill ladder over all slots and streams finished KV
+    # pages out — never dispatches the [B,1] decode executable), or
+    # "decode" (receives streamed pages between its own decode boundaries
+    # and seats them as if the prompt were an exact prefix-cache hit).
+    # Role is host-side scheduling policy ONLY: the executables are
+    # identical per shape, which is what keeps disaggregated output
+    # bitwise equal to a single-engine run. Paged layout required for
+    # non-"both" roles. Usually set per-replica via
+    # ServingSupervisor(roles=...), not globally.
+    "FLAGS_serving_role": "both",
+    # Max KV pages a decode worker installs from incoming transfers per
+    # step boundary — bounds the host->device copy work that rides
+    # between decode dispatches, so an arriving giant-prompt transfer
+    # never stalls the decoding slots (T3-style overlap discipline).
+    "FLAGS_serving_transfer_pages_per_boundary": 4,
+    # Prefix-affinity routing: the supervisor probes each decode
+    # replica's prefix cache with the request's cumulative page hashes
+    # and routes shared-prefix traffic to the replica that already holds
+    # the pages — a hit admits directly on the decode worker and SKIPS
+    # the prefill worker and the page transfer entirely. Off: disagg
+    # routing is least-loaded-prefill only.
+    "FLAGS_serving_affinity_routing": True,
     # -- SLO-driven multi-tenant serving (serving/slo.py) --------------------
     # Class-aware admission: requests carry priority ("interactive" |
     # "batch" | "best_effort") and a tenant id; admission serves classes

@@ -60,6 +60,23 @@ per config; bf16/bf16 stays bitwise identical to the unquantized engine,
 and a dtype-mismatched snapshot restore raises the typed
 ``QuantDtypeMismatchError`` naming both configs.
 
+Disaggregated prefill/decode serving (kv_transfer.py; opt-in via
+``ServingSupervisor(roles=...)`` / ``FLAGS_serving_role``): dedicated
+PREFILL workers run only the big-chunk rungs of the ladder over all
+their slots (never the [B,1] decode dispatch) and stream each request's
+finished KV pages — at the pool's storage dtype, int8/fp8 wires carry
+per-page scales — to a decode worker, which installs a bounded number of
+pages per decode boundary (``FLAGS_serving_transfer_pages_per_boundary``)
+and seats the request exactly like an exact-prefix-cache hit, so the
+disaggregated token stream stays BITWISE identical to a single engine,
+greedy and sampled, per dtype config. The router is role- and
+cache-aware (``Engine.prefix_page_hashes`` is the stable routing key):
+a prompt whose prefix a decode worker already caches routes straight
+there — no prefill compute, no transfer — and the fleet rebalances
+roles when a chip loss strands decode capacity (pure-decode fallback,
+zero drops; transfers retain payloads until seated so a decode-worker
+death mid-stream re-offers, not recomputes).
+
 SLO traffic management (slo.py; all default-off, host-side policy over
 the machinery above): priority classes with WFQ tenant fairness and
 deadline-driven preemption (``FLAGS_serving_priority_classes``),
@@ -79,6 +96,7 @@ from .slo import (  # noqa: F401
     CLASSES, class_rank, Autoscaler, ShedPolicy, TokenBucket,
 )
 from .paged_kv import PagedKVPool, PagePoolExhausted, pages_for  # noqa: F401
+from .kv_transfer import KVTransfer, PagePayload  # noqa: F401
 from .engine import Engine, EngineStoppedError  # noqa: F401
 from .mp_forward import replica_mesh  # noqa: F401
 from .elastic import FleetTopology, viable_mp  # noqa: F401

@@ -247,11 +247,18 @@ def degraded_count(replicas, configured_mp):
 
 
 def set_group_gauges(replicas, configured_mp):
-    """Refresh the live fleet-shape gauges: per-replica active mp and the
-    degraded-group count (``degraded_count``)."""
+    """Refresh the live fleet-shape gauges: per-replica active mp, the
+    degraded-group count (``degraded_count``) and — for disaggregated
+    fleets — each replica's serving role (0=both, 1=prefill, 2=decode;
+    an operator watching a chip-loss rebalance sees the flip here)."""
+    role_code = {"both": 0, "prefill": 1, "decode": 2}
     for rep in replicas:
         mp = int(getattr(rep, "mp", 0) or 0)
         if rep.state != "up":
             mp = 0
         _egauge(f"active_mp_replica{rep.idx}", mp)
+        role = getattr(rep, "role", "both")
+        if role != "both" or getattr(rep, "configured_role", "both") != "both":
+            _egauge(f"serving_role_replica{rep.idx}",
+                    role_code.get(role, 0))
     _egauge("degraded_groups", degraded_count(replicas, configured_mp))

@@ -68,6 +68,7 @@ from ..models.generation import (
 )
 from . import metrics
 from . import quant as _squant
+from .kv_transfer import KVTransfer, PagePayload
 from .paged_attention import paged_forward, paged_kernel_supported
 from .paged_kv import PagedKVPool, pages_for
 from .request import (
@@ -243,6 +244,34 @@ def _make_page_copy(donate):
     return jax.jit(fn, donate_argnums=donate)
 
 
+@lru_cache(maxsize=None)
+def _make_page_read():
+    """Read one physical page out of the pool (the prefill worker's
+    transfer-out path): src is a traced scalar, one executable for every
+    page hauled to the host at the pool's storage dtype."""
+
+    def fn(kc, vc, src):
+        metrics.bump("read_traces")  # body runs only when traced
+        return kc[:, src], vc[:, src]
+
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=None)
+def _make_page_write(donate):
+    """Write one page payload into the pool (the decode worker's
+    transfer-in path): dst is a traced scalar, so installing any page of
+    any transfer reuses ONE executable."""
+
+    def fn(kc, vc, kpage, vpage, dst):
+        metrics.bump("write_traces")  # body runs only when traced
+        kc = kc.at[:, dst].set(kpage)
+        vc = vc.at[:, dst].set(vpage)
+        return kc, vc
+
+    return jax.jit(fn, donate_argnums=donate)
+
+
 class Engine:
     """Continuous-batching serving engine.
 
@@ -266,7 +295,7 @@ class Engine:
                  num_pages=None, prefill_chunk=None, prefix_cache=None,
                  tag=None, trace=None, priority=None, tenant_weights=None,
                  shed=None, params_version=0, mesh=None, mp=None,
-                 comm_backend=None, anomaly=None, quant=None):
+                 comm_backend=None, anomaly=None, quant=None, role=None):
         if model is not None:
             params = _collect_params(model)
             config = model.config
@@ -543,6 +572,29 @@ class Engine:
         self._admit_count = 0
         self._results = {}                # request_id -> GenerationResult
 
+        # disaggregated serving (serving/kv_transfer.py): role is
+        # host-side SCHEDULING policy over the same executables — a
+        # prefill worker never dispatches the [B,1] decode shape, a
+        # decode worker seats streamed pages as if the prompt were an
+        # exact prefix-cache hit — which is what keeps disaggregated
+        # output bitwise identical to a single-engine run.
+        self.role = "both"
+        self._outbound = {}            # rid -> KVTransfer (prefill side)
+        self._fresh_outbound = []      # transfers not yet taken by the sup
+        self._transfers_in = []        # KVTransfers offered to this decoder
+        self._install_progress = {}    # rid -> pages installed so far
+        self._transfer_budget = int(
+            flags.get("FLAGS_serving_transfer_pages_per_boundary", 4))
+        self._page_read = None
+        self._page_write = None
+        # per-role trace gates (host counters beside the global
+        # paged_traces gate): decode dispatches and chunk rungs actually
+        # used BY THIS ENGINE — the per-role acceptance criteria
+        self._decode_dispatches = 0
+        self._chunk_rungs = set()
+        self.set_role(role if role is not None
+                      else flags.get("FLAGS_serving_role", "both"))
+
         # self-healing state: step counter (snapshot cadence + chaos
         # hooks), attached snapshot manager, drain/stop latch
         self.tag = "engine" if tag is None else str(tag)
@@ -594,6 +646,85 @@ class Engine:
                                     else float(retry_after))
         self._reforming = True
         self._stopped = True
+
+    # -- disaggregated roles -------------------------------------------------
+    def set_role(self, role):
+        """Assign this engine's serving role ("both" | "prefill" |
+        "decode") — host-side policy only, settable while the engine is
+        IDLE (no slots, no queue, no in-flight transfers): a mid-stream
+        flip would strand half-prefilled slots with no decoder. The
+        supervisor flips roles only through a drain (``_set_replica_role``).
+        Non-"both" roles require the paged layout (the handoff is a page
+        copy + a table splice)."""
+        role = str(role)
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', got {role!r}")
+        if role != "both" and self.kv_layout != "paged":
+            raise ValueError(
+                "disaggregated roles ride the paged layout (KV pages are "
+                "the transfer unit); use kv_layout='paged'")
+        if (any(r is not None for r in self._slots)
+                or self.scheduler.qsize() > 0
+                or self._outbound or self._transfers_in):
+            raise RuntimeError(
+                "set_role on a non-idle engine: drain() first")
+        self.role = role
+        if role != "both":
+            donate_ok = jax.default_backend() != "cpu"
+            self._page_read = _make_page_read()
+            self._page_write = _make_page_write((0, 1) if donate_ok else ())
+        return self
+
+    def take_outbound(self):
+        """Pop the transfers opened since the last call (the supervisor
+        polls this on a prefill worker every boundary and routes them)."""
+        out, self._fresh_outbound = self._fresh_outbound, []
+        return out
+
+    def prefill_backlog(self):
+        """Prompt tokens this engine still has to prefill: the remaining
+        chunk tokens of every mid-prefill slot plus every queued prompt.
+        The supervisor folds this into its load probe — queue depth alone
+        makes a replica mid-giant-prefill look idle."""
+        if self.kv_layout != "paged":
+            return sum(r.prompt_len for r in self.scheduler._q
+                       if r.state != FINISHED)
+        backlog = 0
+        for b, req in enumerate(self._slots):
+            if req is not None:
+                backlog += max(0, req.prompt_len - int(self._chunk_off[b]))
+        backlog += sum(r.prompt_len for r in self.scheduler._q
+                       if r.state != FINISHED)
+        return backlog
+
+    def prefix_page_hashes(self, prompt):
+        """Stable routing key for prefix-affinity: ``(page_hashes,
+        exact_key)`` where ``page_hashes[j]`` digests the cumulative
+        full-page prefix ``prompt[:(j+1)*page_size]`` and ``exact_key``
+        digests the whole prompt — the same keys (hashed) the prefix
+        cache indexes by, so the router and tests never reach into cache
+        internals. Paged layout only."""
+        import hashlib
+        if self.kv_layout != "paged":
+            raise ValueError("prefix_page_hashes needs the paged layout")
+        prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        ps = self.page_size
+        hashes = tuple(
+            hashlib.blake2b(prompt[:j * ps].tobytes(),
+                            digest_size=16).hexdigest()
+            for j in range(1, len(prompt) // ps + 1))
+        exact = hashlib.blake2b(prompt.tobytes(),
+                                digest_size=16).hexdigest()
+        return hashes, exact
+
+    def prefix_coverage(self, prompt):
+        """Tokens of ``prompt`` this engine's prefix cache already holds
+        (longest cached prefix, LRU-neutral probe). 0 for pooled engines."""
+        if self.kv_layout != "paged":
+            return 0
+        prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        return self.pool.peek_coverage(prompt)
 
     def submit(self, request):
         """Queue a request (FCFS). Raises QueueFullError past max_queue,
@@ -723,6 +854,18 @@ class Engine:
                     and self._slots[b] is request:
                 self._free_slot(b)
                 self._resolve(request, CANCELLED, count=count)
+            elif request.request_id in self._install_progress:
+                # cancelled MID-TRANSFER on the decode side (RUNNING, no
+                # slot anywhere): abort the stream, return staged pages
+                rid = request.request_id
+                for tr in self._transfers_in:
+                    if tr.request_id == rid:
+                        tr.aborted = True
+                self.pool.release_staged(rid)
+                self._install_progress.pop(rid, None)
+                self._transfers_in = [t for t in self._transfers_in
+                                      if t.request_id != rid]
+                self._resolve(request, CANCELLED, count=count)
             # else: a RUNNING handle this engine does not host (e.g. a
             # stale snapshot copy whose live twin moved to another
             # replica) — freeing request.slot here would evict whatever
@@ -775,6 +918,14 @@ class Engine:
         if self.priority_mode:
             self._preempt_for_deadline(now)
 
+        # 2d) inbound KV transfers (disaggregated serving): install up to
+        #     the per-boundary page budget and seat fully-landed requests
+        #     BEFORE admission, so a handed-off request (older by FCFS —
+        #     it was admitted on the prefill worker already) takes a free
+        #     slot ahead of fresh queue arrivals
+        if self.kv_layout == "paged" and self._transfers_in:
+            self._pump_transfers(now)
+
         #    then admission into free slots at the boundary, FCFS or
         #    class-aware WFQ (page-aware for the paged layout: a candidate
         #    is admitted when PAGES suffice for its whole lifetime, not
@@ -811,7 +962,8 @@ class Engine:
             self.save_snapshot()
 
         return self.scheduler.qsize() > 0 or \
-            any(r is not None for r in self._slots)
+            any(r is not None for r in self._slots) or \
+            bool(self._transfers_in) or bool(self._outbound)
 
     def _iterate_pooled(self, active):
         """One pooled-layout decode iteration: one token for every active
@@ -922,8 +1074,11 @@ class Engine:
             # prefill budget scales with IDLE decode capacity (Sarathi's
             # principle): while the batch ramps up, several prompts chunk
             # per boundary; once half the slots decode, only one chunk
-            # rides along, so the inter-token gap stays one-chunk-bounded
-            budget = max(1, B // 2 - n_dec)
+            # rides along, so the inter-token gap stays one-chunk-bounded.
+            # A dedicated PREFILL worker has no decode streams to protect:
+            # every prefilling slot advances each boundary.
+            budget = (len(prefilling) if self.role == "prefill"
+                      else max(1, B // 2 - n_dec))
             for b in prefilling[:budget]:
                 self._prefill_chunk(b)
 
@@ -940,7 +1095,8 @@ class Engine:
         for b in decoding:
             self._cow(b, int(self._pos[b]), int(self._pos[b]) + 1)
         t0 = time.perf_counter()
-        out = self._paged_step(
+        self._decode_dispatches += 1     # per-role gate: prefill workers
+        out = self._paged_step(          # must never reach this dispatch
             self.params, self._kc, self._vc,
             jnp.asarray(self._tok[:, None]), jnp.asarray(self._pos),
             jnp.asarray(valid), jnp.asarray(emit),
@@ -992,6 +1148,13 @@ class Engine:
         C = max(c for c in self._chunk_ladder if c <= target)
         v = min(C, remaining)
         last = off + v >= plen                # final chunk emits token #1
+        # a PREFILL worker never emits: its final chunk dispatches with
+        # emit=False, so the slot's PRNG key PARKS exactly as it does for
+        # every non-final chunk — the decode worker re-derives the stream
+        # from the request seed and makes the FIRST split itself, which is
+        # what keeps the handoff bitwise-identical to a single engine
+        emit = last and self.role != "prefill"
+        self._chunk_rungs.add(C)              # per-role rung gate
         ids = np.zeros((1, C), np.int32)
         ids[0, :v] = req.prompt[off:off + v]
         self._cow(b, off, off + v)
@@ -999,7 +1162,7 @@ class Engine:
         out = self._paged_step(
             self.params, self._kc, self._vc, jnp.asarray(ids),
             jnp.asarray([off], np.int32), jnp.asarray([v], np.int32),
-            jnp.asarray([last]), jnp.asarray(self.pool.table[b:b + 1]),
+            jnp.asarray([emit]), jnp.asarray(self.pool.table[b:b + 1]),
             jnp.asarray(self._do_sample[b:b + 1]),
             jnp.asarray(self._temp[b:b + 1]),
             jnp.asarray(self._top_p[b:b + 1]),
@@ -1034,10 +1197,206 @@ class Engine:
                 # emitted or published
                 self._quarantine(req, b)
                 return
+            if self.role == "prefill":
+                # the prompt KV is complete: stream the remaining pages,
+                # close the transfer and free the slot for the next
+                # prompt — the assigned decode worker emits token #1
+                self._finish_handoff(b)
+                return
             tok = int(np.asarray(nxt)[0])
             self._emit_token(req, b, tok, first=True)
         else:
             self._chunk_off[b] = off + v
+            if self.role == "prefill":
+                # pages the chunk boundary just passed are FINAL (KV of a
+                # token depends only on its prefix) — stream them now so
+                # the transfer overlaps the rest of the prefill
+                tr = self._outbound.get(req.request_id)
+                if tr is not None:
+                    self._stream_pages(b, tr)
+
+    # -- KV-page streaming (disaggregated prefill/decode) --------------------
+    def _stream_pages(self, b, tr, final=False):
+        """Haul slot b's FINAL pages to the host and append them to the
+        outbound transfer: everything the chunk boundary has passed (a
+        token's KV depends only on its prefix, so a fully-written page
+        never changes again), or all ``total_pages`` when ``final``."""
+        complete = (tr.total_pages if final
+                    else int(self._chunk_off[b]) // self.page_size)
+        while len(tr.pages) < complete:
+            li = len(tr.pages)
+            phys = int(self.pool.table[b, li])
+            kpage, vpage = self._page_read(self._kc, self._vc,
+                                           jnp.int32(phys))
+            ks = vs = None
+            if self._kv_quant:
+                ks = self.pool.k_scale[:, phys].copy()
+                vs = self.pool.v_scale[:, phys].copy()
+            tr.append(PagePayload(li, np.asarray(jax.device_get(kpage)),
+                                  np.asarray(jax.device_get(vpage)),
+                                  ks, vs))
+
+    def _finish_handoff(self, b):
+        """Prefill complete on a PREFILL worker: stream the remaining
+        pages, close the transfer and free the slot — the request stays
+        RUNNING (slot None) while the supervisor routes its pages to a
+        decode worker, which emits token #1."""
+        req = self._slots[b]
+        tr = self._outbound[req.request_id]
+        self._stream_pages(b, tr, final=True)
+        tr.finish()
+        if req.trace is not None:
+            req.trace.instant("handoff", pages=tr.total_pages,
+                              bytes=tr.bytes_total)
+        metrics.bump("prefill_handoffs")
+        # frees pages AND publishes the prompt to this worker's prefix
+        # cache (chunk_off == plen) — the next shared-prefix prompt routed
+        # here streams its covered pages without recompute
+        self._free_slot(b)
+        req.slot = None
+
+    def offer_transfer(self, tr):
+        """Hand an inbound KV transfer to this (decode-capable) engine:
+        pages install between decode boundaries and the request seats in
+        a free slot once all pages landed. Re-offering a transfer already
+        in flight (a supervisor retry) restarts its install cleanly."""
+        if self.kv_layout != "paged":
+            raise ValueError("KV transfers ride the paged layout")
+        if self.role == "prefill":
+            raise ValueError(f"engine {self.tag!r} is a prefill worker; "
+                             f"offer transfers to a decode-capable engine")
+        if tr.page_size != self.page_size \
+                or tr.kv_dtype != self.pool.kv_dtype:
+            raise ValueError(
+                f"transfer geometry (page_size={tr.page_size}, "
+                f"kv_dtype={tr.kv_dtype!r}) does not match this engine "
+                f"(page_size={self.page_size}, "
+                f"kv_dtype={self.pool.kv_dtype!r})")
+        rid = tr.request_id
+        if rid in self._install_progress:
+            self.pool.release_staged(rid)
+            self._transfers_in = [t for t in self._transfers_in
+                                  if t.request_id != rid]
+        if self._page_write is None:
+            donate_ok = jax.default_backend() != "cpu"
+            self._page_write = _make_page_write((0, 1) if donate_ok else ())
+        self._transfers_in.append(tr)
+        self._install_progress[rid] = 0
+        return tr
+
+    def has_transfer(self, rid):
+        """Is a transfer for request ``rid`` currently installing here?"""
+        return rid in self._install_progress
+
+    def _install_page(self, payload, dst):
+        """Write one page payload into physical page ``dst`` (ONE traced
+        executable for every page of every transfer)."""
+        kpage = jnp.asarray(payload.k, self._kc.dtype)
+        vpage = jnp.asarray(payload.v, self._vc.dtype)
+        self._kc, self._vc = self._page_write(self._kc, self._vc,
+                                              kpage, vpage, jnp.int32(dst))
+        if self._kv_quant:
+            self.pool.k_scale[:, dst] = payload.k_scale
+            self.pool.v_scale[:, dst] = payload.v_scale
+        metrics.bump("transfer_installs")
+
+    def _pump_transfers(self, now):
+        """Advance inbound transfers at a decode boundary, T3-style: at
+        most ``FLAGS_serving_transfer_pages_per_boundary`` page installs
+        ride this boundary (the copies hide behind the batch's decode
+        compute — decoding slots never stall on a transfer), then any
+        fully-landed transfer seats its request in a free slot."""
+        budget = self._transfer_budget
+        keep = []
+        for tr in self._transfers_in:
+            rid = tr.request_id
+            req = tr.request
+            if tr.aborted or tr.failed or req.state == FINISHED:
+                # handled elsewhere (cancel / supervisor abort): return
+                # the staged pages, forget the stream
+                self.pool.release_staged(rid)
+                self._install_progress.pop(rid, None)
+                continue
+            if req.expired(now):
+                self.pool.release_staged(rid)
+                self._install_progress.pop(rid, None)
+                tr.aborted = True
+                self._resolve(req, EXPIRED, count="expired")
+                continue
+            installed = self._install_progress[rid]
+            while budget > 0 and installed < len(tr.pages):
+                dst = self.pool.stage(rid, 1)
+                if dst is None:
+                    break                  # page pressure: retry next boundary
+                self._install_page(tr.pages[installed], dst[0])
+                installed += 1
+                budget -= 1
+            self._install_progress[rid] = installed
+            if tr.done and installed == tr.total_pages \
+                    and self._seat_transfer(tr, now):
+                self._install_progress.pop(rid, None)
+                continue
+            keep.append(tr)
+        self._transfers_in = keep
+
+    def _seat_transfer(self, tr, now):
+        """All pages landed: adopt them into a free slot and resume the
+        request EXACTLY as a single engine resumes an exact-prefix-cache
+        hit — ``chunk_off = plen - 1`` re-forwards the last prompt token
+        (into exclusively-owned pages: no CoW) and the fresh per-request
+        threefry key makes its FIRST split on the emitting chunk, so the
+        token stream is bitwise the single-engine stream. Returns True
+        when the transfer is terminal (seated or failed), False to retry
+        at the next boundary (no slot / no tail pages yet)."""
+        req = tr.request
+        rid = tr.request_id
+        b = next((i for i, r in enumerate(self._slots) if r is None), None)
+        if b is None:
+            return False
+        if req.params_version is not None \
+                and req.params_version != self.params_version:
+            # the prompt KV was computed under different weights than this
+            # engine serves — seating it would mix versions mid-stream.
+            # Surface it to the supervisor for a single-version replay.
+            tr.failed = True
+            self.pool.release_staged(rid)
+            return True
+        plen = req.prompt_len
+        extra = pages_for(plen + req.max_new_tokens,
+                          self.page_size) - tr.total_pages
+        tail = []
+        if extra > 0:
+            tail = self.pool.try_alloc(extra)
+            if tail is None:
+                return False               # page pressure: retry later
+        pages = self.pool.adopt_staged(rid)
+        self._trace_queue_span(req, b)
+        self.pool.map_slot(b, pages + tail, None)
+        req.slot = b
+        self._slots[b] = req
+        self._chunk_off[b] = plen - 1      # re-forward the last prompt token
+        self._admit_count += 1
+        self._admit_seq[b] = self._admit_count
+        self._pos[b] = 0
+        self._tok[b] = 0
+        self._keys[b] = np.asarray(
+            jax.random.key_data(jax.random.key(req.seed)))
+        self._do_sample[b] = bool(req.do_sample)
+        self._temp[b] = float(req.temperature)
+        self._top_p[b] = 1.0 if req.top_p is None else float(req.top_p)
+        tr.seated = True
+        metrics.bump("transfers")
+        metrics.bump("transfer_pages", tr.total_pages)
+        metrics.bump("transfer_bytes", tr.bytes_total)
+        metrics.add_time("transfer_time_s", now - tr.t_open)
+        if req.trace is not None:
+            # the transfer span covers open (prefill admission on the
+            # source worker) to seat — TTFT = queue + transfer + the final
+            # chunk's boundary, reconciling on the request's own timeline
+            req.trace.span("transfer", tr.t_open, now,
+                           bytes=tr.bytes_total, pages=tr.total_pages,
+                           dtype=tr.kv_dtype, src=tr.src_tag)
+        return True
 
     def _emit_token(self, req, b, tok, first):
         # a requeued/replayed request keeps its original first_token_t (the
@@ -1146,7 +1505,12 @@ class Engine:
         pool = self.pool
         ps = self.page_size
         plen = req.prompt_len
-        total = pages_for(plen + req.max_new_tokens, ps)
+        # a PREFILL worker computes (and ships) only the PROMPT's pages —
+        # the decode worker reserves the generation tail when it seats the
+        # transfer, so prefill admission never holds decode capacity
+        total = pages_for(
+            plen + (0 if self.role == "prefill" else req.max_new_tokens),
+            ps)
         m, shared, exact = pool.lookup(req.prompt)
         # at least the last prompt token must be (re-)forwarded so the
         # first emitted token has logits — even on an exact-prompt hit
@@ -1215,6 +1579,16 @@ class Engine:
         self._temp[b] = float(req.temperature)
         self._top_p[b] = 1.0 if req.top_p is None else float(req.top_p)
         metrics.bump("admitted")
+        if self.role == "prefill":
+            # open the request's KV stream; pages a cached prefix already
+            # covers (logical 0 .. chunk_start//ps - 1) are final right
+            # now and stream before the first chunk even runs — the
+            # prefix-affinity payoff on the prefill side
+            tr = KVTransfer(req, self.page_size, self.pool.kv_dtype,
+                            self.tag)
+            self._outbound[req.request_id] = tr
+            self._fresh_outbound.append(tr)
+            self._stream_pages(b, tr)
 
     def _admit_pooled(self, req, b):
         """Prefill req's prompt into slot b (prompt padded to its bucket);
@@ -1286,6 +1660,14 @@ class Engine:
 
     def _free_slot(self, b, register=True):
         req = self._slots[b]
+        if self.role == "prefill" and req is not None:
+            # a prefill slot freed before its transfer completed (cancel /
+            # expiry / quarantine / drain) aborts the stream — the normal
+            # resolution path owns the request, the supervisor must not
+            # replay it off a half-dead transfer
+            tr = self._outbound.pop(req.request_id, None)
+            if tr is not None and not tr.done:
+                tr.aborted = True
         if self.kv_layout == "paged" and req is not None and register \
                 and int(self._chunk_off[b]) >= req.prompt_len:
             # publish the prompt's pages for prefix reuse ON RELEASE
@@ -1613,10 +1995,31 @@ class Engine:
         self._step_count = int(state["step_count"])
         if self.kv_layout == "paged":
             self.pool.load_state_dict(state["pool"])
+            # in-flight transfer state is NOT part of a snapshot (the
+            # KVTransfer objects live with the supervisor, which replays
+            # or re-offers them): staged pages restored by the pool have
+            # no owning stream anymore — return them to the free list
+            self.pool.clear_staged()
+        self._transfers_in = []
+        self._install_progress = {}
+        self._outbound = {}
+        self._fresh_outbound = []
         self._slots = [None if s is None else Request.from_state(s)
                        for s in state["slots"]]
         queue = [Request.from_state(s) for s in state["queue"]]
         self.scheduler.restore_queue(queue)
+        if self.role == "prefill":
+            # a restored mid-prefill slot has no outbound stream to append
+            # to (transfers are not snapshotted): reset it to the queue —
+            # re-admission opens a fresh transfer and the replay is
+            # bitwise (same prompt, same pages, no tokens emitted yet)
+            for b, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                self._free_slot(b, register=False)
+                req._requeue()
+                self.scheduler.requeue(req)
+                metrics.bump("requeued")
         outage = max(0.0, time.time() - float(state["snapshot_wall"]))
         shift = (time.perf_counter() - outage) - float(state["snapshot_t"])
         live = [r for r in self._slots if r is not None] + queue
@@ -1644,6 +2047,17 @@ class Engine:
             for d in state["results"]}
         if restore_metrics:
             metrics.import_state(state["metrics"])
+        elif self.kv_layout == "paged" and self.pool.prefix_cache_enabled \
+                and self.pool.cache_entries > 0:
+            # the restored pool carries REAL cache entries whose lookups/
+            # hits were counted before the snapshot: without the matching
+            # counters, the post-restore hit RATE lies (hits against
+            # restored entries over a lookup count that starts at zero).
+            # Seed the prefix counters from the snapshot — only when this
+            # process hasn't counted any prefix traffic of its own yet
+            # (a shared-process sibling engine's ledger is never clobbered)
+            metrics.seed_prefix_counters(
+                state["metrics"].get("counters", {}))
         metrics.bump("snapshot_restores")
         self._stopped = False
         self._reforming = False
@@ -1669,6 +2083,20 @@ class Engine:
             req._requeue()
             metrics.bump("requeued")
             drained.append(req)
+        # transfer hygiene: outbound streams of freed slots were aborted
+        # by _free_slot above; inbound streams return their staged pages —
+        # their requests live on with the SUPERVISOR (payloads retained on
+        # the KVTransfer), which re-offers or replays them elsewhere
+        if self.kv_layout == "paged":
+            for tr in self._transfers_in:
+                self.pool.release_staged(tr.request_id)
+            self._transfers_in = []
+            self._install_progress = {}
+            for tr in self._outbound.values():
+                if not tr.done:
+                    tr.aborted = True
+            self._outbound = {}
+            self._fresh_outbound = []
         drained.extend(self.scheduler.drain_queue())
         drained.sort(key=lambda r: (
             r.submit_t if r.submit_t is not None else float("inf"),

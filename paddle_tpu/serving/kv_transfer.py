@@ -1,0 +1,108 @@
+"""KV-page streaming between disaggregated serving roles.
+
+A PREFILL worker runs only the big-chunk rungs of the chunked-prefill
+ladder: it computes a request's whole prompt KV, never decodes, and
+streams finished pages to the DECODE worker the supervisor assigned.
+This module is the wire format of that handoff:
+
+``PagePayload``
+    one logical page hauled to the host — K/V blocks at the pool's
+    STORAGE dtype (bf16, or int8 / fp8-as-uint8 for quantized pools, so
+    the wire is ~4x cheaper at 8-bit) plus the per-page dequant scale
+    columns when quantized.
+
+``KVTransfer``
+    the per-request stream: the prefill engine appends payloads as
+    chunks complete (pages become final the moment the chunk boundary
+    passes them — KV of a token depends only on its prefix, which is
+    what makes the handoff bitwise-safe), the supervisor routes the
+    object, and the decode engine installs pages into its own allocator
+    between decode boundaries (a bounded number per boundary, T3-style:
+    the copy hides behind compute, decoding slots never stall). Payloads
+    are RETAINED until the request is seated so a decode-worker death
+    mid-transfer can re-offer the same stream to a survivor from the
+    host copies — no recompute unless the PREFILL side died.
+
+State flags (host-side, single supervising thread — no locking):
+
+- ``done``     all ``total_pages`` payloads appended; the prefill side
+               has freed its slot and registered its prefix cache.
+- ``seated``   the decode side adopted the pages into a slot; terminal
+               success.
+- ``aborted``  the request was handled elsewhere (cancelled, expired,
+               shed, drained, quarantined) — the supervisor takes NO
+               replay action, the normal resolution path owns it.
+- ``failed``   the transfer itself is unusable (e.g. the decode worker's
+               params_version moved mid-flight) while the request is
+               still live — the supervisor MUST replay it.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class PagePayload:
+    """One KV page on the wire: host copies of the K and V blocks for
+    every layer (``[L, page_size, nh, d]`` at the pool's storage dtype)
+    and, for quantized pools, the fp32 per-page scale columns ``[L]``."""
+
+    __slots__ = ("index", "k", "v", "k_scale", "v_scale")
+
+    def __init__(self, index, k, v, k_scale=None, v_scale=None):
+        self.index = int(index)          # logical page number within the prompt
+        self.k = np.asarray(k)
+        self.v = np.asarray(v)
+        self.k_scale = None if k_scale is None else np.asarray(k_scale)
+        self.v_scale = None if v_scale is None else np.asarray(v_scale)
+
+    @property
+    def nbytes(self):
+        n = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes
+        if self.v_scale is not None:
+            n += self.v_scale.nbytes
+        return n
+
+
+class KVTransfer:
+    """A request's prompt-KV stream from a prefill worker to a decode
+    worker. Shared in-process object: the prefill engine appends, the
+    decode engine reads, the supervisor routes — all on the supervising
+    thread."""
+
+    def __init__(self, request, page_size, kv_dtype, src_tag):
+        from .paged_kv import pages_for
+        self.request = request
+        self.prompt_len = int(request.prompt_len)
+        self.page_size = int(page_size)
+        self.total_pages = pages_for(self.prompt_len, self.page_size)
+        self.kv_dtype = str(kv_dtype)
+        self.src_tag = str(src_tag)
+        self.pages = []                  # PagePayloads in logical order
+        self.done = False
+        self.seated = False
+        self.aborted = False
+        self.failed = False
+        self.t_open = time.perf_counter()
+
+    @property
+    def request_id(self):
+        return self.request.request_id
+
+    @property
+    def bytes_total(self):
+        return sum(p.nbytes for p in self.pages)
+
+    def append(self, payload):
+        assert not self.done, "append after finish()"
+        assert payload.index == len(self.pages), (
+            f"out-of-order page {payload.index} (expected {len(self.pages)})")
+        self.pages.append(payload)
+
+    def finish(self):
+        assert len(self.pages) == self.total_pages, (
+            f"finish() with {len(self.pages)}/{self.total_pages} pages")
+        self.done = True

@@ -79,6 +79,18 @@ def _zero():
         # profiler.mp_comm_counters() ledger.
         "mp_steps": 0, "mp_collectives": 0, "mp_wire_bytes": 0,
         "mp_fused_dispatches": 0,
+        # disaggregated serving (serving/kv_transfer.py): prefill-worker
+        # handoffs, decode-worker transfer installs/seats, wire bytes at
+        # the pool's storage dtype, and the router's prefix-affinity
+        # decisions. A routed affinity hit means the transfer was SKIPPED
+        # — the decode replica's cache already held the pages.
+        "prefill_handoffs": 0, "transfers": 0, "transfer_pages": 0,
+        "transfer_bytes": 0, "transfer_installs": 0,
+        "transfer_time_s": 0.0,
+        "affinity_hits": 0, "disagg_fallbacks": 0, "role_rebalances": 0,
+        # page read/write executables for the transfer path (memoized like
+        # every other builder — frozen after warmup)
+        "read_traces": 0, "write_traces": 0,
         # tokens / time
         "tokens_out": 0,
         "decode_time_s": 0.0, "prefill_time_s": 0.0,
@@ -267,6 +279,29 @@ def reset_serving_counters():
         # counters between rungs must not blank the summary's mp labels
 
 
+_PREFIX_KEYS = ("prefix_lookups", "prefix_hits", "prefix_tokens_reused")
+
+
+def seed_prefix_counters(snapshot_counters):
+    """Counter-lifecycle unification for prefix-cache stats across
+    ``load_state_dict(restore_metrics=False)``: the restored engine brings
+    its prefix-cache ENTRIES back (they live in the pool snapshot), but
+    under restore_metrics=False the hit/reuse counters describing them
+    stayed at whatever the live ledger holds — on a fresh respawn that is
+    zero, so hit-rate reporting diverged from the recovery ledger (which
+    does record the restore). Seed the prefix family from the snapshot
+    ONLY when the live family is untouched — a warm engine restoring a
+    snapshot (preempt-drain resume on the same process) keeps its own
+    live counts exactly like every other serving counter. Returns True
+    when seeding happened."""
+    with _lock:
+        if any(_C[k] for k in _PREFIX_KEYS):
+            return False
+        for k in _PREFIX_KEYS:
+            _C[k] = snapshot_counters.get(k, 0)
+        return True
+
+
 def export_state():
     """Serializable snapshot of the raw ledger (counters + latency ring
     buffers) for ``Engine.state_dict()`` — a restored engine can carry its
@@ -345,6 +380,17 @@ def serving_summary():
               f"wire: {c['mp_wire_bytes'] / 1e6:.2f}MB over "
               f"{c['mp_collectives']} collectives in {c['mp_steps']} "
               f"dispatches  fused-dispatches: {c['mp_fused_dispatches']}")
+    disagg = ""
+    if any(c[k] for k in ("prefill_handoffs", "transfers", "affinity_hits",
+                          "disagg_fallbacks", "role_rebalances")):
+        disagg = (f"  disagg: {c['prefill_handoffs']} handoffs / "
+                  f"{c['transfers']} transfers "
+                  f"({c['transfer_pages']} pages, "
+                  f"{c['transfer_bytes'] / 1e6:.2f}MB, "
+                  f"{c['transfer_time_s'] * 1e3:.0f}ms)  "
+                  f"affinity-hits: {c['affinity_hits']}  "
+                  f"fallbacks: {c['disagg_fallbacks']}  "
+                  f"role-rebalances: {c['role_rebalances']}")
     slo = ""
     if any(c[k] for k in ("shed", "preempted", "rate_limited", "scale_ups",
                           "scale_downs", "weight_swaps")):
@@ -365,4 +411,4 @@ def serving_summary():
             f"queue: {c['queue_depth_mean']:.1f} avg/{c['queue_depth_max']} max  "
             f"executables: {c['prefill_traces']} prefill + "
             f"{c['decode_traces']} decode + {c['paged_traces']} paged"
-            f"{paged}{quant}{mp}{waste}{slo}{heal}")
+            f"{paged}{quant}{mp}{disagg}{waste}{slo}{heal}")

@@ -97,6 +97,11 @@ class PagedKVPool:
         # LRU: key -> page id (full-page entries, key=(b"P", bytes)) or
         # (tuple(pages), plen) (exact entries, key=(b"E", bytes))
         self._cache = OrderedDict()
+        # staged pages: request_id -> [pages] held for an incoming KV
+        # transfer that has not been seated into a slot yet (disaggregated
+        # decode worker). Ref-held like slot pages; adopt_staged moves
+        # them into map_slot without touching the refcounts.
+        self._staged = {}
         # audit counters (the leak gate sums these)
         self.allocated = 0
         self.freed = 0
@@ -179,6 +184,36 @@ class PagedKVPool:
             self.decref([self._spare[b]])
             self._spare[b] = None
 
+    # -- transfer staging ----------------------------------------------------
+    def stage(self, rid, n=1):
+        """Allocate ``n`` pages for an in-flight KV transfer and park them
+        under ``rid`` until the request is seated. Returns the new pages
+        (appended to any already staged) or None when the pool can't cover
+        them right now — the transfer waits for the next boundary."""
+        got = self.try_alloc(n)
+        if got is None:
+            return None
+        self._staged.setdefault(rid, []).extend(got)
+        return got
+
+    def staged_pages(self, rid):
+        return list(self._staged.get(rid, ()))
+
+    def adopt_staged(self, rid):
+        """Hand the staged pages to the caller for ``map_slot`` — the ref
+        each page carries from ``stage`` becomes the slot-table ref."""
+        return self._staged.pop(rid, [])
+
+    def release_staged(self, rid):
+        """Drop a transfer's staged pages (abort/failure path)."""
+        pages = self._staged.pop(rid, None)
+        if pages:
+            self.decref(pages)
+
+    def clear_staged(self):
+        for rid in list(self._staged):
+            self.release_staged(rid)
+
     def make_writable(self, b, start, end):
         """Ensure slot b exclusively owns every page covering positions
         [start, end): any page with refcount > 1 (shared with another slot
@@ -233,6 +268,24 @@ class PagedKVPool:
             self._cache.move_to_end(key)
             pages.append(page)
         return len(pages) * ps, pages, False
+
+    def peek_coverage(self, prompt):
+        """Longest cached prefix of ``prompt`` in TOKENS, without touching
+        LRU recency or refcounts. The supervisor's affinity router probes
+        every decode replica with this — a probe that bumped recency would
+        let routing traffic keep cold entries pinned hot."""
+        if not self.prefix_cache_enabled:
+            return 0
+        hit = self._cache.get((b"E", prompt.tobytes()))
+        if hit is not None:
+            return hit[1]
+        ps = self.page_size
+        n = 0
+        for j in range(1, len(prompt) // ps + 1):
+            if (b"P", prompt[:j * ps].tobytes()) not in self._cache:
+                break
+            n += 1
+        return n * ps
 
     def register(self, prompt, b, min_free_frac=0.25):
         """Publish slot b's prompt pages into the cache (cumulative
@@ -305,6 +358,7 @@ class PagedKVPool:
             "free": list(self._free),
             "spare": list(self._spare),
             "cache": [(k, v) for k, v in self._cache.items()],
+            "staged": {rid: list(pp) for rid, pp in self._staged.items()},
             "allocated": int(self.allocated),
             "freed": int(self.freed),
         }
@@ -333,6 +387,9 @@ class PagedKVPool:
         self._spare = [None if s is None else int(s) for s in state["spare"]]
         self._cache = OrderedDict(
             (tuple(k), v) for k, v in state["cache"])
+        # pre-disagg snapshots carry no staged pages
+        self._staged = {rid: [int(p) for p in pp]
+                        for rid, pp in state.get("staged", {}).items()}
         self.allocated = int(state["allocated"])
         self.freed = int(state["freed"])
 
@@ -348,6 +405,9 @@ class PagedKVPool:
                     slot_refs[p] += 1
             if self._spare[b] is not None:
                 slot_refs[self._spare[b]] += 1
+        for pages in self._staged.values():
+            for p in pages:
+                slot_refs[p] += 1
         cache_refs = np.zeros(self.num_pages, np.int64)
         for key, val in self._cache.items():
             for p in ([val] if key[0] == b"P" else val[0]):

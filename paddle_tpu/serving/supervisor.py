@@ -45,7 +45,7 @@ from .elastic import (  # noqa: F401  (mp_replica_meshes re-exported here)
     set_group_gauges,
 )
 from .engine import EngineStoppedError
-from .request import CANCELLED, DROPPED, FINISHED, Request
+from .request import CANCELLED, DROPPED, FINISHED, RUNNING, Request
 from .scheduler import QueueFullError, ShedError
 from .slo import Autoscaler, TokenBucket
 
@@ -74,6 +74,13 @@ class _Replica:
         self.state = "down"
         self.restarts = 0
         self.last_error = None
+        # disaggregated serving: the role this replica CURRENTLY runs
+        # ("both" | "prefill" | "decode") and the one it was configured
+        # with — they diverge while a chip loss has the fleet rebalanced
+        # (a prefill worker covering for dead decode capacity flips back
+        # once configured decode capacity is routable again)
+        self.role = "both"
+        self.configured_role = "both"
         # topology-elastic state (None/0 when the supervisor is not in
         # elastic mode): the mesh the CURRENT engine runs on, its mp
         # degree and its global chip ranks
@@ -104,6 +111,15 @@ class _Replica:
         eng = self.engine
         if eng is None:
             return float("inf")
+        # fold the in-flight PREFILL BACKLOG in (normalized to chunk
+        # boundaries — the unit a queued arrival actually waits behind):
+        # queue depth + occupied slots alone make a replica grinding
+        # through a giant mid-prefill prompt look as idle as one decoding
+        # short tails, and the router would pile new prompts onto it
+        backlog = eng.prefill_backlog()
+        if backlog:
+            chunk = getattr(eng, "prefill_chunk", 0) or 1
+            return eng.queue_depth + eng.active_slots + backlog / chunk
         return eng.queue_depth + eng.active_slots
 
 
@@ -148,9 +164,41 @@ class ServingSupervisor:
                  snapshot_every=None, max_restarts=None, heartbeat_dir=None,
                  heartbeat_timeout=None, autoscale=None, tenant_rate=None,
                  tenant_burst=None, mp=None, devices=None,
-                 elastic_grow=None):
+                 elastic_grow=None, roles=None):
         flags = get_flags()
         self.engine_factory = engine_factory
+        # -- disaggregated prefill/decode serving (serving/kv_transfer.py):
+        # ``roles`` assigns each replica a serving role — "prefill"
+        # workers run only the big-chunk rungs over all their slots and
+        # stream finished KV pages to a decode worker; "decode"/"both"
+        # replicas install the pages between decode boundaries and emit
+        # tokens. With roles unset the supervisor is the plain fleet,
+        # byte-identical.
+        self._roles = None
+        self._disagg = False
+        self._affinity_routing = bool(
+            flags.get("FLAGS_serving_affinity_routing", True))
+        self._transfers = {}         # rid -> in-flight KVTransfer
+        self._assign = {}            # rid -> decode replica idx (target)
+        self._transfer_src = {}      # rid -> prefill replica idx (source)
+        if roles is not None:
+            roles = tuple(str(r) for r in roles)
+            if len(roles) != int(num_replicas):
+                raise ValueError(
+                    f"roles has {len(roles)} entries for "
+                    f"{int(num_replicas)} replicas")
+            for r in roles:
+                if r not in ("both", "prefill", "decode"):
+                    raise ValueError(
+                        f"role must be 'both', 'prefill' or 'decode', "
+                        f"got {r!r}")
+            if all(r == "prefill" for r in roles):
+                raise ValueError(
+                    "a disaggregated fleet needs at least one decode-"
+                    "capable replica ('decode' or 'both'): prefill "
+                    "workers never emit tokens")
+            self._roles = roles
+            self._disagg = any(r == "prefill" for r in roles)
         self._factory_arity = None       # lazily inspected (_call_factory)
         self.snapshot_every = snapshot_every
         # -- topology-elastic mode (serving/elastic.py): ``mp`` makes each
@@ -246,6 +294,8 @@ class ServingSupervisor:
         if self._heartbeat_dir is not None:
             hb = Heartbeat(self._heartbeat_dir, rank=i)
         rep = _Replica(i, mgr, hb)
+        if self._roles is not None and i < len(self._roles):
+            rep.configured_role = rep.role = self._roles[i]
         if self._topology is not None:
             if i >= self._topology.num_replicas:
                 raise ValueError(
@@ -278,6 +328,11 @@ class ServingSupervisor:
     def _spawn_engine(self, rep):
         eng = self._call_factory(rep)
         eng.tag = f"replica{rep.idx}"
+        if rep.role != "both":
+            # the replica's CURRENT role (configured, or rebalanced after
+            # a chip loss): applied while the fresh engine is idle — the
+            # only window set_role allows
+            eng.set_role(rep.role)
         if self._live_params is not None:
             # the fleet was hot-upgraded: every spawn — crash respawn,
             # rolling restart, autoscale grow — serves the LIVE weights.
@@ -335,6 +390,65 @@ class ServingSupervisor:
         if not ups:
             return None
         return min(ups, key=lambda r: (r.load, r.idx))
+
+    def _pick_decode(self, exclude=None):
+        """Least-loaded routable DECODE-CAPABLE replica (transfer targets
+        and in-transfer re-offers must never land on a prefill worker)."""
+        ups = [r for r in self._routable()
+               if r.role != "prefill" and r is not exclude]
+        if not ups:
+            return None
+        return min(ups, key=lambda r: (r.load, r.idx))
+
+    def _route_disagg(self, request, ups):
+        """Role- and cache-aware candidate order for a disaggregated
+        fleet. Returns ``(candidates, affinity_rep)``:
+
+        1. PREFIX AFFINITY — when a decode-capable replica's prefix cache
+           already covers the prompt to within one page (the engine's
+           exact-hit re-forward handles the tail), route STRAIGHT to it:
+           the prefill compute AND the transfer are skipped entirely.
+           Best coverage wins; load breaks ties.
+        2. Sub-page prompts (nothing cached anywhere) also go straight to
+           a decode worker — a one-page handoff costs more than the one
+           chunk it saves — but are NOT counted as affinity hits.
+        3. Otherwise prefill workers by load (the handoff pipeline), then
+           decode-capable replicas as spill.
+        4. No routable prefill worker at all -> pure-decode fallback (the
+           decode-capable replicas chunk-prefill locally, exactly like a
+           plain fleet) — counted, it signals degraded disaggregation."""
+        prefills = [r for r in ups if r.role == "prefill"]
+        decodes = [r for r in ups if r.role != "prefill"]
+        affinity = None
+        short = False
+        if self._affinity_routing and decodes:
+            plen = request.prompt_len
+            best = None
+            for r in decodes:
+                eng = r.engine
+                if eng is None or eng.kv_layout != "paged":
+                    continue
+                cov = eng.prefix_coverage(request.prompt)
+                if cov > 0 and plen - cov <= eng.page_size:
+                    key = (-cov, r.load, r.idx)
+                    if best is None or key < best[0]:
+                        best = (key, r)
+                elif plen <= eng.page_size:
+                    short = True
+            if best is not None:
+                affinity = best[1]
+        if affinity is not None:
+            order = [affinity] + [r for r in prefills + decodes
+                                  if r is not affinity]
+        elif short:
+            order = sorted(decodes, key=lambda r: (r.load, r.idx)) + prefills
+        elif prefills:
+            order = prefills + decodes
+        else:
+            if decodes:
+                metrics.bump("disagg_fallbacks")
+            order = decodes
+        return order, affinity
 
     def _rate_limit(self, request):
         """Per-tenant token bucket at the router: over-rate submissions
@@ -430,6 +544,9 @@ class ServingSupervisor:
             time.sleep(min(hint, 0.25)
                        * (0.5 + (request.request_id % 8) / 16.0))
         self._rate_limit(request)
+        affinity = None
+        if self._disagg:
+            ups, affinity = self._route_disagg(request, ups)
         shedding = []
         stopped_midway = 0
         for rep in ups:
@@ -526,6 +643,12 @@ class ServingSupervisor:
                 f"all {len(ups) - stopped_midway} routable replica queues "
                 f"full ({qsize}/{cap} waiting fleet-wide{reform_note}); "
                 f"retry later", qsize=qsize, max_queue=cap)
+        if affinity is not None and rep is affinity:
+            # the shared-prefix prompt landed on the replica that already
+            # holds its pages: no prefill-worker compute, no KV transfer
+            metrics.bump("affinity_hits")
+            if request.trace is not None:
+                request.trace.instant("affinity_route", replica=rep.idx)
         return request
 
     def _acked(self, rid):
@@ -543,6 +666,24 @@ class ServingSupervisor:
         with self._lock:
             live = self._requests.get(rid, request)
             owner = self._owner.get(rid)
+            tr = self._transfers.get(rid) if self._disagg else None
+        if tr is not None and live.state == RUNNING and live.slot is None:
+            # cancelled MID-TRANSFER: the request occupies no slot on any
+            # engine (the prefill worker freed or never finished its slot,
+            # the decode worker has not seated it) — abort the stream and
+            # route the cancel to the install side so staged pages return
+            tr.aborted = True
+            tgt = self._assign.get(rid)
+            teng = (self._replicas[tgt].engine
+                    if tgt is not None else None)
+            if teng is not None and teng.has_transfer(rid):
+                teng.cancel(live)
+                return
+            live._finish(CANCELLED)
+            metrics.bump("cancelled")
+            with self._lock:
+                self._results[rid] = live.result()
+            return
         rep = self._replicas[owner] if owner is not None else None
         # single read of .engine: a group reform (or crash failover) on
         # the supervising thread nulls it between a state check and the
@@ -593,9 +734,148 @@ class ServingSupervisor:
                     metrics.bump("stale_failovers")
                     self._on_failure(rep, RuntimeError(
                         f"stale heartbeat (replica {rank})"))
+        if self._disagg:
+            self._pump_transfers()
+            self._rebalance_roles()
         if self.autoscaler is not None:
             self._autoscale_step()
         return self.pending() > 0
+
+    # -- disaggregated prefill/decode: transfer routing, role balance --------
+    def _pump_transfers(self):
+        """One transfer-routing round: collect streams the prefill
+        workers opened since last round, assign each to the least-loaded
+        decode-capable replica, and reconcile every in-flight stream —
+        seated streams flip ownership and drop, aborted ones drop,
+        failed ones replay, orphaned ones (their target died mid-install)
+        re-offer the RETAINED host payloads to a survivor."""
+        for rep in self._replicas:
+            eng = rep.engine
+            if rep.state != "up" or eng is None or eng.role != "prefill":
+                continue
+            for tr in eng.take_outbound():
+                rid = tr.request_id
+                with self._lock:
+                    self._transfers[rid] = tr
+                self._transfer_src[rid] = rep.idx
+                target = self._pick_decode()
+                if target is not None:
+                    target.engine.offer_transfer(tr)
+                    self._assign[rid] = target.idx
+        for rid, tr in list(self._transfers.items()):
+            req = tr.request
+            if tr.seated:
+                # terminal success: the decode replica hosts the request
+                # now — its death replays there, not at the prefill source
+                tgt = self._assign.get(rid)
+                if tgt is not None:
+                    with self._lock:
+                        self._owner[rid] = tgt
+                self._drop_transfer(rid)
+                continue
+            if tr.aborted or req.state == FINISHED or self._acked(rid):
+                # handled elsewhere (cancel/expire/shed/drain): the normal
+                # resolution path owns it — no replay from here
+                self._drop_transfer(rid)
+                continue
+            if tr.failed:
+                # the stream is unusable but the request is live (e.g.
+                # params_version moved mid-flight): single-version replay
+                self._drop_transfer(rid)
+                self._replay([rid])
+                continue
+            tgt = self._assign.get(rid)
+            rep_t = self._replicas[tgt] if tgt is not None else None
+            if rep_t is None or not rep_t.routable \
+                    or rep_t.engine is None \
+                    or not rep_t.engine.has_transfer(rid):
+                # no target yet, or it died/drained mid-install: the page
+                # payloads are retained host-side until seated — re-offer
+                # the SAME stream to a survivor (no prompt recompute)
+                target = self._pick_decode()
+                if target is None:
+                    continue          # fleet degraded: retry next round
+                target.engine.offer_transfer(tr)
+                self._assign[rid] = target.idx
+            if tr.done:
+                # every page is host-resident and the prefill worker has
+                # freed its slot: from here the DECODE side owns delivery
+                with self._lock:
+                    self._owner[rid] = self._assign[rid]
+
+    def _drop_transfer(self, rid):
+        with self._lock:
+            self._transfers.pop(rid, None)
+        self._assign.pop(rid, None)
+        self._transfer_src.pop(rid, None)
+
+    def _drop_transfers_for(self, rep):
+        """Reconcile in-flight transfers against replica ``rep`` going
+        away (crash, stale heartbeat, chip loss, reform, role flip)."""
+        if not self._disagg:
+            return
+        for rid, tr in list(self._transfers.items()):
+            if self._transfer_src.get(rid) == rep.idx and not tr.done:
+                # the SOURCE died mid-stream: the remaining pages can
+                # never arrive — abort (the decode side returns its
+                # staged pages) and let the caller's replay recompute
+                tr.aborted = True
+                self._drop_transfer(rid)
+            elif self._assign.get(rid) == rep.idx:
+                # the TARGET died: payloads survive on the host — unassign
+                # and let the pump re-offer the stream to a survivor
+                self._assign.pop(rid, None)
+
+    def _rebalance_roles(self):
+        """Role elasticity under chip loss: decoding must never stall —
+        when no decode-capable replica is routable, the least-loaded live
+        prefill worker flips to decode (drain + respawn, zero drops); it
+        flips back to its configured role once native decode capacity is
+        routable again."""
+        ups = self._routable()
+        decodes = [r for r in ups if r.role != "prefill"]
+        prefills = [r for r in ups if r.role == "prefill"]
+        if not decodes and prefills:
+            rep = min(prefills, key=lambda r: (r.load, r.idx))
+            self._set_replica_role(rep, "decode")
+            metrics.bump("role_rebalances")
+        elif decodes and not prefills:
+            conv = [r for r in decodes if r.configured_role == "prefill"]
+            native = [r for r in decodes if r.configured_role != "prefill"]
+            if conv and native:
+                rep = min(conv, key=lambda r: (r.load, r.idx))
+                self._set_replica_role(rep, rep.configured_role)
+                metrics.bump("role_rebalances")
+
+    def _set_replica_role(self, rep, role):
+        """Flip a replica's serving role through a drain (the only safe
+        window — set_role refuses non-idle engines): in-flight work
+        requeues on the survivors (original arrival kept, zero drops),
+        the engine respawns in the new role (builders memoized — zero
+        new traces over warm shapes)."""
+        if rep.role == role:
+            return
+        eng = rep.engine
+        if eng is None or rep.state != "up":
+            rep.role = role          # applied at the next spawn
+            return
+        self._drop_transfers_for(rep)
+        rep.state = "draining"
+        drained = eng.drain()
+        self._collect(rep)
+        rep.role = role
+        rep.engine = self._spawn_engine(rep)
+        rep.state = "up"
+        metrics.bump("respawns")
+        if rep.hb is not None:
+            rep.hb.beat(status="running")
+        for req in drained:
+            if req.state == FINISHED:
+                continue
+            target = self._requeue_target(req, exclude=rep) or rep
+            target.engine.requeue(req)
+            with self._lock:
+                self._owner[req.request_id] = target.idx
 
     # -- telemetry-driven autoscaling ----------------------------------------
     def _autoscale_step(self):
@@ -693,6 +973,7 @@ class ServingSupervisor:
         rep.state = "down"
         rep.last_error = err
         rep.engine = None
+        self._drop_transfers_for(rep)
         unacked = self._unacked_of(rep)
         rep.restarts += 1
         if rep.restarts > self.max_restarts:
@@ -825,6 +1106,7 @@ class ServingSupervisor:
                 rep.last_error = e
                 rep.reform_backoff = min(max(1, rep.reform_backoff * 2), 32)
                 rep.reform_wait = rep.reform_backoff
+                self._drop_transfers_for(rep)
                 self._replay(self._unacked_of(rep))
         set_group_gauges(self._replicas, self._configured_mp)
 
@@ -859,6 +1141,7 @@ class ServingSupervisor:
                 # dead engine
                 rep.engine.stop_for_reform(self._last_reform_latency())
             rep.engine = None
+        self._drop_transfers_for(rep)
         unacked = self._unacked_of(rep)
         if plan is None:
             # no home chip survives: the group stays down (degraded to
@@ -923,6 +1206,11 @@ class ServingSupervisor:
         rep.engine = eng
         rep.state = "up"
         rep.reform_wait = rep.reform_backoff = 0   # spawn worked again
+        # the rebuilt engine holds neither the old engine's outbound
+        # streams nor its staged install pages: abort unfinished sourced
+        # transfers (their slots were requeued by the restore) and
+        # unassign inbound ones so the pump re-offers them
+        self._drop_transfers_for(rep)
         # same reconciliation as the loss path: the handoff minted FRESH
         # Request objects (from_state), so live handles must be refreshed
         # for cancel() identity-routing; a request cancelled MID-grow
@@ -964,6 +1252,14 @@ class ServingSupervisor:
             with self._lock:
                 src = self._requests.get(rid)
             if src is None or self._acked(rid):
+                continue
+            tr = self._transfers.get(rid) if self._disagg else None
+            if tr is not None and tr.done \
+                    and not (tr.aborted or tr.failed or tr.seated):
+                # complete KV stream retained host-side: the pump re-offers
+                # it to a surviving decode worker — cheaper than a full
+                # prompt recompute, still zero drops
+                self._assign.pop(rid, None)
                 continue
             if src.state == FINISHED:
                 if src.finish_reason == CANCELLED:
@@ -1115,6 +1411,19 @@ class ServingSupervisor:
                 if rep.hb is not None:
                     rep.hb.beat(status="stopped")
                 rep.state = "down"
+        if self._disagg:
+            # requests caught mid-transfer live on NO engine (the prefill
+            # worker freed its slot, the decode worker never seated them):
+            # without this they would vanish from the hand-off set
+            seen = {r.request_id for r in leftovers}
+            for rid, tr in list(self._transfers.items()):
+                req = tr.request
+                tr.aborted = True
+                self._drop_transfer(rid)
+                if rid not in seen and not tr.seated \
+                        and req.state != FINISHED:
+                    req._requeue()
+                    leftovers.append(req)
         return leftovers
 
     # -- introspection -------------------------------------------------------
@@ -1138,6 +1447,9 @@ class ServingSupervisor:
                "pending": self.pending(),
                "params_version": (self._live_params[1]
                                   if self._live_params is not None else 0)}
+        if self._disagg:
+            with self._lock:
+                out["transfers_inflight"] = len(self._transfers)
         if self._topology is not None:
             out["configured_mp"] = int(self._configured_mp)
             out["degraded_groups"] = degraded_count(self._replicas,
@@ -1147,6 +1459,7 @@ class ServingSupervisor:
             out[f"replica{rep.idx}"] = {
                 "up": int(rep.state == "up"),
                 "state": rep.state,
+                "role": rep.role,
                 "restarts": int(rep.restarts),
                 "queue_depth": (0 if eng is None else eng.queue_depth),
                 "active_slots": (0 if eng is None else eng.active_slots),
