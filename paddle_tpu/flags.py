@@ -145,6 +145,28 @@ _FLAGS = {
     # when auditing cross-mp-degree bitwise parity of a quantized config
     # on TPU (e.g. restoring an mp snapshot onto a single chip).
     "FLAGS_serving_quant_kernel": True,
+    # -- speculative decoding (serving/engine.py + serving/quant.py) --------
+    # Speculative multi-token decoding on the paged engine: per boundary a
+    # cheap DRAFT pass proposes up to k tokens per slot, then ONE fused
+    # verify executable scores all slots at [B,k+1] with per-slot accept
+    # masks / lengths / sampling params as traced operands (the chunk-
+    # ladder trick: mixed speculative/plain/greedy/sampled traffic shares
+    # one executable, admission never retraces). Greedy speculative output
+    # is BITWISE identical to the non-speculative engine; sampled streams
+    # replay generate_from_params exactly (threefry streams split only on
+    # EMITTED tokens). 0 = OFF: the engine builds byte-identical
+    # executables to a pre-speculation engine.
+    "FLAGS_serving_speculate_k": 0,
+    # Draft source: "quant" (default — the PR 14 int8 self-draft: the
+    # SAME weights quantized per-channel, reading the engine's paged KV
+    # through a draft-scale sidecar; on an already-quantized engine the
+    # draft degenerates to the engine weights) or "shallow" (truncate to
+    # the first FLAGS_serving_draft_layers transformer blocks — cheaper
+    # on CPU where int8 dequant costs more than it saves).
+    "FLAGS_serving_draft_source": "quant",
+    # Number of transformer blocks the "shallow" draft keeps. 0 = auto
+    # (num_layers // 2, at least 1). Ignored by source="quant".
+    "FLAGS_serving_draft_layers": 0,
     # -- self-healing serving (serving/engine.py + serving/supervisor.py) ---
     # Engine-snapshot cadence: with a CheckpointManager attached
     # (Engine.attach_checkpoint), every N step boundaries the FULL engine

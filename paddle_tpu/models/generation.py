@@ -206,6 +206,50 @@ def _is_stop(tok, stop_token_ids):
     return hit
 
 
+def _verify_accept(logits, ids_next, nprop, emit, do_sample, temperature,
+                   top_p, key_data, top_k):
+    """Speculative accept scan over the verify window's logits
+    [B, T, V] (T = k+1). Lane i's logits score the token AT window
+    position i, so its selected token is the TRUE next token after i;
+    the slot keeps emitting while each selected token matches the draft's
+    proposal for the next lane (ids_next [B, T], garbage in the last
+    lane — never compared, since lane T-1 has ``i == nprop`` at most).
+
+    PRNG discipline — the whole bitwise contract lives here: each slot's
+    threefry key splits ONCE PER EMITTED token, greedy included, exactly
+    like the plain fused step and ``_generate_jit``. Lanes past the
+    accept point (``going`` False) select garbage greedily, split
+    nothing, and advance nothing, so a sampled stream replays
+    ``generate_from_params`` token-for-token no matter where rejection
+    lands. temperature/top_p are per-slot traced operands; top_k is
+    static (shape of the top_k cut).
+
+    Returns (toks [B, T] — lanes >= n_emit[b] garbage, n_emit [B] int32
+    with emit=False slots at 0, new key_data [B, 2] uint32)."""
+    B, T, _ = logits.shape
+
+    def step(carry, xs):
+        key_data, going, n_emit = carry
+        lg, nxt_prop, i = xs
+        pair = jax.vmap(jax.random.split)(
+            jax.random.wrap_key_data(key_data))
+        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        sampled = jax.vmap(jax.random.categorical)(
+            pair[:, 1],
+            _mask_logits(lg, temperature, top_k, top_p)).astype(jnp.int32)
+        t = jnp.where(do_sample & going, sampled, greedy)
+        new_kd = jnp.where(going[:, None],
+                           jax.random.key_data(pair[:, 0]), key_data)
+        n_emit = n_emit + going
+        going = going & (i < nprop) & (t == nxt_prop)
+        return (new_kd, going, n_emit), t
+
+    (key_data, _, n_emit), toks = jax.lax.scan(
+        step, (key_data, emit, jnp.zeros((B,), jnp.int32)),
+        (jnp.swapaxes(logits, 0, 1), ids_next.T, jnp.arange(T)))
+    return toks.T, n_emit, key_data
+
+
 def _cfg_view(cfg):
     """cfg is a hashable static tuple (nh, L, H, eps, compute_dtype_str) —
     GPTConfig itself is a mutable dataclass and cannot key the jit cache."""

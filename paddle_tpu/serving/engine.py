@@ -64,12 +64,15 @@ from ..observability import tracing as obs_tracing
 from ..utils import fault_injection as _fi
 from ..models.generation import (
     _cfg_key, _cfg_view, _collect_params, _forward_cached,
-    _forward_decode_slots, _logical_qkv, _mask_logits,
+    _forward_decode_slots, _logical_qkv, _mask_logits, _verify_accept,
 )
 from . import metrics
 from . import quant as _squant
 from .kv_transfer import KVTransfer, PagePayload
-from .paged_attention import paged_forward, paged_kernel_supported
+from .paged_attention import (
+    paged_draft_forward, paged_forward, paged_kernel_supported,
+    paged_kv_rewind, paged_verify_forward,
+)
 from .paged_kv import PagedKVPool, pages_for
 from .request import (
     CANCELLED, ERROR, EXPIRED, FINISHED, LENGTH, QUEUED, RUNNING, SHED,
@@ -272,6 +275,79 @@ def _make_page_write(donate):
     return jax.jit(fn, donate_argnums=donate)
 
 
+@lru_cache(maxsize=None)
+def _make_spec_draft(cfg, page_size, k, quant=None):
+    """Build the speculative DRAFT executable: greedily roll the draft
+    params ``k`` tokens ahead of every slot, reading the shared paged
+    pool (strictly below each slot's write position) and carrying the
+    in-window KV in a [L, B, k, nh, d] sidecar — the pool is NEVER
+    written, so a rejected proposal needs zero draft-side rewind.
+    nprop gating is the verify pass's job (its accept scan stops at
+    nprop[b]); the draft always rolls the full static k so one
+    executable serves every per-slot proposal depth. Memoized per
+    (config, page_size, k, quant) — both draft sources share this one
+    wrapper; their distinct param TREES (int8 scale leaves vs sliced
+    shallow blocks) key distinct traces under it, exactly like the
+    quantized vs bf16 fused step."""
+    config = _cfg_view(cfg)
+    kvq = quant is not None and quant[1] != "bf16"
+
+    def fn(draft_params, kc, vc, tok, pos, table, *kv_scales):
+        metrics.bump("spec_draft_traces")  # body runs only when traced
+        scales = tuple(kv_scales) if kvq else None
+        return paged_draft_forward(draft_params, config, tok, kc, vc, pos,
+                                   table, page_size, k, kv_scales=scales)
+
+    # NO donation: kc/vc must survive — the verify dispatch reads them next
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=None)
+def _make_spec_verify(cfg, top_k, page_size, donate, anomaly=False,
+                      quant=None, qkernel=False):
+    """Build the fused speculative VERIFY executable: score ALL slots'
+    [B, k+1] windows (lane 0 = the last emitted token, lanes 1..k = the
+    draft's proposals) with the SERVED weights, run the accept scan
+    (per-slot nprop/emit/sampling params as traced operands — the
+    chunk-ladder trick, so mixed speculative/plain/greedy/sampled
+    traffic shares this one executable), then rewind every KV byte
+    written past each slot's accepted length back to its pre-dispatch
+    value. PRNG keys split once per EMITTED token inside the scan, so
+    sampled streams replay ``generate_from_params`` exactly.
+
+    ``anomaly=True`` mirrors the fused step's guard: a slot is flagged
+    only if a NON-finite logit occurs on a lane it actually emitted
+    from — rejected lanes' logits are dead values."""
+    config = _cfg_view(cfg)
+    kvq = quant is not None and quant[1] != "bf16"
+
+    def fn(params, kc, vc, ids, start, valid, emit, table, nprop,
+           do_sample, temperature, top_p, key_data, *kv_scales):
+        metrics.bump("spec_verify_traces")  # body runs only when traced
+        scales = tuple(kv_scales) if kvq else None
+        logits, kc, vc, saved_k, saved_v = paged_verify_forward(
+            params, config, ids, kc, vc, start, valid, table, page_size,
+            False, kv_scales=scales, wq_kernel=qkernel)
+        # lane i's logits score the token AFTER window position i: the
+        # proposal to check against is ids[:, i+1] (last lane has none)
+        ids_next = jnp.concatenate(
+            [ids[:, 1:], jnp.zeros_like(ids[:, :1])], axis=1)
+        toks, n_emit, new_keys = _verify_accept(
+            logits, ids_next, nprop, emit, do_sample, temperature, top_p,
+            key_data, top_k)
+        kc, vc = paged_kv_rewind(kc, vc, saved_k, saved_v, table, start,
+                                 valid, n_emit, page_size)
+        if anomaly:
+            T = ids.shape[1]
+            lane = jnp.arange(T)[None, :]
+            fin = jnp.all(jnp.isfinite(logits), axis=-1)        # [B, T]
+            ok = jnp.all((lane >= n_emit[:, None]) | fin, axis=-1)
+            return kc, vc, toks, n_emit, new_keys, ok
+        return kc, vc, toks, n_emit, new_keys
+
+    return jax.jit(fn, donate_argnums=donate)
+
+
 class Engine:
     """Continuous-batching serving engine.
 
@@ -295,7 +371,8 @@ class Engine:
                  num_pages=None, prefill_chunk=None, prefix_cache=None,
                  tag=None, trace=None, priority=None, tenant_weights=None,
                  shed=None, params_version=0, mesh=None, mp=None,
-                 comm_backend=None, anomaly=None, quant=None, role=None):
+                 comm_backend=None, anomaly=None, quant=None, role=None,
+                 speculate_k=None, draft_source=None, draft_layers=None):
         if model is not None:
             params = _collect_params(model)
             config = model.config
@@ -459,6 +536,30 @@ class Engine:
         self.top_k = (None if top_k in (None, 0)
                       else min(int(top_k), config.vocab_size))
 
+        # speculative decoding (FLAGS_serving_speculate_k): resolves to
+        # None at the default 0 and every speculative code path below is
+        # skipped — the engine's executables, dispatch sequence and trace
+        # counters are byte-identical to the plain engine (the flags-off
+        # parity contract every serving PR carries).
+        self._spec = _squant.resolve_draft(speculate_k, draft_source,
+                                           draft_layers, flags)
+        self.speculate_k = 0 if self._spec is None else self._spec.k
+        self._draft_params = None
+        self._spec_draft = None
+        self._spec_verify = None
+        self._draft_params_version = None
+        if self._spec is not None and self.kv_layout != "paged":
+            raise ValueError(
+                "speculative decoding rides the paged layout (the draft "
+                "shares the paged pool and rejected writes rewind "
+                "per-page; the pooled layout is the parity baseline); "
+                "use kv_layout='paged' with FLAGS_serving_speculate_k > 0")
+        if self._spec is not None and self.mp > 1:
+            raise ValueError(
+                "speculative decoding is single-chip for now (the draft/"
+                "verify pair would double the mp collective schedule); "
+                "use mp=1 with FLAGS_serving_speculate_k > 0")
+
         cfg = _cfg_key(config)
         donate_ok = jax.default_backend() != "cpu"  # cpu: donation unimplemented
         B = self.num_slots
@@ -531,6 +632,17 @@ class Engine:
                     (1, 2) if donate_ok else (), anomaly=self._anomaly,
                     quant=quant_key, qkernel=qkernel)
             self._page_copy = _make_page_copy((0, 1) if donate_ok else ())
+            if self._spec is not None:
+                # one draft + one verify builder, memoized per config like
+                # every other serving executable: a second spec engine
+                # over warm shapes adds zero traces
+                self._spec_verify = _make_spec_verify(
+                    cfg, self.top_k, self.page_size,
+                    (1, 2) if donate_ok else (), anomaly=self._anomaly,
+                    quant=quant_key, qkernel=qkernel)
+                self._spec_draft = _make_spec_draft(
+                    cfg, self.page_size, self._spec.k, quant=quant_key)
+                self._build_draft_params()
             shape = (config.num_layers, self.pool.num_pages, self.page_size,
                      nh, d)
             if self._kv_quant:
@@ -1086,6 +1198,9 @@ class Engine:
                     and self._chunk_off[b] >= self._slots[b].prompt_len]
         if not decoding:
             return
+        if self._spec is not None:
+            self._iterate_spec(decoding, t_boundary)
+            return
         # mid-prefill slots ride along inert: valid=0 routes their writes
         # to the trash page, emit=False parks their PRNG keys
         valid = np.zeros(B, np.int32)
@@ -1132,6 +1247,129 @@ class Engine:
                                pos=int(self._pos[b]))
             self._pos[b] += 1
             self._emit_token(req, b, int(nxt[b]), first=False)
+
+    def _build_draft_params(self):
+        """(Re)derive the draft params from the SERVED weights — at
+        construction and after every ``swap_params`` — so the draft always
+        proposes against the live version (``_draft_params_version``, the
+        snapshot's audit stamp, records which). Source "quant": the PR 14
+        int8 self-draft — on an engine already serving quantized weights
+        the served tree IS the draft (degenerate self-draft, 100% greedy
+        agreement); on a bf16 engine the served tree is quantized fresh.
+        Source "shallow": the first ``draft_layers`` transformer blocks
+        of the served tree (embeddings/LN/head shared, zero copies)."""
+        if self._spec.source == "quant":
+            if self._quant is not None and self._quant.quantizes_weights:
+                self._draft_params = self.params
+            else:
+                self._draft_params = _squant.quantize_params(
+                    self.params, self.config,
+                    _squant.QuantSpec(weight_dtype="int8"))
+        else:
+            self._draft_params = _squant.shallow_draft_params(
+                self.params,
+                self._spec.num_layers(self.config.num_layers))
+        self._draft_params_version = self.params_version
+
+    def _iterate_spec(self, decoding, t_boundary):
+        """Speculative decode boundary (FLAGS_serving_speculate_k > 0):
+        the draft rolls every decode-ready slot up to k tokens ahead of
+        its last emitted token (sidecar KV — the shared pool is never
+        written), then ONE fused verify dispatch scores all slots at
+        [B, k+1] under the SERVED weights, accepts per slot, and rewinds
+        every KV byte written past an accepted length. Per-slot
+        nprop/emit/sampling params are traced operands — the chunk-ladder
+        trick — so mixed speculative/plain/greedy/sampled traffic shares
+        this one executable: a slot with nprop=0 (``speculate="off"``, or
+        one token remaining) IS plain decode inside the same dispatch,
+        and a spec engine never dispatches the [B, 1] plain-decode shape.
+        Emitted token streams are bitwise the plain engine's (greedy) and
+        replay ``generate_from_params`` exactly (sampled): the verify key
+        splits once per EMITTED token only."""
+        B = self.num_slots
+        k = self._spec.k
+        nprop = np.zeros(B, np.int32)
+        valid = np.zeros(B, np.int32)
+        emit = np.zeros(B, bool)
+        for b in decoding:
+            req = self._slots[b]
+            remaining = req.max_new_tokens - len(req.tokens)
+            if req.speculate != "off":
+                # the window's last lane must stay a real (non-proposed)
+                # emission so LENGTH fires exactly at max_new_tokens
+                nprop[b] = min(k, max(0, remaining - 1))
+            valid[b] = nprop[b] + 1
+            emit[b] = True
+        ids = np.zeros((B, k + 1), np.int32)
+        ids[:, 0] = self._tok                 # lane 0: last emitted token
+        t0 = time.perf_counter()
+        if int(nprop.max()) > 0:
+            props = self._spec_draft(
+                self._draft_params, self._kc, self._vc,
+                jnp.asarray(self._tok), jnp.asarray(self._pos),
+                jnp.asarray(self.pool.table), *self._kv_scale_args())
+            ids[:, 1:] = np.asarray(props)
+            metrics.bump("draft_dispatches")
+        for b in decoding:
+            self._cow(b, int(self._pos[b]),
+                      int(self._pos[b]) + int(valid[b]))
+        self._decode_dispatches += 1     # per-role gate: prefill workers
+        out = self._spec_verify(         # must never reach this dispatch
+            self.params, self._kc, self._vc, jnp.asarray(ids),
+            jnp.asarray(self._pos), jnp.asarray(valid), jnp.asarray(emit),
+            jnp.asarray(self.pool.table), jnp.asarray(nprop),
+            jnp.asarray(self._do_sample), jnp.asarray(self._temp),
+            jnp.asarray(self._top_p), jnp.asarray(self._keys),
+            *self._kv_scale_args())
+        if self._anomaly:
+            self._kc, self._vc, toks, n_emit, keys, ok = out
+            ok = np.asarray(ok)
+        else:
+            self._kc, self._vc, toks, n_emit, keys = out
+            ok = None
+        toks = np.asarray(toks)
+        n_emit = np.asarray(n_emit)
+        self._keys = np.array(keys)
+        now = time.perf_counter()
+        metrics.bump("paged_steps")
+        metrics.bump("verify_dispatches")
+        metrics.add_time("decode_time_s", now - t0)
+        total_emitted = 0
+        for b in decoding:
+            req = self._slots[b]
+            if ok is not None and not ok[b]:
+                self._quarantine(req, b)
+                continue
+            # a stop token cuts the window mid-run: the tail of the
+            # accepted run is dropped (freed pages only), so the emission
+            # count is known BEFORE emitting — which is what lets the
+            # span land before the final token's emission delivers the
+            # request and archives its trace
+            n = int(n_emit[b])
+            stops = req.stop_token_ids or ()
+            plan = next((j + 1 for j in range(n)
+                         if int(toks[b, j]) in stops), n)
+            accepted = max(0, plan - 1)      # lane 0 is never speculative
+            metrics.bump("spec_proposed", int(nprop[b]))
+            metrics.bump("spec_accepted", accepted)
+            metrics.bump("spec_tokens_out", plan)
+            if req.trace is not None:
+                # reconciles with the emitted-token ledger: sum(emitted)
+                # over a request's speculate spans == len(result.tokens)-1
+                # (the first token comes from the prefill chunk)
+                req.trace.span("speculate", t_boundary, now,
+                               proposed=int(nprop[b]), accepted=accepted,
+                               emitted=plan)
+            for j in range(plan):
+                if self._slots[b] is not req:
+                    break                    # safety net; plan already
+                self._pos[b] += 1            # accounts for the stop cut
+                self._emit_token(req, b, int(toks[b, j]), first=False)
+            total_emitted += plan
+        # the whole boundary gap bought total_emitted tokens — the
+        # speculative payoff the latency histogram should see
+        metrics.observe_token_latency(now - t_boundary,
+                                      max(1, total_emitted))
 
     def _prefill_chunk(self, b):
         """Advance slot b's prefill by one chunk ([1, rung] dispatch of
@@ -1799,6 +2037,12 @@ class Engine:
             # decode against stale KV (caught by the parity gate). Version
             # bump invalidates the whole cache.
             self.pool.clear_cache()
+        if self._spec is not None:
+            # the draft must propose against the NEW weights (a stale
+            # draft would only cost accept rate, never correctness — the
+            # verify pass serves the swapped tree — but the whole point
+            # of the self-draft is tracking the served version for free)
+            self._build_draft_params()
         if count:
             metrics.bump("weight_swaps")
         return self
@@ -1927,6 +2171,23 @@ class Engine:
         }
         if self.kv_layout == "paged":
             state["pool"] = self.pool.state_dict()
+        if self._spec is not None:
+            # draft/speculation state. Drafts are BOUNDARY-ATOMIC — a
+            # draft+verify pair completes inside one step boundary and
+            # every rejected byte is rewound before the host regains
+            # control — so there is never pending-draft progress to
+            # drain: the snapshot is always the plain-equivalent state,
+            # which is what lets spec <-> plain restores stay bitwise.
+            # (Deliberately NOT in _snapshot_meta: spec config is an
+            # ENGINE property, not a snapshot-compatibility axis.)
+            state["spec"] = {
+                "speculate_k": int(self._spec.k),
+                "draft_source": self._spec.source,
+                "draft_layers": int(self._spec.layers),
+                "draft_params_version": (
+                    None if self._draft_params_version is None
+                    else int(self._draft_params_version)),
+            }
         return state
 
     def load_state_dict(self, state, restore_metrics=False):
@@ -2059,6 +2320,13 @@ class Engine:
             metrics.seed_prefix_counters(
                 state["metrics"].get("counters", {}))
         metrics.bump("snapshot_restores")
+        if self._spec is not None:
+            # the restoring engine rebuilt its draft from ITS OWN served
+            # weights at construction; the meta check above already
+            # guaranteed params_version agreement, so the draft tracks
+            # the restored version too (state["spec"] is an audit stamp,
+            # not restored state — drafts are boundary-atomic)
+            self._draft_params_version = self.params_version
         self._stopped = False
         self._reforming = False
         self._reform_retry_after = None

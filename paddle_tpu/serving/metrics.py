@@ -91,6 +91,15 @@ def _zero():
         # page read/write executables for the transfer path (memoized like
         # every other builder — frozen after warmup)
         "read_traces": 0, "write_traces": 0,
+        # speculative decoding (FLAGS_serving_speculate_k): draft/verify
+        # dispatch tallies, proposed vs accepted draft tokens, and the
+        # tokens every speculative boundary actually emitted. The two
+        # trace counters are the spec engine's no-recompile audit trail
+        # (one draft + one verify executable, memoized per config); on a
+        # plain engine the whole family stays 0 — the flags-off gate.
+        "draft_dispatches": 0, "verify_dispatches": 0,
+        "spec_proposed": 0, "spec_accepted": 0, "spec_tokens_out": 0,
+        "spec_draft_traces": 0, "spec_verify_traces": 0,
         # tokens / time
         "tokens_out": 0,
         "decode_time_s": 0.0, "prefill_time_s": 0.0,
@@ -264,6 +273,15 @@ def serving_counters():
     out["prefill_waste_mean"] = (
         out["prefill_padded_tokens"] / out["prefill_padded_reqs"]
         if out["prefill_padded_reqs"] else 0.0)
+    # speculative decoding: what fraction of proposed draft tokens the
+    # verify pass accepted, and how many tokens ONE dispatch buys on
+    # average (draft + verify both count — the honest amortization; the
+    # plain engine's equivalent is exactly 1.0)
+    out["accept_rate"] = (out["spec_accepted"] / out["spec_proposed"]
+                          if out["spec_proposed"] else 0.0)
+    spec_disp = out["draft_dispatches"] + out["verify_dispatches"]
+    out["tokens_per_dispatch"] = (out["spec_tokens_out"] / spec_disp
+                                  if spec_disp else 0.0)
     return out
 
 
@@ -372,6 +390,13 @@ def serving_summary():
                  f"kv={qinfo.get('kv_dtype', '?')}  "
                  f"scales: {c['quant_scale_bytes']}B  "
                  f"kv-bytes/tok: {c['quant_kv_bytes_per_token']}{drift}")
+    spec = ""
+    if c["verify_dispatches"]:
+        spec = (f"  spec: accept: {c['accept_rate'] * 100:.1f}% "
+                f"({c['spec_accepted']}/{c['spec_proposed']})  "
+                f"tok/dispatch: {c['tokens_per_dispatch']:.2f}  "
+                f"draft/verify: {c['draft_dispatches']}/"
+                f"{c['verify_dispatches']}")
     mp = ""
     if c["mp_steps"]:
         with _lock:
@@ -411,4 +436,4 @@ def serving_summary():
             f"queue: {c['queue_depth_mean']:.1f} avg/{c['queue_depth_max']} max  "
             f"executables: {c['prefill_traces']} prefill + "
             f"{c['decode_traces']} decode + {c['paged_traces']} paged"
-            f"{paged}{quant}{mp}{disagg}{waste}{slo}{heal}")
+            f"{paged}{quant}{spec}{mp}{disagg}{waste}{slo}{heal}")

@@ -447,3 +447,240 @@ def paged_forward(params, config, ids, kc, vc, start, valid, table,
                             use_kernel=wq_kernel)
         return logits, kc, vc
     return _final_logits(params, config, xlast), kc, vc
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: verify forward (+ KV rewind) and the draft forward
+
+
+def _layer_verify(p, h, kc_l, vc_l, table, pos, valid, nh, eps, page_size,
+                  use_kernel, ksc_l=None, vsc_l=None, wq_kernel=False):
+    """``_layer_paged`` with the attention read decomposed PER LANE: each
+    of the T window lanes reads the pool at the [B, 1] shape — the exact
+    dot/softmax/contraction shapes of the plain engine's one-token decode
+    — instead of one [B, T] read. The [B, T] contraction over the virtual
+    window is mathematically identical but NOT bitwise (the backend may
+    block a T-row GEMM differently than T=1's matvec), and the verify
+    pass's whole contract is that an accepted lane's KV bytes and logits
+    are bit-for-bit what the plain engine would have produced. T is the
+    static k+1, so the unrolled loop stays a small fixed cost."""
+    B, T, H = h.shape
+    d = H // nh
+
+    h1 = ln_fp32(h, p["ln1_g"], p["ln1_b"], eps)
+    qkv = _proj(h1, p, "qkv_w", wq_kernel) + p["qkv_b"].astype(h.dtype)
+    q, k, v = jnp.split(qkv.reshape(B, T, 3, nh, d), 3, axis=2)
+    q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+
+    kc_l, vc_l = paged_kv_scatter(kc_l, vc_l, k, v, table, pos, valid,
+                                  page_size, ksc_l, vsc_l)
+    ctx = jnp.concatenate(
+        [paged_attention_read(q[:, t:t + 1], kc_l, vc_l, table,
+                              pos[:, t:t + 1], page_size, use_kernel,
+                              h.dtype, ksc_l, vsc_l)
+         for t in range(T)], axis=1)
+
+    attn = _proj(ctx.reshape(B, T, H), p, "out_w", wq_kernel) + \
+        p["out_b"].astype(h.dtype)
+    h = h + attn
+    h2 = ln_fp32(h, p["ln2_g"], p["ln2_b"], eps)
+    up = _proj(h2, p, "up_w", wq_kernel) + p["up_b"].astype(h.dtype)
+    up = jax.nn.gelu(up, approximate=True)
+    return h + _proj(up, p, "down_w", wq_kernel) + \
+        p["down_b"].astype(h.dtype), kc_l, vc_l
+
+
+def _head_logits(params, config, x, wq_kernel=False):
+    """LM-head logits over arbitrary leading dims, routing through the
+    quantized head when the tree carries one."""
+    if "head_w_s" in params:
+        xn = _final_ln(params, config, x)
+        return quant_gemm(xn, params["head_w"], params["head_w_s"],
+                          use_kernel=wq_kernel)
+    return _final_logits(params, config, x)
+
+
+def paged_verify_forward(params, config, ids, kc, vc, start, valid, table,
+                         page_size, use_kernel=False, kv_scales=None,
+                         wq_kernel=False):
+    """Speculative VERIFY forward: exactly ``paged_forward``'s math over
+    the window ids [B, T] (T = k+1: the last emitted token + k draft
+    proposals), except that (a) logits come back for EVERY lane
+    ([B, T, V] — the accept scan needs all of them) and (b) the pre-write
+    STORAGE-dtype pool bytes of every written position are gathered per
+    layer BEFORE the scatter and returned ([L, B, T, nh, d] saved_k/
+    saved_v), so ``paged_kv_rewind`` can restore rejected lanes without a
+    second forward. Lane 0's logits are bitwise identical to the plain
+    fused step's logits for the same slot state: the scatter-then-read
+    order, the absolute causal mask and the per-row LN/GEMM math are all
+    unchanged, and appended masked lanes contribute exact zeros."""
+    compute = jnp.dtype(config.compute_dtype or "float32")
+    B, T = ids.shape
+    MP = table.shape[1]
+    pos = start[:, None] + jnp.arange(T)[None, :]               # [B, T]
+    x = params["wte"].astype(compute)[ids] + \
+        jnp.take(params["wpe"].astype(compute), pos, axis=0)
+    nh = config.num_heads
+    ksc, vsc = kv_scales if kv_scales is not None else (None, None)
+    # the same phys/off routing as paged_kv_scatter: padding lanes and
+    # inactive slots resolve to trash page 0, whose pre-write bytes are
+    # saved (and later rewritten) harmlessly
+    writable = jnp.arange(T)[None, :] < valid[:, None]          # [B, T]
+    li = jnp.minimum(pos // page_size, MP - 1)
+    phys = jnp.where(writable, jnp.take_along_axis(table, li, axis=1), 0)
+    off = pos % page_size
+
+    def layer_fn(h, xs):
+        if kv_scales is not None:
+            p_l, kc_l, vc_l, ksc_l, vsc_l = xs
+        else:
+            p_l, kc_l, vc_l = xs
+            ksc_l = vsc_l = None
+        saved_k = kc_l[phys, off]            # [B, T, nh, d] storage dtype
+        saved_v = vc_l[phys, off]
+        h, kc_l, vc_l = _layer_verify(p_l, h, kc_l, vc_l, table, pos,
+                                      valid, nh, config.layer_norm_epsilon,
+                                      page_size, use_kernel, ksc_l, vsc_l,
+                                      wq_kernel)
+        return h, (kc_l, vc_l, saved_k, saved_v)
+
+    xs = ((params["blocks"], kc, vc) if kv_scales is None
+          else (params["blocks"], kc, vc, ksc, vsc))
+    x, (kc, vc, saved_k, saved_v) = jax.lax.scan(layer_fn, x, xs)
+    logits = _head_logits(params, config, x, wq_kernel)         # [B, T, V]
+    return logits, kc, vc, saved_k, saved_v
+
+
+def paged_kv_rewind(kc, vc, saved_k, saved_v, table, start, valid, n_emit,
+                    page_size):
+    """Restore the pool bytes the verify pass wrote past each slot's
+    accepted length: lanes n_emit[b] <= i < valid[b] get their pre-write
+    STORAGE-dtype bytes back (already-quantized bytes on a quantized
+    pool — the restore bypasses re-quantization by construction, and the
+    host-side per-page scales were never touched). After this the pool is
+    byte-identical to a plain engine that decoded n_emit[b] tokens —
+    except physical page 0, the trash page, which both engines treat as
+    write-only garbage. Non-restored lanes route to page 0 exactly like
+    ``paged_kv_scatter``'s padding lanes."""
+    T = saved_k.shape[2]
+    MP = table.shape[1]
+    pos = start[:, None] + jnp.arange(T)[None, :]               # [B, T]
+    lane = jnp.arange(T)[None, :]
+    restore = (lane >= n_emit[:, None]) & (lane < valid[:, None])
+    li = jnp.minimum(pos // page_size, MP - 1)
+    phys = jnp.where(restore, jnp.take_along_axis(table, li, axis=1), 0)
+    off = pos % page_size
+
+    def layer_fn(carry, xs):
+        kc_l, vc_l, sk_l, sv_l = xs
+        kc_l = kc_l.at[phys, off].set(sk_l)
+        vc_l = vc_l.at[phys, off].set(sv_l)
+        return carry, (kc_l, vc_l)
+
+    _, (kc, vc) = jax.lax.scan(layer_fn, 0, (kc, vc, saved_k, saved_v))
+    return kc, vc
+
+
+def _draft_layer(p_l, h, kc_l, vc_l, sk_l, sv_l, table, base_pos, i, nh,
+                 eps, page_size, ksc_l, vsc_l):
+    """One draft transformer block at T=1: the current draft token reads
+    the REAL paged pool (strictly below base_pos — positions at/past it
+    hold stale rewound bytes) jointly with the in-flight draft K/V
+    sidecar (lanes 0..i), one concatenated softmax. The pool is never
+    written: draft K/V live only in the sidecar, so rejected drafts need
+    zero rewind."""
+    B, T, H = h.shape
+    d = H // nh
+    kmax = sk_l.shape[1]
+
+    h1 = ln_fp32(h, p_l["ln1_g"], p_l["ln1_b"], eps)
+    qkv = _proj(h1, p_l, "qkv_w") + p_l["qkv_b"].astype(h.dtype)
+    q, kx, vx = jnp.split(qkv.reshape(B, 1, 3, nh, d), 3, axis=2)
+    q, kx, vx = q[:, :, 0], kx[:, :, 0], vx[:, :, 0]
+    sk_l = sk_l.at[:, i].set(kx[:, 0])
+    sv_l = sv_l.at[:, i].set(vx[:, 0])
+
+    S = table.shape[1] * page_size
+    kv_k = kc_l[table].reshape(B, S, nh, d)
+    sc_pool = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                         kv_k.astype(jnp.float32)) / (d ** 0.5)
+    if ksc_l is not None:
+        k_sc = jnp.repeat(ksc_l[table], page_size, axis=1)      # [B, S]
+        sc_pool = sc_pool * k_sc[:, None, None, :]
+    sc_side = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                         sk_l.astype(jnp.float32)) / (d ** 0.5)
+    pool_mask = (jnp.arange(S)[None, :] <
+                 base_pos[:, None])[:, None, None, :]           # strict
+    side_mask = (jnp.arange(kmax) <= i)[None, None, None, :]
+    scores = jnp.concatenate(
+        [jnp.where(pool_mask, sc_pool, -jnp.inf),
+         jnp.where(side_mask, sc_side, -jnp.inf)], axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1)
+    kv_v = vc_l[table].reshape(B, S, nh, d).astype(jnp.float32)
+    if vsc_l is not None:
+        v_sc = jnp.repeat(vsc_l[table], page_size, axis=1)      # [B, S]
+        kv_v = kv_v * v_sc[:, :, None, None]
+    vals = jnp.concatenate([kv_v, sv_l.astype(jnp.float32)], axis=1)
+    ctx = jnp.einsum("bhts,bshd->bthd", probs, vals).astype(h.dtype)
+
+    attn = _proj(ctx.reshape(B, 1, H), p_l, "out_w") + \
+        p_l["out_b"].astype(h.dtype)
+    h = h + attn
+    h2 = ln_fp32(h, p_l["ln2_g"], p_l["ln2_b"], eps)
+    up = _proj(h2, p_l, "up_w") + p_l["up_b"].astype(h.dtype)
+    up = jax.nn.gelu(up, approximate=True)
+    return h + _proj(up, p_l, "down_w") + \
+        p_l["down_b"].astype(h.dtype), sk_l, sv_l
+
+
+def paged_draft_forward(params, config, tok, kc, vc, pos, table, page_size,
+                        k, kv_scales=None):
+    """Speculative DRAFT forward: greedily roll the draft model ``k``
+    tokens ahead of each slot's last emitted token ``tok`` [B] at
+    absolute position ``pos`` [B], reading the engine's paged pool
+    READ-ONLY and carrying the draft's own K/V in a compute-dtype sidecar
+    [Ld, B, k, nh, d]. ``params`` may be a quantized and/or
+    layer-truncated tree (Ld = its block count; the pool's leading layers
+    line up because shallow drafts keep the FIRST blocks). Proposals are
+    always greedy — the verify pass owns sampling and the PRNG stream.
+    Returns proposals [B, k] int32."""
+    compute = jnp.dtype(config.compute_dtype or "float32")
+    B = tok.shape[0]
+    nh = config.num_heads
+    d = config.hidden_size // nh
+    Ld = params["blocks"]["qkv_w"].shape[0]
+    ksc, vsc = kv_scales if kv_scales is not None else (None, None)
+    kcd, vcd = kc[:Ld], vc[:Ld]
+    kscd = ksc[:Ld] if ksc is not None else None
+    vscd = vsc[:Ld] if vsc is not None else None
+    sk0 = jnp.zeros((Ld, B, k, nh, d), compute)
+    sv0 = jnp.zeros((Ld, B, k, nh, d), compute)
+
+    def step_fn(carry, i):
+        cur, sk, sv = carry
+        p = pos + i
+        # jnp.take clips OOB positions (a slot about to hit max_seq_len)
+        x = params["wte"].astype(compute)[cur][:, None] + \
+            jnp.take(params["wpe"].astype(compute), p, axis=0)[:, None]
+
+        def layer_fn(h, xs):
+            if kv_scales is not None:
+                p_l, kc_l, vc_l, sk_l, sv_l, ksc_l, vsc_l = xs
+            else:
+                p_l, kc_l, vc_l, sk_l, sv_l = xs
+                ksc_l = vsc_l = None
+            h, sk_l, sv_l = _draft_layer(p_l, h, kc_l, vc_l, sk_l, sv_l,
+                                         table, pos, i, nh,
+                                         config.layer_norm_epsilon,
+                                         page_size, ksc_l, vsc_l)
+            return h, (sk_l, sv_l)
+
+        xs = ((params["blocks"], kcd, vcd, sk, sv) if kv_scales is None
+              else (params["blocks"], kcd, vcd, sk, sv, kscd, vscd))
+        x, (sk, sv) = jax.lax.scan(layer_fn, x, xs)
+        logits = _head_logits(params, config, x[:, 0])          # [B, V]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, sk, sv), nxt
+
+    _, props = jax.lax.scan(step_fn, (tok, sk0, sv0), jnp.arange(k))
+    return props.T                                              # [B, k]

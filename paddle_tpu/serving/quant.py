@@ -424,3 +424,79 @@ def max_logit_drift(params, config, spec, prompt, page_size=8):
                      jnp.asarray(page_scales(spec.kv_v_clip, P, qmax)))
     got = run(qparams, spec.kv_dtype, kv_scales)
     return float(np.max(np.abs(ref - got))), float(np.max(np.abs(ref)))
+
+
+# ---------------------------------------------------------------------------
+# speculative-draft plumbing (the draft model is DERIVED, never loaded)
+
+
+DRAFT_SOURCES = ("quant", "shallow")
+
+
+@dataclass
+class DraftSpec:
+    """Static speculative-decoding config: how many tokens the draft
+    proposes per boundary (``k``) and where the draft model comes from —
+    ``"quant"`` (int8 self-draft: the engine's own weights quantized
+    per-channel; degenerates to the engine weights when the engine is
+    already quantized) or ``"shallow"`` (the first ``layers`` transformer
+    blocks of the same tree, sharing embeddings/final-LN/head).
+    ``layers=0`` means auto (num_layers // 2, at least 1)."""
+
+    k: int
+    source: str = "quant"
+    layers: int = 0
+
+    def __post_init__(self):
+        self.k = int(self.k)
+        if self.k < 1:
+            raise QuantSpecError(
+                f"DraftSpec.k must be >= 1, got {self.k}")
+        if self.source not in DRAFT_SOURCES:
+            raise QuantSpecError(
+                f"DraftSpec.source must be one of {DRAFT_SOURCES}, got "
+                f"{self.source!r}")
+        self.layers = int(self.layers)
+        if self.layers < 0:
+            raise QuantSpecError(
+                f"DraftSpec.layers must be >= 0 (0 = auto), got "
+                f"{self.layers}")
+
+    def num_layers(self, total_layers):
+        if self.source != "shallow":
+            return int(total_layers)
+        n = self.layers or max(1, int(total_layers) // 2)
+        return min(n, int(total_layers))
+
+    def key(self):
+        """Hashable static key for the memoized draft builder."""
+        return (self.k, self.source, self.layers)
+
+
+def resolve_draft(speculate_k, source, layers, flags):
+    """Normalize the Engine's speculation arguments: explicit kwargs win,
+    None falls back to the FLAGS_serving_speculate_k family. Returns None
+    when the resolved k is 0 — the engine then builds byte-identical
+    executables to a pre-speculation engine."""
+    k = (int(flags.get("FLAGS_serving_speculate_k", 0))
+         if speculate_k is None else int(speculate_k))
+    if k <= 0:
+        return None
+    src = (str(flags.get("FLAGS_serving_draft_source", "quant"))
+           if source is None else str(source))
+    n = (int(flags.get("FLAGS_serving_draft_layers", 0))
+         if layers is None else int(layers))
+    return DraftSpec(k=k, source=src, layers=n)
+
+
+def shallow_draft_params(params, n_layers):
+    """Truncate a (possibly quantized) params tree to its first
+    ``n_layers`` transformer blocks. Embeddings, final LN and the LM head
+    are SHARED with the full tree (same arrays, no copy); only the
+    stacked block leaves — and their ``_s`` scale companions, which stack
+    the same layer axis — are sliced."""
+    blocks = {name: leaf[:n_layers]
+              for name, leaf in params["blocks"].items()}
+    out = dict(params)
+    out["blocks"] = blocks
+    return out

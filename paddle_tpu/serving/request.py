@@ -61,6 +61,12 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
     # "best_effort" (preempted and shed first).
     priority: str = "batch"
     tenant: str = "default"
+    # speculative-decode opt-out ("auto" | "off"). Policy-only, like
+    # priority: on a speculative engine, "off" pins this request to plain
+    # one-token decode (nprop=0 inside the SAME fused verify dispatch —
+    # a latency-sensitive tenant trades throughput for the tightest
+    # inter-token gap); on a plain engine it is carried but never read.
+    speculate: str = "auto"
 
     # -- engine-managed state ------------------------------------------------
     request_id: int = field(default_factory=lambda: next(_req_ids))
@@ -111,6 +117,10 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
         from .slo import class_rank
         class_rank(self.priority)      # validate eagerly: fail at submit
         self.tenant = str(self.tenant)
+        if self.speculate not in ("auto", "off"):
+            raise ValueError(
+                f"speculate must be 'auto' or 'off', got "
+                f"{self.speculate!r}")
 
     @property
     def prompt_len(self):
@@ -194,7 +204,8 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
                     top_p=self.top_p, top_k=self.top_k,
                     stop_token_ids=self.stop_token_ids, seed=self.seed,
                     deadline_s=self.deadline_s, on_token=self.on_token,
-                    priority=self.priority, tenant=self.tenant)
+                    priority=self.priority, tenant=self.tenant,
+                    speculate=self.speculate)
         r.request_id = self.request_id
         r.submit_t = self.submit_t
         r.first_token_t = self.first_token_t
@@ -226,6 +237,7 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
                            else float(self.deadline_s)),
             "priority": self.priority,
             "tenant": self.tenant,
+            "speculate": self.speculate,
             "params_version": (None if self.params_version is None
                                else int(self.params_version)),
             "request_id": int(self.request_id),
@@ -251,7 +263,8 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
                 stop_token_ids=state["stop_token_ids"], seed=state["seed"],
                 deadline_s=state["deadline_s"],
                 priority=state.get("priority", "batch"),
-                tenant=state.get("tenant", "default"))
+                tenant=state.get("tenant", "default"),
+                speculate=state.get("speculate", "auto"))
         r.params_version = state.get("params_version")
         r.request_id = int(state["request_id"])
         global _req_ids

@@ -164,6 +164,44 @@ def test_kill_resume_bitwise(ckpt_dir, layout, sampled, scenario):
         assert bal["conserved"] and bal["refcounts_accounted"], bal
 
 
+def test_kill_resume_bitwise_speculative(ckpt_dir):
+    """Kill-and-resume MID-SPECULATIVE-TRAFFIC stays bitwise: drafts are
+    boundary-atomic (no pending draft state exists between boundaries, so
+    there is nothing to drain), the snapshot carries the draft config +
+    params version under state["spec"], and the restored spec engine
+    resumes every stream — greedy AND sampled, prefix-shared siblings
+    included — token for token, with the paged allocator balanced."""
+    reqs, _ = _requests("prefix-shared", sampled=True)
+    golden = _golden(reqs)
+
+    eng = _engine("paged", speculate_k=4)
+    mgr = CheckpointManager(ckpt_dir, async_save=False,
+                            site="serving_snapshot")
+    eng.attach_checkpoint(mgr, every=0)
+    for r in reqs:
+        eng.submit(r)
+    for _ in range(3):
+        eng.step()
+    assert eng.active_slots, "kill must land mid-traffic"
+    state = eng.state_dict()
+    assert state["spec"] == {"speculate_k": 4, "draft_source": "quant",
+                             "draft_layers": 0,
+                             "draft_params_version": eng.params_version}
+    eng.save_snapshot()
+    pre = eng.pop_results()
+    del eng
+
+    restored = _engine("paged", speculate_k=4)
+    restored.load_state_dict(mgr.restore())
+    results = restored.run()
+    results.update(pre)
+    for r in reqs:
+        assert results[r.request_id].tokens == golden[r.request_id], \
+            f"spec request {r.request_id} diverged after resume"
+    bal = restored.pool.balance()
+    assert bal["conserved"] and bal["refcounts_accounted"], bal
+
+
 def test_restore_does_not_retrace():
     """A restored engine re-dispatches the warm executables: the paged
     fused-step trace counter is IDENTICAL before the snapshot and after
