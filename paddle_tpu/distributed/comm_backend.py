@@ -14,6 +14,12 @@ The collective schedule of each mesh axis is a pluggable backend:
     into the scatter destination (``ops/pallas_kernels/fused_collectives``)
     — no intermediate full-size HBM buffer is ever materialized.
 
+The pp axis binds the PIPELINE schedule the same way (``resolve_pp``):
+``ring`` runs the 1F1B/GPipe scan full-manual with ppermute boundary sends
+issued at tick end (overlapping the next microbatch's stage compute);
+``fused`` additionally runs each stage's last GEMM as a Pallas kernel whose
+epilogue issues the boundary RDMA (``fused_gemm_ppsend``).
+
 ``FLAGS_comm_backend`` is a comma-separated ``axis=backend`` list (e.g.
 ``"mp=fused,dp=ring"``); a bare backend name applies to every axis. The
 empty default hands control to the legacy flags (``FLAGS_mp_overlap`` ->
@@ -75,7 +81,7 @@ def parse(spec):
                        f"are {'/'.join(BACKENDS)} — entry ignored")
             continue
         if axis is None:
-            for a in ("dp", "mp"):
+            for a in ("dp", "mp", "pp"):
                 out[a] = backend
         else:
             out[axis] = backend
@@ -108,3 +114,172 @@ def fused_mesh_ok(mesh):
     alias of ops.pallas_kernels.fused_collectives.supported.)"""
     from ..ops.pallas_kernels import fused_collectives as _fc
     return _fc.supported(mesh)[0]
+
+
+# ---------------------------------------------------------------------------
+# pp axis: explicit pipeline-boundary schedule (FLAGS_comm_backend='pp=...')
+#
+#   * gspmd — the seed pipeline: partial-manual shard_map over 'pp' only,
+#     microbatches replicated into the region, the partitioner placing the
+#     `stage == k` selects (and involuntarily rematerializing around them);
+#   * ring  — FULL-manual shard_map over every mesh axis; the schedule's
+#     boundary sends are explicit `ppermute`s issued at the END of each
+#     scan tick so the hop rides the ICI while the next tick's stage GEMMs
+#     run. No tensor in the region is replicated-then-repartitioned, so
+#     the partitioner never sees the stage selects at all — the
+#     "[SPMD] Involuntary full rematerialization" warnings die structurally;
+#   * fused — ring, plus the LAST GEMM of each stage runs as a Pallas
+#     kernel whose epilogue issues the boundary RDMA directly
+#     (fused_collectives.fused_gemm_ppsend, the fused_gemm_ag pattern) with
+#     a custom VJP so the backward boundary hop is fused too.
+
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PpConfig:
+    """Static pp-axis schedule of one pipelined step (hashable — keys the
+    trace-time resolution memo and the step-record builders)."""
+    axis: str            # mesh axis name ("pp")
+    n: int               # stage count
+    backend: str         # "ring" | "fused" (gspmd resolves to None, not this)
+    schedule: str        # "gpipe" | "1f1b" — what the explicit path RUNS
+    wire_dtype: object   # boundary wire dtype, or None = compute dtype
+    fused_rdma: bool     # fused kernels may issue real remote DMA here
+
+    def kernel_meta(self, mesh):
+        if self.backend != "fused":
+            return None
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        return _fc.meta_for(mesh, self.axis)
+
+
+def pp_requested():
+    """The pp-axis backend FLAGS_comm_backend names (None = legacy gspmd)."""
+    return requested("pp")
+
+
+def pp_explicit_requested():
+    return requested("pp") in ("ring", "fused")
+
+
+def resolve_pp(config, mesh, batch=None, num_microbatches=1, sp=None):
+    """Decide whether the explicit pipeline schedule applies to this step.
+
+    Returns PpConfig or None (None = the seed GSPMD pipeline, byte-identical
+    to flags-off). `sp` is the step's resolved tp_overlap.SPConfig (or None):
+    the full-manual region binds EVERY mesh axis, so an active mp axis is
+    only composable when its schedule is ALSO explicit. Every bail warns
+    once naming the exact flag setting that would fix it."""
+    import jax.numpy as jnp
+
+    req = requested("pp")
+    if req in (None, "gspmd"):
+        return None
+    if mesh is None:
+        return None
+    pp = mesh.shape.get("pp", 1)
+    if pp <= 1:
+        return None
+    backend = req
+
+    def bail(key, msg):
+        _warn_once(key, msg + " — falling back to the GSPMD pp schedule")
+        return None
+
+    if getattr(config, "pp_interleave", 1) > 1:
+        return bail("pp-vpp", "the explicit pp schedule does not interleave "
+                    "virtual stages yet; set config.pp_interleave=1 (or keep "
+                    "VPP with FLAGS_comm_backend='pp=gspmd')")
+    if getattr(config, "zero3_params", False):
+        return bail("pp-zero3", "ZeRO stage-3 FSDP params need the GSPMD "
+                    "per-layer all-gather inside the stage scan, which a "
+                    "full-manual region cannot emit; set zero_stage=1 (host "
+                    "offload of optimizer moments composes either way)")
+    mp = mesh.shape.get("mp", 1)
+    if mp > 1 and sp is None:
+        return bail(("pp-mp", mp), "the explicit pp schedule binds the whole "
+                    "mesh manually, so an active mp axis needs an explicit "
+                    "mp schedule too; set FLAGS_comm_backend='mp=ring,pp="
+                    + backend + "' (FLAGS_sequence_parallel layout implied)")
+    extra = [a for a in mesh.axis_names
+             if a not in ("dp", "mp", "pp") and mesh.shape.get(a, 1) > 1]
+    if extra:
+        return bail(("pp-axes", tuple(extra)),
+                    f"the explicit pp schedule binds the whole mesh "
+                    f"manually; axes {extra} must be size 1 (set them to 1 "
+                    f"in create_hybrid_mesh, or keep "
+                    f"FLAGS_comm_backend='pp=gspmd')")
+    M = int(num_microbatches)
+    dp = mesh.shape.get("dp", 1)
+    if batch is not None:
+        if batch % M:
+            return bail(("pp-mb", batch, M),
+                        f"batch {batch} not divisible by num_microbatches="
+                        f"{M} (choose a microbatch count dividing the "
+                        f"global batch)")
+        if dp > 1 and (batch // M) % dp:
+            return bail(("pp-mb-dp", batch, M, dp),
+                        f"microbatch size {batch // M} not divisible by "
+                        f"dp={dp}; the explicit schedule shards each "
+                        f"microbatch over dp — lower num_microbatches (or "
+                        f"the dp degree)")
+    schedule = getattr(config, "pp_schedule", "1f1b") or "1f1b"
+    if backend == "fused" and sp is not None:
+        _warn_once(("pp-fused-mp", mp),
+                   "pp=fused boundary kernels take the plain (mp=1) block "
+                   "tail; with an explicit mp schedule active the boundary "
+                   "hop runs as FLAGS_comm_backend='pp=ring' instead")
+        backend = "ring"
+    fused_rdma = False
+    if backend == "fused":
+        if schedule == "1f1b":
+            # at a combined 1F1B tick the cotangent a stage consumes was
+            # produced one tick EARLIER on its down-neighbor — the hop is a
+            # schedule-level scan carry, which an intra-vjp kernel epilogue
+            # cannot express. The fused rung therefore runs the gpipe
+            # autodiff schedule (its custom VJP fuses the hop transposes).
+            _warn_once("pp-fused-1f1b",
+                       "pp=fused runs the gpipe autodiff schedule (the 1f1b "
+                       "combined tick needs a scan-carried cotangent hop); "
+                       "set FLAGS_comm_backend='pp=ring' to keep the 1f1b "
+                       "schedule explicit")
+            schedule = "gpipe"
+        from ..ops.pallas_kernels import fused_collectives as _fc
+        H = getattr(config, "hidden_size", 0)
+        ok, why = _fc.supported(mesh, shapes=(H,) if H else (), why="pp axis")
+        if ok:
+            fused_rdma = True
+        else:
+            _warn_once(("pp-fused-rdma", tuple(mesh.axis_names)),
+                       f"fused pp boundary RDMA unavailable: {why} — the "
+                       f"boundary runs the unfused GEMM tail with the hop "
+                       f"as an explicit ppermute (a single-axis "
+                       f"create_single_axis_mesh('pp', n) enables the full "
+                       f"RDMA kernel in interpret mode)")
+    # boundary wire dtype: grad_comm's wire-dtype vocabulary, 'auto' =
+    # the compute dtype (bf16 compute wires bf16 natively; stage grads
+    # accumulate fp32 in the 1f1b tick regardless — see pipeline.py)
+    raw = _flags().get("FLAGS_pp_wire_dtype", "auto")
+    wire = None
+    if raw not in ("auto", None, ""):
+        from .grad_comm import _WIRE_DTYPES
+        wire = _WIRE_DTYPES.get(raw, "?")
+        if wire == "?" or wire is jnp.int8:
+            _warn_once(("pp-wire", raw),
+                       f"FLAGS_pp_wire_dtype={raw!r} unsupported for the "
+                       f"boundary wire (float32/bfloat16/auto) — using the "
+                       f"compute dtype; set FLAGS_pp_wire_dtype='bfloat16' "
+                       f"for the compressed wire")
+            wire = None
+    if backend == "fused" and wire is not None:
+        _warn_once(("pp-fused-wire", raw),
+                   "pp=fused issues the boundary RDMA from the GEMM epilogue "
+                   "at the compute dtype (a cast copy would reintroduce the "
+                   "buffer the kernel exists to remove) — "
+                   "FLAGS_pp_wire_dtype ignored; set "
+                   "FLAGS_comm_backend='pp=ring' to compress the wire")
+        wire = None
+    return PpConfig(axis="pp", n=int(pp), backend=backend, schedule=schedule,
+                    wire_dtype=wire, fused_rdma=fused_rdma)

@@ -41,8 +41,8 @@ _elastic_lock = threading.Lock()
 def _zero_elastic():
     return {"shrinks": 0, "grows": 0, "reforms": 0, "elastic_restores": 0,
             "steps_lost": 0, "resume_latency_s_last": 0.0,
-            "resume_latency_s_total": 0.0, "active_dp": 0, "world_size": 0,
-            "failed_ranks": 0,
+            "resume_latency_s_total": 0.0, "active_dp": 0, "active_pp": 0,
+            "world_size": 0, "failed_ranks": 0,
             # serving fleet (serving/elastic.py): mp-group reforms after a
             # chip loss, grow-backs to the original degree, live gauges
             # for groups running below their configured mp / chips
@@ -341,9 +341,13 @@ class ElasticMeshSupervisor:
          (fresh heartbeats / ``chip_return_at``), so the mesh grows back;
       2. **re-forms** the mesh: the largest dp with ``min_dp <= dp <=
          survivors`` that divides ``global_batch`` (the global batch must
-         still shard evenly), over the surviving devices;
+         still shard evenly), over the surviving devices. With a ``pp``
+         target, the largest ``pp <= target`` dividing ``num_layers``
+         (stages stay layer-balanced) that still leaves a viable dp is
+         chosen FIRST — a pp4×dp2 job that loses a chip resumes as
+         pp2×dp<=3 and grows back to pp4 when the chip returns;
       3. **rebuilds** the TrainStep through ``step_factory(mesh)`` —
-         memoized per (dp, device-set), so growing back to a topology
+         memoized per (dp, pp, device-set), so growing back to a topology
          seen before reuses its compiled executables;
       4. **resumes** from ``ckpt.restore(None)``: the packed dp-sharded
          optimizer slots reshard to the new axis size on load
@@ -365,7 +369,8 @@ class ElasticMeshSupervisor:
 
     def __init__(self, step_factory, ckpt, global_batch, devices=None,
                  save_every=None, min_dp=None, grow=None, max_reforms=16,
-                 heartbeat_dir=None, heartbeat_timeout=None, on_event=None):
+                 heartbeat_dir=None, heartbeat_timeout=None, on_event=None,
+                 pp=1, num_layers=None):
         from .. import flags as _flags
         F = _flags._FLAGS
         self.step_factory = step_factory
@@ -373,6 +378,14 @@ class ElasticMeshSupervisor:
         self.global_batch = int(global_batch)
         self.devices = list(devices if devices is not None else jax.devices())
         self.world = len(self.devices)
+        # pipelined elastic training: ``pp`` is the TARGET stage count; on
+        # chip loss the mesh re-forms to the largest pp <= target that
+        # divides ``num_layers`` (stages must stay layer-balanced) and
+        # still leaves a viable dp for the survivors — growing back toward
+        # the target when chips return
+        self.pp_target = max(1, int(pp))
+        self.num_layers = None if num_layers is None else int(num_layers)
+        self.pp = 0                 # pp degree of the CURRENT mesh
         self.save_every = int(F.get("FLAGS_elastic_snapshot_every", 4)
                               if save_every is None else save_every)
         self.min_dp = int(F.get("FLAGS_elastic_min_dp", 1)
@@ -439,21 +452,39 @@ class ElasticMeshSupervisor:
             f"elastic: no viable mesh from {n_survivors} surviving ranks "
             f"(min_dp={self.min_dp}, global_batch={self.global_batch})")
 
+    def viable_pp(self, n_survivors):
+        """Largest pp with ``pp <= pp_target`` that divides ``num_layers``
+        AND leaves the survivors a viable dp (``dp*pp <= survivors`` with
+        ``viable_dp`` constraints). pp=1 is always layer-balanced, so a
+        plan exists whenever plain-dp elastic would find one."""
+        for p in range(min(self.pp_target, max(1, int(n_survivors))), 0, -1):
+            if self.num_layers is not None and self.num_layers % p:
+                continue
+            if int(n_survivors) // p >= self.min_dp:
+                return p
+        raise RuntimeError(
+            f"elastic: no viable mesh from {n_survivors} surviving ranks "
+            f"(pp_target={self.pp_target}, num_layers={self.num_layers}, "
+            f"min_dp={self.min_dp})")
+
     def _plan_active(self, failed):
-        """(dp, active ranks) the mesh would re-form to under ``failed``
-        — the cheap what-if ``run()`` uses to skip reforms whose active
-        set is unchanged (e.g. a retired spare flapping back)."""
+        """(dp, pp, active ranks) the mesh would re-form to under
+        ``failed`` — the cheap what-if ``run()`` uses to skip reforms
+        whose active set is unchanged (e.g. a retired spare flapping
+        back)."""
         survivors = [r for r in range(self.world) if r not in failed]
-        dp = self.viable_dp(len(survivors))
-        return dp, tuple(survivors[:dp])
+        pp = self.viable_pp(len(survivors))
+        dp = self.viable_dp(len(survivors) // pp)
+        return dp, pp, tuple(survivors[:dp * pp])
 
     def _reform(self, failed, target_step):
         from . import env as dist_env
         t0 = time.perf_counter()
-        dp, active = self._plan_active(failed)
+        dp, pp, active = self._plan_active(failed)
+        prev_n = self.dp * self.pp
         kind = ("start" if self.dp == 0 else
-                "shrink" if dp < self.dp else
-                "grow" if dp > self.dp else "reform")
+                "shrink" if dp * pp < prev_n else
+                "grow" if dp * pp > prev_n else "reform")
         devs = [self.devices[r] for r in active]
         if kind == "grow" and self.step is not None \
                 and not (set(failed) & set(self.active)):
@@ -469,8 +500,9 @@ class ElasticMeshSupervisor:
                 pass  # a failed async save must not block the grow
             self.ckpt.save(self.step._step, self.step.state_dict(),
                            blocking=True)
-        mesh = dist_env.create_hybrid_mesh(dp=dp, devices=devs)
-        key = (dp, tuple(getattr(d, "id", i) for i, d in enumerate(devs)))
+        mesh = dist_env.create_hybrid_mesh(dp=dp, pp=pp, devices=devs)
+        key = (dp, pp,
+               tuple(getattr(d, "id", i) for i, d in enumerate(devs)))
         state = self.ckpt.restore(None)
         step = self._steps.get(key)
         if step is None or state is None:
@@ -491,7 +523,7 @@ class ElasticMeshSupervisor:
         step.attach_checkpoint(self.ckpt, save_every=self.save_every)
         if self.monitor is not None:
             self.monitor.set_ranks(active)
-        self.step, self.dp = step, dp
+        self.step, self.dp, self.pp = step, dp, pp
         self.active, self.failed = tuple(active), frozenset(failed)
         if kind != "start":
             self.reforms += 1
@@ -508,8 +540,9 @@ class ElasticMeshSupervisor:
         _egauge("resume_latency_s_last", dt)
         _ecount("resume_latency_s_total", dt)
         _egauge("active_dp", dp)
+        _egauge("active_pp", pp)
         _egauge("failed_ranks", len(failed))
-        event = {"kind": kind, "dp": dp, "failed": sorted(failed),
+        event = {"kind": kind, "dp": dp, "pp": pp, "failed": sorted(failed),
                  "restored_step": restored, "fresh_start": state is None,
                  "latency_s": dt}
         self.events.append(event)
@@ -538,7 +571,7 @@ class ElasticMeshSupervisor:
             self._beat_all(t)
             failed = self._detect(t)
             if failed != self.failed:
-                if self._plan_active(failed)[1] == self.active:
+                if self._plan_active(failed)[2] == self.active:
                     # the active mesh is unchanged (a retired spare came
                     # back / another spare died): no reform — tearing
                     # down the live healthy step would discard progress

@@ -21,6 +21,8 @@ layers_per_stage] axis, sharded P('pp') on axis 0.
 from __future__ import annotations
 
 import functools
+import threading
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -403,6 +405,184 @@ def pipeline_spmd_interleaved_1f1b(block_fn, stage_params, x_mb, *,
     return pipe(stage_params, x_mb)
 
 
+# ---------------------------------------------------------------------------
+# explicit pp backend (FLAGS_comm_backend='pp=ring|fused'): the SAME schedules
+# rewritten to run under a FULL-manual shard_map over every mesh axis. The
+# partitioner never sees this region, so the `stage == k` selects operate on
+# per-device shards — no replicated-then-repartitioned tensor exists for
+# GSPMD to involuntarily rematerialize. Boundary sends are issued at the END
+# of each scan tick (the ppermute start rides the ICI while the next tick's
+# stage GEMMs run; the done lands where the next tick consumes it).
+#
+# Contract differences vs the gspmd schedules above:
+#   * x_mb is the LOCAL batch shard [M, mb/dp, ...] (in_spec P(None, 'dp'...))
+#     — not the replicated full microbatch array;
+#   * the result is STAGE-MAJOR: [1, M, mb/dp, ...] per device, out_spec
+#     P('pp', ...), and the caller slices stage S-1 outside the region. This
+#     is load-bearing for autodiff: an out_spec that mentions 'pp' makes the
+#     shard_map transpose hand each stage its own slice's cotangent verbatim
+#     (an UNMENTIONED manual axis would divide the cotangent by S — observed);
+#   * scan tick indices are explicitly int32: with jax_enable_x64 the default
+#     int64 `jnp.arange` mixed with the int32 `lax.axis_index` produces
+#     invalid partitioned HLO (s64/s32 compare) when the out_spec mentions
+#     the manual axis.
+
+
+def pipeline_ring_gpipe(block_fn, stage_params, x_mb, *, axis_name="pp",
+                        wire_dtype=None, boundary=None):
+    """Circular GPipe under full-manual: autodiff derives the backward
+    (reversed-ring, reversed-time) schedule, including the transpose of the
+    tick-end boundary send. `wire_dtype` compresses the boundary hop (e.g.
+    bf16 wire under fp32 compute); `boundary` is the fused rung's hook —
+    ``boundary(last_layer_params, h) -> (block_out, received)`` runs the
+    stage's LAST layer with the boundary send fused into its final GEMM's
+    epilogue (fused_collectives.fused_gemm_ppsend); the hook owns the hop,
+    so no separate ppermute is issued for it."""
+    S = env.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = x_mb.shape[0]
+    T = M + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    local_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    wire = jnp.dtype(wire_dtype) if wire_dtype is not None else x_mb.dtype
+
+    if boundary is None:
+        full_stage_fn = _stage_fn_of(block_fn)
+
+        def run_stage(act):
+            out = full_stage_fn(local_params, act)
+            recv = lax.ppermute(out.astype(wire), axis_name, perm)
+            return out, recv
+    else:
+        head = jax.tree_util.tree_map(lambda a: a[:-1], local_params)
+        last = jax.tree_util.tree_map(lambda a: a[-1], local_params)
+        head_fn = _stage_fn_of(block_fn)
+
+        def run_stage(act):
+            h = head_fn(head, act)
+            out, recv = boundary(last, h)
+            return out, recv.astype(wire)
+
+    outputs0 = jnp.zeros_like(x_mb)
+    recv0 = jnp.zeros(x_mb.shape[1:], wire)
+
+    def tick(carry, t):
+        t = t.astype(stage.dtype)
+        outputs, recv = carry
+        mb_idx = jnp.clip(t, 0, M - 1)
+        first_in = lax.dynamic_index_in_dim(x_mb, mb_idx, 0, keepdims=False)
+        inp = jnp.where(stage == 0, first_in, recv.astype(x_mb.dtype))
+        out, recv_next = run_stage(inp)
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        cur = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        write = jnp.logical_and(stage == S - 1, t >= S - 1)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, out, cur), out_idx, 0)
+        return (outputs, recv_next), None
+
+    (outputs, _), _ = lax.scan(tick, (outputs0, recv0),
+                               jnp.arange(T, dtype=jnp.int32))
+    # stage-major result; the last ring hop's cotangent closes the loop in
+    # the transpose — no masked-psum broadcast (its all-reduce is exactly
+    # the replicated tensor this path exists to kill)
+    return outputs[None]
+
+
+def pipeline_ring_1f1b(block_fn, stage_params, x_mb, *, axis_name="pp",
+                       wire_dtype=None, remat_policy=None):
+    """1F1B under full-manual — `pipeline_spmd_1f1b`'s hand-scheduled
+    backward (stash K=2S-1, combined fwd/bwd ticks, O(S) residency) with the
+    explicit-backend contract: boundary activations ride a `wire_dtype` hop
+    issued at tick end, cotangents ride the reversed ring the same way, and
+    per-stage param grads accumulate in the PARAM dtype (fp32 master params
+    give fp32 accumulation under a bf16 wire for free)."""
+    S = env.axis_size(axis_name)
+    M = x_mb.shape[0]
+    stage_fn = _stage_fn_of(block_fn, remat_policy)
+    wire = jnp.dtype(wire_dtype) if wire_dtype is not None else x_mb.dtype
+
+    @jax.custom_vjp
+    def pipe(sp, xm):
+        return pipeline_ring_gpipe(block_fn, sp, xm, axis_name=axis_name,
+                                   wire_dtype=wire_dtype)
+
+    def pipe_fwd(sp, xm):
+        return pipe(sp, xm), (sp, xm)
+
+    def pipe_bwd(res, g):
+        sp, xm = res
+        g = g[0]  # stage-major [1, M, mb, ...] output cotangent, this shard
+        local_params = jax.tree_util.tree_map(lambda a: a[0], sp)
+        stage = lax.axis_index(axis_name)
+        K = 2 * S - 1
+        T = M + 2 * S - 2
+        perm_down = [(i, (i + 1) % S) for i in range(S)]
+        perm_up = [(i, (i - 1) % S) for i in range(S)]
+        mb_shape = xm.shape[1:]
+
+        stash0 = jnp.zeros((K,) + mb_shape, xm.dtype)
+        recv_f0 = jnp.zeros(mb_shape, wire)
+        recv_b0 = jnp.zeros(mb_shape, wire)
+        pgrads0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype), local_params)
+        gx0 = jnp.zeros_like(xm)
+
+        def tick(carry, t):
+            t = t.astype(stage.dtype)
+            stash, recv_f, recv_b, pgrads, gx = carry
+
+            # ---- forward sub-tick: recompute the activation stream
+            fm = t - stage
+            f_act = jnp.logical_and(fm >= 0, fm < M)
+            first_in = lax.dynamic_index_in_dim(
+                xm, jnp.clip(fm, 0, M - 1), 0, keepdims=False)
+            inp = jnp.where(stage == 0, first_in, recv_f.astype(xm.dtype))
+            out_f = _gated_fwd(stage_fn, axis_name, f_act, local_params, inp)
+            slot_f = jnp.mod(fm, K)
+            cur = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+            stash = lax.dynamic_update_index_in_dim(
+                stash, jnp.where(f_act, inp, cur), slot_f, 0)
+
+            # ---- backward sub-tick
+            bm = t - 2 * (S - 1) + stage
+            b_act = jnp.logical_and(bm >= 0, bm < M)
+            slot_b = jnp.mod(bm, K)
+            stashed_in = lax.dynamic_index_in_dim(
+                stash, slot_b, 0, keepdims=False)
+            g_last = lax.dynamic_index_in_dim(
+                g, jnp.clip(bm, 0, M - 1), 0, keepdims=False)
+            g_out = jnp.where(stage == S - 1, g_last.astype(wire), recv_b)
+            gp, gi = _gated_vjp(stage_fn, axis_name, b_act, local_params,
+                                stashed_in, g_out.astype(stashed_in.dtype))
+            pgrads = jax.tree_util.tree_map(
+                lambda acc, gg: acc + gg.astype(acc.dtype), pgrads, gp)
+            write_gx = jnp.logical_and(b_act, stage == 0)
+            cur_gx = lax.dynamic_index_in_dim(
+                gx, jnp.clip(bm, 0, M - 1), 0, keepdims=False)
+            gx = lax.dynamic_update_index_in_dim(
+                gx, jnp.where(write_gx, gi.astype(gx.dtype), cur_gx),
+                jnp.clip(bm, 0, M - 1), 0)
+
+            # ---- boundary sends, issued at tick end: both hops ride the
+            # wire while the NEXT tick's stage fwd+bwd GEMMs run
+            recv_f = lax.ppermute(out_f.astype(wire), axis_name, perm_down)
+            recv_b = lax.ppermute(gi.astype(wire), axis_name, perm_up)
+            return (stash, recv_f, recv_b, pgrads, gx), None
+
+        carry0 = (stash0, recv_f0, recv_b0, pgrads0, gx0)
+        (_, _, _, pgrads, gx), _ = lax.scan(tick, carry0,
+                                            jnp.arange(T, dtype=jnp.int32))
+        g_sp = jax.tree_util.tree_map(lambda a: a[None], pgrads)
+        # xm entered as a batch shard replicated over 'pp' only; mask the
+        # cotangent to stage 0's contribution WITHOUT a psum — shard_map's
+        # transpose already psums over the in_spec-unmentioned pp axis
+        gx = jnp.where(stage == 0, gx, jnp.zeros_like(gx))
+        return g_sp, gx
+
+    pipe.defvjp(pipe_fwd, pipe_bwd)
+    return pipe(stage_params, x_mb)
+
+
 def vpp_storage_perm(L, S, V):
     """Stage-major storage order for interleaved VPP: storage slot
     s*(V*Lc)+v*Lc+p holds logical layer (v*S+s)*Lc+p. Stacked params
@@ -417,7 +597,9 @@ def vpp_storage_perm(L, S, V):
 
 def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
                  axis_name="pp", data_spec=P(), schedule="gpipe",
-                 interleave=1, vpp_stage_major=False, remat_policy=None):
+                 interleave=1, vpp_stage_major=False, remat_policy=None,
+                 backend=None, pp_param_specs=None, x_spec=None,
+                 wire_dtype=None, boundary=None):
     """Host-side wrapper: shard_map(manual over 'pp', auto elsewhere).
 
     stacked_params: pytree, leaves [S * local_L, ...] stacked layer params.
@@ -428,6 +610,15 @@ def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
     `vpp_storage_perm` order so the interleaved reshape is contiguous and
     the 'pp' sharding of storage matches chunk placement exactly (avoids
     XLA's involuntary full rematerialization of every block param).
+
+    ``backend`` 'ring'|'fused' (comm_backend.resolve_pp) switches to the
+    FULL-manual explicit schedules (`pipeline_ring_*`): every mesh axis is
+    bound, so ``pp_param_specs`` must give the stacked leaves' full specs
+    (leading 'pp'; e.g. gpt_param_specs' blocks) and ``x_spec`` the batch
+    activation spec — any axis they name is sharded INTO the region instead
+    of replicated-then-repartitioned by the partitioner. ``boundary`` is the
+    fused rung's last-GEMM hook (see pipeline_ring_gpipe); 'fused' without a
+    boundary runs identically to 'ring'.
     """
     mesh = mesh or env.get_mesh()
     S = mesh.shape[axis_name]
@@ -456,6 +647,41 @@ def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
     param_specs = jax.tree_util.tree_map(
         lambda a: P("pp", *([None] * (a.ndim - 1))), staged)
 
+    if backend in ("ring", "fused"):
+        if V > 1:
+            raise ValueError(
+                "the explicit pp backend does not interleave virtual stages"
+                " (comm_backend.resolve_pp gates this)")
+        if pp_param_specs is not None:
+            # stacked-leaf specs (leading 'pp' over [L, ...]) -> staged
+            # [S, L/S, ...]: the layer dim splits in two, sharding unchanged
+            param_specs = jax.tree_util.tree_map(
+                lambda a, s: P("pp", None, *tuple(s)[1:]),
+                staged, pp_param_specs)
+        xs = tuple(x_spec) if x_spec is not None else ()
+        if schedule == "1f1b":
+            inner = functools.partial(
+                pipeline_ring_1f1b, block_fn, axis_name=axis_name,
+                wire_dtype=wire_dtype, remat_policy=remat_policy)
+        else:
+            if remat_policy is not None:
+                raise ValueError(
+                    "remat_policy requires the 1f1b schedule (the gpipe "
+                    "autodiff path derives its own recompute from the scan)")
+            inner = functools.partial(
+                pipeline_ring_gpipe, block_fn, axis_name=axis_name,
+                wire_dtype=wire_dtype, boundary=boundary)
+        mapped = env.shard_map_compat(
+            lambda p, xm: inner(p, xm), mesh=mesh,
+            in_specs=(param_specs, P(None, *xs)),
+            out_specs=P("pp", None, *xs), axis_names=None)
+        out_smb = mapped(staged, x_mb)
+        # stage-major [S, M, mb, ...]: slice the last stage's outputs (the
+        # one cross-stage broadcast of the step, replacing the seed's
+        # masked-psum of the whole output buffer every scan tick)
+        out_mb = lax.index_in_dim(out_smb, S - 1, 0, keepdims=False)
+        return out_mb.reshape((B,) + out_mb.shape[2:])
+
     if V > 1:
         assert schedule == "1f1b", "interleaving requires the 1f1b schedule"
         spmd = functools.partial(pipeline_spmd_interleaved_1f1b,
@@ -476,6 +702,114 @@ def run_pipeline(block_fn, stacked_params, x, num_microbatches, mesh=None,
         axis_names=frozenset({axis_name}))
     out_mb = mapped(staged, x_mb)
     return out_mb.reshape((B,) + out_mb.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# static schedule ledger + per-step counters (profiler.pp_comm_counters —
+# the pp-axis sibling of tp_overlap's mp ledger and grad_comm's dp ledger)
+
+
+@dataclass
+class PpStepRecord:
+    """Per-device pp-axis boundary traffic of one executed step (fwd+bwd).
+    ``bubble_fraction`` is the schedule's idle-slot estimate — gpipe
+    (S-1)/(M+S-1), 1f1b (2S-2)/(M+2S-2) — not a measurement."""
+    backend: str = "gspmd"       # the pp backend that produced this step
+    schedule: str = "gpipe"
+    stages: int = 1
+    microbatches: int = 1
+    boundary_bytes: int = 0      # wire bytes over the boundary hops
+    ppermute_hops: int = 0       # explicit ppermutes issued (ring/fused)
+    fused_dispatches: int = 0    # boundary Pallas kernel launches (fused)
+    bubble_fraction: float = 0.0
+
+
+def bubble_fraction(schedule, S, M):
+    """Idle-slot fraction of the schedule at S stages, M microbatches."""
+    if S <= 1:
+        return 0.0
+    if schedule == "1f1b":
+        return (2 * S - 2) / (M + 2 * S - 2)
+    return (S - 1) / (M + S - 1)
+
+
+def gpt_pp_step_record(config, ppc, batch, seq, num_microbatches, S=None,
+                       mp=1):
+    """Ledger of one gpt_hybrid pipelined step. ``ppc`` is the resolved
+    comm_backend.PpConfig or None (None = GSPMD schedule: backend label and
+    bubble estimate only — the partitioner owns that wire traffic)."""
+    import jax.numpy as _jnp
+    S = int(ppc.n if ppc is not None else S)
+    M = int(num_microbatches)
+    sched = (ppc.schedule if ppc is not None
+             else (getattr(config, "pp_schedule", "1f1b") or "1f1b"))
+    rec = PpStepRecord(backend=ppc.backend if ppc is not None else "gspmd",
+                       schedule=sched, stages=S, microbatches=M,
+                       bubble_fraction=bubble_fraction(sched, S, M))
+    if ppc is None:
+        return rec
+    compute = _jnp.dtype(config.compute_dtype or "float32")
+    wire = _jnp.dtype(ppc.wire_dtype) if ppc.wire_dtype is not None \
+        else compute
+    # one boundary hop moves the LOCAL microbatch activation shard
+    hop_bytes = (batch // M) * (seq // mp) * config.hidden_size \
+        * wire.itemsize
+    T_fwd = M + S - 1
+    if sched == "1f1b":
+        # fwd = the gpipe stream (custom-vjp primal), bwd = T=M+2S-2
+        # combined ticks x (one activation hop down + one cotangent hop up)
+        hops = T_fwd + 2 * (M + 2 * S - 2)
+    else:
+        hops = 2 * T_fwd  # autodiff'd transpose mirrors the fwd hops
+    rec.boundary_bytes = hops * hop_bytes
+    if ppc.backend == "fused" and ppc.fused_rdma:
+        rec.fused_dispatches = 2 * T_fwd  # one boundary kernel per tick
+        # the kernel epilogue's RDMA replaces the fwd/bwd boundary
+        # ppermutes; only the 1f1b-style scheduling hops remain (none
+        # on the gpipe schedule the fused rung runs)
+        hops -= 2 * T_fwd
+    rec.ppermute_hops = hops
+    return rec
+
+
+_pp_lock = threading.Lock()
+
+
+def _zero_pp_counters():
+    return {"steps": 0, "boundary_bytes": 0, "ppermute_hops": 0,
+            "fused_dispatches": 0, "backend": {}, "schedule": "",
+            "stages": 0, "microbatches": 0, "bubble_fraction": 0.0}
+
+
+_pp_counters = _zero_pp_counters()
+
+
+def record_pp_step(rec: PpStepRecord | None):
+    if rec is None:
+        return
+    with _pp_lock:
+        _pp_counters["steps"] += 1
+        _pp_counters["boundary_bytes"] += rec.boundary_bytes
+        _pp_counters["ppermute_hops"] += rec.ppermute_hops
+        _pp_counters["fused_dispatches"] += rec.fused_dispatches
+        _pp_counters["backend"]["pp"] = rec.backend
+        _pp_counters["schedule"] = rec.schedule
+        _pp_counters["stages"] = rec.stages
+        _pp_counters["microbatches"] = rec.microbatches
+        _pp_counters["bubble_fraction"] = rec.bubble_fraction
+
+
+def pp_counters():
+    with _pp_lock:
+        out = dict(_pp_counters)
+        out["backend"] = dict(out["backend"])
+    return out
+
+
+def reset_pp_counters():
+    global _pp_counters
+    with _pp_lock:
+        _pp_counters = _zero_pp_counters()
 
 
 # ---------------------------------------------------------------------------

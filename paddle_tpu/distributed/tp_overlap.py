@@ -347,14 +347,22 @@ def resolve_gpt(config, mesh, batch=None, seq=None):
         _warn_once(key, msg + " — falling back to the GSPMD mp schedule")
         return None
 
+    allowed = ("dp", "mp")
+    from . import comm_backend as _cb
+    if _cb.pp_explicit_requested():
+        # the explicit pipeline (comm_backend.resolve_pp) binds the whole
+        # mesh manually and runs the per-shard sp block INSIDE its region —
+        # an active pp axis composes instead of blocking the sp schedule
+        allowed = ("dp", "mp", "pp")
     extra = [a for a in mesh.axis_names
-             if a not in ("dp", "mp") and mesh.shape.get(a, 1) > 1]
+             if a not in allowed and mesh.shape.get(a, 1) > 1]
     if extra:
         return bail(("axes", tuple(extra)),
                     f"sequence parallelism binds the whole mesh manually; "
                     f"axes {extra} must be size 1 (set them to 1 in "
-                    f"create_hybrid_mesh, or drop the explicit schedule "
-                    f"with FLAGS_comm_backend='mp=gspmd')")
+                    f"create_hybrid_mesh, set FLAGS_comm_backend='pp=ring' "
+                    f"to compose an active pp axis, or drop the explicit "
+                    f"schedule with FLAGS_comm_backend='mp=gspmd')")
     H = config.hidden_size
     if H % mp or config.num_heads % mp or (config.ffn_mult * H) % mp:
         return bail(("dims", H, config.num_heads, mp),

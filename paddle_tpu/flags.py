@@ -367,12 +367,28 @@ _FLAGS = {
     #           (ops/pallas_kernels/fused_collectives.py).
     # Naming mp=ring/fused implies the sequence-parallel activation layout;
     # naming dp=ring/fused implies the explicit grad-comm schedule. The
-    # empty default keeps the legacy flags in charge (FLAGS_mp_overlap ->
-    # mp=ring, FLAGS_grad_comm/FLAGS_weight_update_sharding -> dp=ring) and
-    # the flags-off program byte-identical to the seed. Ineligible
-    # selections fall back one rung (fused -> ring -> gspmd) with a
-    # once-per-reason warning naming the exact flag that would fix it.
+    # pp axis selects the PIPELINE-boundary schedule (distributed/
+    # pipeline.py): pp=gspmd keeps the seed's partial-manual pipeline;
+    # pp=ring rewrites the gpipe/1f1b schedule fully manually with the
+    # boundary activation/cotangent ppermutes issued at the end of each
+    # scan tick (the hop rides the wire while the next tick's stage GEMMs
+    # run, and the partitioner never sees a replicated stage select —
+    # involuntary-remat warnings die structurally); pp=fused additionally
+    # runs each stage's LAST GEMM as a Pallas kernel whose epilogue issues
+    # the boundary RDMA directly (fused_collectives.fused_gemm_ppsend,
+    # custom VJP for the backward tick). The empty default keeps the
+    # legacy flags in charge (FLAGS_mp_overlap -> mp=ring,
+    # FLAGS_grad_comm/FLAGS_weight_update_sharding -> dp=ring) and the
+    # flags-off program byte-identical to the seed. Ineligible selections
+    # fall back one rung (fused -> ring -> gspmd) with a once-per-reason
+    # warning naming the exact flag that would fix it.
     "FLAGS_comm_backend": "",
+    # Boundary wire dtype of the explicit pp schedule (grad_comm's wire
+    # vocabulary: "auto" | "float32" | "bfloat16"). "auto" wires the
+    # compute dtype; "bfloat16" halves boundary bytes while every stage
+    # still accumulates fp32 (pp=fused ignores this — its RDMA leaves the
+    # GEMM epilogue at the compute dtype).
+    "FLAGS_pp_wire_dtype": "auto",
 }
 
 

@@ -270,14 +270,19 @@ def ln_fp32(x, g, b, eps):
         x.dtype) + b.astype(x.dtype)
 
 
-def gpt_block_fn(config: GPTConfig):
+def gpt_block_prelude_fn(config: GPTConfig):
+    """The block minus its final down-projection: (p, x) -> (resid, gact)
+    where resid is the post-attention residual stream and gact the gelu
+    activation — the (r, x) operands of the boundary GEMM the fused pp
+    backend runs in-kernel (fused_collectives.fused_gemm_ppsend). The
+    full block is prelude + ``resid + (gact @ down_w + down_b)``."""
     nh = config.num_heads
     eps = config.layer_norm_epsilon
 
     def ln(x, g, b):
         return ln_fp32(x, g, b, eps)
 
-    def block(p, x):
+    def prelude(p, x):
         B, S, H = x.shape
         d = H // nh
         h1 = ln(x, p["ln1_g"], p["ln1_b"])
@@ -302,10 +307,42 @@ def gpt_block_fn(config: GPTConfig):
         h2 = ln(x, p["ln2_g"], p["ln2_b"])
         up = h2 @ p["up_w"].astype(x.dtype) + p["up_b"].astype(x.dtype)
         up = jax.nn.gelu(up, approximate=True)
+        return x, up
+
+    return prelude
+
+
+def gpt_block_fn(config: GPTConfig):
+    prelude = gpt_block_prelude_fn(config)
+
+    def block(p, x):
+        x, up = prelude(p, x)
         down = up @ p["down_w"].astype(x.dtype) + p["down_b"].astype(x.dtype)
         return x + down
 
     return block
+
+
+def gpt_fused_boundary(config: GPTConfig, meta, rdma):
+    """``boundary(last_layer_params, h)`` for ``run_pipeline(boundary=...)``
+    (FLAGS_comm_backend='pp=fused'): the stage's LAST block runs with its
+    down-projection GEMM fused with the boundary RDMA — the kernel's
+    epilogue puts the stage output on the wire to the down-ring neighbor
+    directly, returning (stage output, received up-neighbor output)."""
+    prelude = gpt_block_prelude_fn(config)
+    from ..ops.pallas_kernels import fused_collectives as _fc
+
+    def boundary(p, h):
+        B, S, H = h.shape
+        resid, gact = prelude(p, h)
+        inner = gact.shape[-1]
+        y, recv = _fc.fused_gemm_ppsend(
+            meta, rdma, (B, S), gact.reshape(B * S, inner),
+            p["down_w"].astype(h.dtype), p["down_b"].astype(h.dtype),
+            resid.reshape(B * S, H))
+        return y.reshape(B, S, H), recv.reshape(B, S, H)
+
+    return boundary
 
 
 # functional block-param key -> submodule path inside one GPT block. THE

@@ -125,8 +125,24 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
     x = params["wte"].astype(compute)[ids] + \
         params["wpe"].astype(compute)[None, :S]
     from ..distributed import tp_overlap as _tp
+    from ..distributed import comm_backend as _cb
     sp = _tp.resolve_gpt(config, mesh, batch=B, seq=S) \
         if mesh is not None else None
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    ppc = _cb.resolve_pp(config, mesh, batch=B,
+                         num_microbatches=num_microbatches, sp=sp) \
+        if pp > 1 else None
+    if pp > 1 and ppc is None and sp is not None:
+        # resolve_gpt admitted the pp axis on the explicit schedule's
+        # behalf, but resolve_pp fell back — the GSPMD pipeline cannot run
+        # the per-shard sp block, so both axes run GSPMD this step
+        _cb._warn_once("pp-sp-gspmd",
+                       "the explicit mp schedule composes with pp>1 only "
+                       "through the explicit pp schedule, which just fell "
+                       "back (see the pp warning above) — running GSPMD on "
+                       "both axes")
+        sp = None
+    x_spec = None
     if mesh is not None:
         # seq-parallel entry: the vocab-sharded embedding's psum lands
         # directly in the seq-sharded layout (a reduce-scatter, GSPMD-emitted
@@ -138,24 +154,28 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
             else P(batch_axis, None, None)
         x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, x_spec))
     block = gpt_block_fn(config)
-    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     from ..distributed.recompute import POLICIES
     pol_name = getattr(config, "remat_policy", "full") or "full"
     if pol_name not in POLICIES:
         raise ValueError(f"unknown remat_policy {pol_name!r}; "
                          f"choose from {sorted(POLICIES)}")
     if pp > 1:
-        if (jax.default_backend() == "cpu"
+        if (ppc is None and jax.default_backend() == "cpu"
                 and jnp.dtype(compute) == jnp.dtype(jnp.bfloat16)):
             # XLA's CPU backend hard-aborts ("Invalid binary instruction
-            # opcode copy", hlo_instruction.cc:1585) partitioning the
+            # opcode copy", hlo_instruction.cc:1585) PARTITIONING the
             # bf16 ppermute pipeline — fail with a catchable error instead
-            # of killing the interpreter. TPU (the real target) is fine.
+            # of killing the interpreter. TPU (the real target) is fine,
+            # and so is the explicit full-manual schedule (nothing left
+            # for the partitioner to partition): bf16 pipelines run on CPU
+            # under FLAGS_comm_backend='pp=ring' (or 'pp=fused').
             raise ValueError(
                 "pipeline parallelism with compute_dtype='bfloat16' "
-                "crashes the XLA CPU backend; use compute_dtype='float32' "
-                "for CPU runs (bf16 is for TPU)")
-        schedule = getattr(config, "pp_schedule", "1f1b")
+                "crashes the XLA CPU backend under the GSPMD pp schedule; "
+                "set FLAGS_comm_backend='pp=ring' (the explicit schedule "
+                "wires bf16 fine) or compute_dtype='float32' for CPU runs")
+        schedule = ppc.schedule if ppc is not None \
+            else getattr(config, "pp_schedule", "1f1b")
         pol = POLICIES[pol_name]
         if pol is not None and schedule != "1f1b":
             import warnings
@@ -171,12 +191,40 @@ def gpt_hidden(params, ids, config: GPTConfig, mesh=None, num_microbatches=1):
         # Under VPP the hybrid step stores blocks in vpp_storage_perm order
         # (see HybridTrainStep.__post_init__), so reshaping to chunks is
         # contiguous and needs no cross-device reshard.
+        pk = {}
+        if ppc is not None:
+            # explicit (full-manual) schedule: hand run_pipeline the real
+            # stacked-leaf specs and the activation spec so every input is
+            # sharded INTO the region — no tensor is replicated-then-
+            # repartitioned, so the partitioner never sees the stage
+            # selects (the involuntary-remat warnings die structurally)
+            blocks_specs = gpt_param_specs(config, pp=pp)["blocks"]
+            blocks_specs = {
+                k: P(*(a if (a is None or a in mesh.axis_names) else None
+                       for a in tuple(s)))
+                for k, s in blocks_specs.items()}
+            boundary = None
+            if ppc.backend == "fused":
+                from .gpt import gpt_fused_boundary
+                boundary = gpt_fused_boundary(config, ppc.kernel_meta(mesh),
+                                              ppc.fused_rdma)
+            if sp is not None:
+                # per-shard sp block runs UNWRAPPED inside the pipeline's
+                # full-manual region (make_sp_block's own shard_map would
+                # nest); the pipeline in_specs deliver the mp-sharded
+                # weights and seq-sharded activations it expects
+                block = _tp.sp_block_fn(config, sp.n, axis=sp.axis,
+                                        backend=sp.backend,
+                                        meta=sp.kernel_meta(mesh))
+            pk = dict(backend=ppc.backend, pp_param_specs=blocks_specs,
+                      x_spec=x_spec, wire_dtype=ppc.wire_dtype,
+                      boundary=boundary)
         x = run_pipeline(block, params["blocks"], x, num_microbatches, mesh=mesh,
                          schedule=schedule,
                          interleave=getattr(config, "pp_interleave", 1),
                          vpp_stage_major=getattr(config, "vpp_stage_major",
                                                  False),
-                         remat_policy=pol)
+                         remat_policy=pol, **pk)
     else:
         if sp is not None:
             block = _tp.make_sp_block(config, mesh, sp)
@@ -245,7 +293,18 @@ class HybridTrainStep:
             self.config.vpp_stage_major = True
         mp = self.mesh.shape.get("mp", 1) if self.mesh is not None else 1
         from ..distributed import tp_overlap as _tp
-        if (_tp.explicit_mp_requested() and mp > 1 and pp == 1
+        from ..distributed import comm_backend as _cb
+        if self.zero_stage >= 3 and not getattr(self.config, "zero3_params",
+                                                False):
+            # record FSDP-sharded params on a private config copy so
+            # trace-time resolvers (comm_backend.resolve_pp) can see it —
+            # the explicit pp schedule cannot emit the per-layer stage-3
+            # all-gather and must bail on such steps
+            import copy
+            self.config = copy.copy(self.config)
+            self.config.zero3_params = True
+        if (_tp.explicit_mp_requested() and mp > 1
+                and (pp == 1 or _cb.pp_explicit_requested())
                 and self.config.hidden_size % mp == 0
                 and self.config.num_heads % mp == 0):
             # head-major qkv storage so a contiguous 1/mp column shard is
@@ -430,14 +489,30 @@ class HybridTrainStep:
         shape_key = tuple(ids.shape)
         if shape_key not in recs:
             from ..distributed import tp_overlap as _tp
+            from ..distributed import comm_backend as _cb
+            from ..distributed import pipeline as _pl
             B, S = ids.shape
             sp = _tp.resolve_gpt(self.config, self.mesh, batch=B, seq=S) \
                 if self.mesh is not None else None
-            recs[shape_key] = (_tp.gpt_step_record(self.config, sp, B, S)
-                               if sp is not None else None)
-        if recs[shape_key] is not None:
+            pp = self.mesh.shape.get("pp", 1) if self.mesh is not None else 1
+            ppc = _cb.resolve_pp(self.config, self.mesh, batch=B,
+                                 num_microbatches=self.num_microbatches,
+                                 sp=sp) if pp > 1 else None
+            if pp > 1 and ppc is None:
+                sp = None  # mirrors gpt_hidden's trace-time fallback
+            sp_rec = _tp.gpt_step_record(self.config, sp, B, S) \
+                if sp is not None else None
+            pp_rec = _pl.gpt_pp_step_record(
+                self.config, ppc, B, S, self.num_microbatches, S=pp,
+                mp=sp.n if sp is not None else 1) if pp > 1 else None
+            recs[shape_key] = (sp_rec, pp_rec)
+        sp_rec, pp_rec = recs[shape_key]
+        if sp_rec is not None:
             from ..distributed import tp_overlap as _tp
-            _tp.record_step(recs[shape_key])
+            _tp.record_step(sp_rec)
+        if pp_rec is not None:
+            from ..distributed import pipeline as _pl
+            _pl.record_pp_step(pp_rec)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         flat_params = self._flat(self.params)
         offload_out = self.offload and not self._offload_in_jit
@@ -451,8 +526,11 @@ class HybridTrainStep:
             from ..observability.flops import train_step_flops
             B, S = ids.shape
             flops, _ = train_step_flops(self.config, B, S)
-            rec = recs[shape_key]
-            wire = None if rec is None else int(rec.rs_bytes + rec.ag_bytes)
+            wire = None
+            if sp_rec is not None:
+                wire = int(sp_rec.rs_bytes + sp_rec.ag_bytes)
+            if pp_rec is not None and pp_rec.boundary_bytes:
+                wire = (wire or 0) + int(pp_rec.boundary_bytes)
             self._tel.end(t_tel, self._step_count, loss, tokens=B * S,
                           flops=flops, wire_bytes=wire)
         if offload_out:

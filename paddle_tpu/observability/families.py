@@ -32,6 +32,11 @@ def _mp_comm():
     return tp_overlap.mp_counters()
 
 
+def _pp_comm():
+    from ..distributed import pipeline
+    return pipeline.pp_counters()
+
+
 def _fault():
     from ..jit import train_step as _ts
     from ..incubate import checkpoint as _ck
@@ -75,6 +80,7 @@ def register_default_families():
     REGISTRY.register_family("dispatch", _dispatch)
     REGISTRY.register_family("comm", _comm)
     REGISTRY.register_family("mp_comm", _mp_comm)
+    REGISTRY.register_family("pp_comm", _pp_comm)
     REGISTRY.register_family("fault", _fault)
     REGISTRY.register_family("serving", _serving)
     REGISTRY.register_family("recovery", _recovery)

@@ -66,7 +66,8 @@ _SMEM = pl.BlockSpec(memory_space=pltpu.SMEM)
 # distinct Mosaic collective ids per kernel family (barrier semaphores of
 # concurrently-compiled kernels must not alias)
 _CID = {"ag_gemm": 0, "gemm_rs": 1, "ag_accum": 2, "rs_bucket": 3,
-        "ag_bucket": 4, "gemm_ag": 5, "gemm_ag_q": 6}
+        "ag_bucket": 4, "gemm_ag": 5, "gemm_ag_q": 6,
+        "gemm_ppsend": 7, "gemm_pprecv": 8}
 
 
 def interpret_default():
@@ -773,6 +774,206 @@ fused_gemm_rs.defvjp(_gemm_rs_fwd, _gemm_rs_bwd)
 
 
 # ---------------------------------------------------------------------------
+# pipeline-boundary kernels (FLAGS_comm_backend='pp=fused'): the LAST GEMM
+# of a pipeline stage (the block's down-projection, r + (x @ w + b)) runs
+# row-chunked, and each chunk's boundary RDMA to the down-ring neighbor is
+# issued the moment its rows retire — the next chunk's GEMM runs under the
+# transfer, so the stage-boundary activation send costs no serial time and
+# never takes an HBM round trip between the epilogue and the wire.
+
+
+def _pp_chunks(R):
+    for c in (8, 4, 2):
+        if R % c == 0 and R // c >= 1 and R >= c:
+            return c
+    return 1
+
+
+def _gemm_ppsend_kernel(nbr_ref, x_ref, w_ref, b_ref, r_ref, y_ref,
+                        recv_ref, send_sem, recv_sem, *, C, interpret):
+    """y = r + (x @ w + b), the boundary rows RDMA'd to the RIGHT
+    (down-ring) neighbor's recv_ref in C pipelined chunks straight from
+    the GEMM epilogue — the first bytes are on the wire while later
+    chunks are still being issued, and the boundary activation never
+    takes an HBM round trip before the transfer. The GEMM itself runs as
+    ONE full-matrix matmul: a row-chunked dot takes a shape-dependent
+    accumulation path, and the fused rung must stay BITWISE equal to the
+    unfused stage tail. Destination rows are disjoint per chunk, so two
+    in-flight transfers (double-buffered semaphore slots) need no extra
+    capacity backpressure: slot c%2 was last waited at iteration c-1."""
+    idx, right, left = nbr_ref[0], nbr_ref[1], nbr_ref[2]
+    barrier = _barrier(interpret)
+    if barrier:
+        barrier(left, right)
+    # plain matmul + the block tail's exact op order (r + (x@w + b))
+    y_ref[...] = (r_ref[...] +
+                  (x_ref[...] @ w_ref[...] + b_ref[0])).astype(y_ref.dtype)
+    R = y_ref.shape[0]
+    rc = R // C
+    dmas = []
+    for c in range(C):
+        lo = c * rc
+        hi = lo + rc
+        dma = _rdma(y_ref.at[lo:hi], recv_ref.at[lo:hi],
+                    send_sem.at[c % 2], recv_sem.at[c % 2], right)
+        dma.start()
+        dmas.append(dma)
+        if c > 0:
+            dmas[c - 1].wait()
+    dmas[C - 1].wait()
+
+
+def _gemm_pprecv_kernel(nbr_ref, gy_ref, grecv_ref, x_ref, w_ref, dx_ref,
+                        dw_ref, dr_ref, gwire_ref, send_sem,
+                        recv_sem, *, C, interpret):
+    """Backward tick of the fused boundary: the received-value cotangent
+    ``grecv`` rides UP the ring (to the left neighbor — the transpose of
+    the forward hop) chunk by chunk while dx/dr rows for the previous
+    chunk compute under the transfer. dw/db run ONCE over the fully
+    assembled cotangent at the end — chunked accumulation would change
+    the summation order and break bitwise parity with the lax reference.
+    The same goes for a row-chunked dx dot (shape-dependent accumulation),
+    so the per-arrival work is the elementwise cotangent assembly
+    (row-independent, bitwise-safe) and all three GEMM-class reductions
+    run full-matrix after the last chunk lands."""
+    idx, right, left = nbr_ref[0], nbr_ref[1], nbr_ref[2]
+    barrier = _barrier(interpret)
+    if barrier:
+        barrier(left, right)
+    R = gy_ref.shape[0]
+    rc = R // C
+
+    def consume(c):
+        lo = c * rc
+        hi = lo + rc
+        dr_ref[lo:hi] = (gy_ref[lo:hi] +
+                         gwire_ref[lo:hi].astype(gy_ref.dtype))
+
+    dmas = []
+    for c in range(C):
+        lo = c * rc
+        hi = lo + rc
+        dma = _rdma(grecv_ref.at[lo:hi], gwire_ref.at[lo:hi],
+                    send_sem.at[c % 2], recv_sem.at[c % 2], left)
+        dma.start()
+        dmas.append(dma)
+        if c > 0:
+            dmas[c - 1].wait()
+            consume(c - 1)
+    dmas[C - 1].wait()
+    consume(C - 1)
+    cot = dr_ref[...]
+    # exact dimension numbers autodiff emits: d(x@w)/dx = g @ w^T
+    dx_ref[...] = lax.dot_general(
+        cot, w_ref[...], (((1,), (1,)), ((), ()))).astype(dx_ref.dtype)
+    # d(x@w)/dw = x^T g, as the contraction autodiff emits (dims 0/0).
+    # The bias cotangent is NOT reduced here: an interpret-mode in-kernel
+    # reduce takes a different accumulation order than the XLA-compiled
+    # reduce autodiff emits — the wrapper reduces dr at the JAX level.
+    dw_ref[...] = lax.dot_general(
+        x_ref[...], cot, (((0,), (0,)), ((), ()))).astype(dw_ref.dtype)
+
+
+def _sems_pp():
+    return [pltpu.SemaphoreType.DMA((2,)), pltpu.SemaphoreType.DMA((2,))]
+
+
+def _gemm_ppsend_call(meta, x, w, b, r):
+    _count("gemm_ppsend")
+    R, K = x.shape
+    F = w.shape[1]
+    C = _pp_chunks(R)
+    y, recv = pl.pallas_call(
+        functools.partial(_gemm_ppsend_kernel, C=C,
+                          interpret=meta.interpret),
+        out_shape=(jax.ShapeDtypeStruct((R, F), r.dtype),
+                   jax.ShapeDtypeStruct((R, F), r.dtype)),
+        in_specs=[_SMEM, _VMEM, _VMEM, _VMEM, _VMEM],
+        scratch_shapes=_sems_pp(),
+        interpret=meta.interpret,
+        **_compiler_params("gemm_ppsend", meta.interpret),
+    )(_nbr(meta), x, w, b.reshape(1, F), r)
+    return y, recv
+
+
+def _gemm_pprecv_call(meta, gy, grecv, x, w):
+    _count("gemm_pprecv")
+    R, F = gy.shape
+    K = x.shape[1]
+    C = _pp_chunks(R)
+    dx, dw, dr = pl.pallas_call(
+        functools.partial(_gemm_pprecv_kernel, C=C,
+                          interpret=meta.interpret),
+        out_shape=(jax.ShapeDtypeStruct((R, K), x.dtype),
+                   jax.ShapeDtypeStruct((K, F), w.dtype),
+                   jax.ShapeDtypeStruct((R, F), gy.dtype)),
+        in_specs=[_SMEM, _VMEM, _VMEM, _VMEM, _VMEM],
+        scratch_shapes=[pltpu.VMEM((R, F), gy.dtype)] + _sems_pp(),
+        interpret=meta.interpret,
+        **_compiler_params("gemm_pprecv", meta.interpret),
+    )(_nbr(meta), gy, grecv, x, w)
+    return dx, dw, dr
+
+
+def _pp_perms(n):
+    down = [(i, (i + 1) % n) for i in range(n)]
+    up = [((i + 1) % n, i) for i in range(n)]
+    return down, up
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def fused_gemm_ppsend(meta, rdma, rows, x, w, b, r):
+    """Fused stage tail + boundary send: ``y = r + (x @ w + b)``;
+    ``recv`` = the DOWN-ring ppermute of y (what this device receives
+    from its up-neighbor). ``rdma=True`` issues the hop from the GEMM
+    epilogue (real remote DMA on TPU; the jax<0.5 interpret discharge
+    rule supports it on a single-axis mesh); ``rdma=False`` keeps the
+    same math with the hop as an explicit lax.ppermute outside the
+    kernel region (multi-axis CPU meshes). ``rows`` is the caller's
+    static leading-axis split of the flattened row dimension (e.g.
+    (B, S)) — the bias-cotangent reduce follows it so the backward is
+    BITWISE equal to autodiff of the unflattened stage tail. Both paths
+    match ``gemm_ppsend_reference`` bitwise."""
+    if rows is None:
+        rows = (x.shape[0],)
+    if rdma:
+        return _gemm_ppsend_call(meta, x, w, b, r)
+    _count("gemm_ppsend_local")
+    y = (r + (x @ w + b)).astype(r.dtype)
+    down, _ = _pp_perms(meta.n)
+    return y, lax.ppermute(y, meta.axis, down)
+
+
+def _gemm_ppsend_fwd(meta, rdma, rows, x, w, b, r):
+    return fused_gemm_ppsend(meta, rdma, rows, x, w, b, r), (x, w)
+
+
+def _gemm_ppsend_bwd(meta, rdma, rows, res, g):
+    x, w = res
+    if rows is None:
+        rows = (x.shape[0],)
+    gy, grecv = g
+    if rdma:
+        dx, dw, dr = _gemm_pprecv_call(meta, gy, grecv, x, w)
+    else:
+        _, up = _pp_perms(meta.n)
+        cot = gy + lax.ppermute(grecv, meta.axis, up)
+        dx = lax.dot_general(cot, w, (((1,), (1,)), ((), ())))
+        dw = lax.dot_general(x, cot, (((0,), (0,)), ((), ()))).astype(w.dtype)
+        dr = cot
+    # the bias cotangent reduces at the JAX level over the caller's
+    # original (e.g. (B, S)) axis split — the exact reduce autodiff
+    # emits for the broadcast-bias transpose of the unflattened tail
+    F = dr.shape[-1]
+    db = jnp.sum(dr.reshape(rows + (F,)),
+                 axis=tuple(range(len(rows)))).astype(w.dtype)
+    return dx.astype(x.dtype), dw, db.reshape(-1), dr
+
+
+fused_gemm_ppsend.defvjp(_gemm_ppsend_fwd, _gemm_ppsend_bwd)
+
+
+# ---------------------------------------------------------------------------
 # unfused references — the SAME schedule (chunk order, fp32 accumulation)
 # expressed with lax collectives that materialize every intermediate
 # buffer. The interpret-mode parity tests assert the kernels match these
@@ -845,3 +1046,12 @@ def rs_bucket_reference(axis, n, x, wire_dtype=None):
             acc = lax.ppermute(acc.astype(wire), axis, perm).astype(
                 jnp.float32) + part
     return acc
+
+
+def gemm_ppsend_reference(axis, n, x, w, b, r):
+    """The stage tail + boundary hop the fused kernel replaces, as plain
+    lax: the parity tests differentiate THIS with jax autodiff and assert
+    the fused custom VJP matches bitwise."""
+    y = (r + (x @ w + b)).astype(r.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return y, lax.ppermute(y, axis, perm)

@@ -258,11 +258,16 @@ def reset_comm_counters():
 
 
 def comm_summary():
-    """One-line human-readable gradient-communication report."""
+    """One-line human-readable gradient-communication report. The backend
+    label covers every axis with an explicit schedule this process ran —
+    dp (grad_comm) plus the pp pipeline ledger's label when pipelined
+    steps were recorded."""
     c = comm_counters()
     by = " ".join(f"{k}:{v / 1e6:.2f}MB"
                   for k, v in sorted(c["reduce_bytes_by_dtype"].items()))
-    backend = ",".join(f"{a}={b}" for a, b in sorted(c["backend"].items())) \
+    label = dict(c["backend"])
+    label.update(pp_comm_counters()["backend"])
+    backend = ",".join(f"{a}={b}" for a, b in sorted(label.items())) \
         or "gspmd"
     return (f"steps: {c['steps']}  backend: {backend}  "
             f"collectives: {c['collectives']}  "
@@ -298,9 +303,13 @@ def reset_mp_comm_counters():
 
 
 def mp_comm_summary():
-    """One-line human-readable mp-axis communication report."""
+    """One-line human-readable mp-axis communication report (the backend
+    label also names the pp axis when pipelined steps were recorded — the
+    two explicit model-parallel schedules compose in one region)."""
     c = mp_comm_counters()
-    backend = ",".join(f"{a}={b}" for a, b in sorted(c["backend"].items())) \
+    label = dict(c["backend"])
+    label.update(pp_comm_counters()["backend"])
+    backend = ",".join(f"{a}={b}" for a, b in sorted(label.items())) \
         or "gspmd"
     return (f"steps: {c['steps']}  backend: {backend}  "
             f"collectives: {c['collectives']}  "
@@ -309,6 +318,47 @@ def mp_comm_summary():
             f"ppermute-hops: {c['ppermute_hops']}  "
             f"fused-dispatches: {c['fused_dispatches']}  "
             f"act/block: {c['activation_bytes'] / 1e6:.3f}MB")
+
+
+# -- pipeline-parallel (pp-axis) communication counters ----------------------
+# The explicit pp schedule (distributed/pipeline.py ring/fused backends;
+# FLAGS_comm_backend='pp=...') has a static per-step boundary ledger:
+# boundary activation/cotangent wire bytes, explicit ppermute hops, fused
+# boundary-kernel dispatches and the schedule's bubble-fraction estimate.
+# Recorded per executed HybridTrainStep — the evidence hook for "boundary
+# sends overlapped into the next tick's stage compute" and the fused
+# last-GEMM RDMA epilogue.
+
+
+def pp_comm_counters():
+    """Snapshot of the pp-axis schedule counters: boundary_bytes,
+    ppermute_hops, fused_dispatches, steps, plus the schedule shape
+    (schedule, stages, microbatches, bubble_fraction — the idle-slot
+    estimate, gpipe (S-1)/(M+S-1), 1f1b (2S-2)/(M+2S-2)) and the per-axis
+    `backend` label ({'pp': 'gspmd'|'ring'|'fused'}), so counter gates can
+    assert which backend actually ran. (Thin view over the registry's
+    "pp_comm" family.)"""
+    from ..observability import collect
+    return collect("pp_comm")
+
+
+def reset_pp_comm_counters():
+    from ..distributed import pipeline
+    pipeline.reset_pp_counters()
+
+
+def pp_comm_summary():
+    """One-line human-readable pp-axis communication report."""
+    c = pp_comm_counters()
+    backend = ",".join(f"{a}={b}" for a, b in sorted(c["backend"].items())) \
+        or "gspmd"
+    return (f"steps: {c['steps']}  backend: {backend}  "
+            f"schedule: {c['schedule'] or '-'}  "
+            f"stages: {c['stages']}  microbatches: {c['microbatches']}  "
+            f"boundary: {c['boundary_bytes'] / 1e6:.2f}MB  "
+            f"ppermute-hops: {c['ppermute_hops']}  "
+            f"fused-dispatches: {c['fused_dispatches']}  "
+            f"bubble: {c['bubble_fraction'] * 100:.1f}%")
 
 
 # -- fault-tolerance counters -------------------------------------------------

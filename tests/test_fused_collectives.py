@@ -75,7 +75,9 @@ def test_comm_backend_parse():
     assert comm_backend.parse("mp=fused") == {"mp": "fused"}
     assert comm_backend.parse("mp=fused,dp=ring") == {"mp": "fused",
                                                       "dp": "ring"}
-    assert comm_backend.parse("ring") == {"dp": "ring", "mp": "ring"}
+    # a bare backend fans out to every scheduled axis (pp since PR 18)
+    assert comm_backend.parse("ring") == {"dp": "ring", "mp": "ring",
+                                          "pp": "ring"}
     assert comm_backend.parse({"mp": "gspmd"}) == {"mp": "gspmd"}
     # unknown backends are dropped (warn once), not fatal
     assert comm_backend.parse("mp=warp9") == {}

@@ -1,0 +1,529 @@
+"""Explicit pipeline-parallel comm backend (FLAGS_comm_backend='pp=...',
+distributed/comm_backend.resolve_pp + distributed/pipeline.py explicit
+schedules + ops/pallas_kernels fused_gemm_ppsend), on the 8-virtual-device
+CPU mesh in Pallas interpret mode:
+
+  * GPT-block pp=2/pp=4 20-step loss trajectory: pp=ring and pp=fused
+    match the GSPMD baseline (fp32 tolerance), and ring-1f1b matches the
+    sequential reference exactly (the GSPMD 1f1b backward does NOT — a
+    known seed defect, tests/test_pipeline.py parity xfails);
+  * flags-off gate: FLAGS_comm_backend unset lowers BITWISE-identically
+    to 'pp=gspmd' (the default path is untouched by this backend);
+  * HLO gate: zero full-microbatch-buffer `stage == k` selects under
+    pp=ring (GSPMD keeps the replicated-then-masked buffer alive; the
+    explicit schedule must not), proxy for zero involuntary remats;
+  * fused boundary kernel fwd+bwd BITWISE vs the unfused lax reference;
+  * HybridTrainStep wiring: ring == fused bitwise on a dp x pp mesh,
+    pp_comm counters/backend label/summary lines, bf16 lift under
+    pp=ring (and the exact fixing flag in the GSPMD refusal), wire-dtype
+    boundary-byte halving, mp=ring + pp=ring composition;
+  * resolve/bail fallback matrix with fix-naming messages;
+  * elastic pp4 -> pp2 -> pp4 kill-shrink-grow resume through
+    ElasticMeshSupervisor(pp=..., num_layers=...).
+"""
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.distributed import comm_backend as cb
+from paddle_tpu.distributed import elastic
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.distributed import pipeline as pl
+from paddle_tpu.distributed import tp_overlap as tp
+from paddle_tpu.models.gpt import GPTConfig, gpt_block_fn
+from paddle_tpu.models.gpt_hybrid import (HybridTrainStep, gpt_param_specs,
+                                          init_gpt_params)
+from paddle_tpu.ops.pallas_kernels import fused_collectives as fc
+from paddle_tpu.utils import fault_injection as fi
+
+
+_DEF = {
+    "FLAGS_sequence_parallel": False,
+    "FLAGS_mp_overlap": False,
+    "FLAGS_comm_backend": "",
+    "FLAGS_pp_wire_dtype": "auto",
+}
+
+
+@pytest.fixture(autouse=True)
+def _reset(devices8):
+    cb._warned.clear()
+    yield
+    paddle.set_flags(dict(_DEF))
+    dist_env.set_mesh(None)
+    pl.reset_pp_counters()
+    tp.reset_mp_counters()
+    fc.reset_trace_counts()
+    cb._warned.clear()
+
+
+def _mini(**kw):
+    base = dict(vocab_size=128, hidden_size=32, num_layers=8, num_heads=4,
+                max_seq_len=32, use_flash=False, compute_dtype="float32",
+                pp_schedule="gpipe")
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _stage_specs(cfg, mesh, pp):
+    """gpt_param_specs names 'mp'; scrub axes absent from the mesh."""
+    return {k: P(*(a if (a is None or a in mesh.axis_names) else None
+                   for a in tuple(s)))
+            for k, s in gpt_param_specs(cfg, pp=pp)["blocks"].items()}
+
+
+def _pp_kwargs(backend, cfg, mesh, pp):
+    if backend == "gspmd":
+        return {}
+    kw = dict(backend=backend, pp_param_specs=_stage_specs(cfg, mesh, pp),
+              x_spec=P(None, None, None))
+    if backend == "fused":
+        from paddle_tpu.models.gpt import gpt_fused_boundary
+        meta = fc.meta_for(mesh, "pp")
+        kw["boundary"] = gpt_fused_boundary(
+            cfg, meta, fc.supported(mesh, shapes=(cfg.hidden_size,))[0])
+    return kw
+
+
+# ---------------------------------------------------------------------------
+# flag plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_and_requested():
+    paddle.set_flags({"FLAGS_comm_backend": "pp=ring,mp=fused"})
+    assert cb.requested("pp") == "ring"
+    assert cb.pp_requested() == "ring"
+    assert cb.pp_explicit_requested()
+    paddle.set_flags({"FLAGS_comm_backend": "pp=gspmd"})
+    assert cb.pp_requested() == "gspmd"
+    assert not cb.pp_explicit_requested()
+    paddle.set_flags({"FLAGS_comm_backend": ""})
+    assert cb.pp_requested() is None
+    assert not cb.pp_explicit_requested()
+    # a bare backend fans out to every axis, pp included
+    paddle.set_flags({"FLAGS_comm_backend": "ring"})
+    assert cb.pp_requested() == "ring"
+
+
+# ---------------------------------------------------------------------------
+# trajectory parity: gspmd == ring == fused on the GPT-block pipeline
+# ---------------------------------------------------------------------------
+
+
+def _trajectory(backend, pp, schedule="gpipe", steps=20, M=4, lr=3e-2):
+    """20-step SGD loss trajectory of a GPT-block pipeline under
+    run_pipeline on a single-axis pp mesh (where the GSPMD schedule
+    compiles on the CPU harness, unlike the hybrid dp x pp mesh — a
+    pre-existing PartitionId limitation of SPMD CPU partitioning)."""
+    cfg = _mini(num_layers=pp * 2)
+    mesh = dist_env.create_single_axis_mesh("pp", pp)
+    params = init_gpt_params(cfg, jax.random.key(0))["blocks"]
+    x = jax.random.normal(jax.random.key(1), (8, 16, cfg.hidden_size))
+    block = gpt_block_fn(cfg)
+    kw = _pp_kwargs(backend, cfg, mesh, pp)
+
+    def loss(p, xx):
+        out = pl.run_pipeline(block, p, xx, M, mesh=mesh, schedule=schedule,
+                              **kw)
+        return jnp.mean(out ** 2)
+
+    @jax.jit
+    def sgd(p, xx):
+        l, g = jax.value_and_grad(loss)(p, xx)
+        return jax.tree_util.tree_map(lambda a, b: a - lr * b, p, g), l
+
+    losses = []
+    with mesh:
+        for _ in range(steps):
+            params, l = sgd(params, x)
+            losses.append(float(jax.device_get(l)))
+    return losses
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_trajectory_gspmd_ring_fused(pp):
+    ref = _trajectory("gspmd", pp)
+    ring = _trajectory("ring", pp)
+    fused = _trajectory("fused", pp)
+    assert all(np.isfinite(ref)) and ref[-1] < ref[0]
+    np.testing.assert_allclose(ring, ref, rtol=1e-5)
+    np.testing.assert_allclose(fused, ref, rtol=1e-5)
+    # ring and fused share the explicit schedule; on the local-fallback
+    # CPU path the fused boundary is trace-identical to ring
+    np.testing.assert_allclose(fused, ring, rtol=1e-6)
+
+
+def test_ring_1f1b_matches_sequential():
+    """The explicit 1f1b backward matches the layer-sequential reference
+    to fp32 accumulation-order noise (~1e-7 abs). The GSPMD 1f1b
+    backward does NOT — its parity test carries a ~0.75 relative error,
+    a known seed defect — so this is the schedule the parity claim
+    actually rests on."""
+    pp, M = 4, 8
+    cfg = _mini(num_layers=pp)
+    mesh = dist_env.create_single_axis_mesh("pp", pp)
+    params = init_gpt_params(cfg, jax.random.key(0))["blocks"]
+    x = jax.random.normal(jax.random.key(1), (8, 16, cfg.hidden_size))
+    block = gpt_block_fn(cfg)
+    kw = _pp_kwargs("ring", cfg, mesh, pp)
+
+    def loss_pp(p, xx):
+        return jnp.sum(pl.run_pipeline(block, p, xx, M, mesh=mesh,
+                                       schedule="1f1b", **kw) ** 2)
+
+    def loss_seq(p, xx):
+        h = xx
+        for i in range(cfg.num_layers):
+            h = block(jax.tree_util.tree_map(lambda a: a[i], p), h)
+        return jnp.sum(h ** 2)
+
+    with mesh:
+        l_ref, g_ref = jax.value_and_grad(loss_seq)(params, x)
+        l_pp, g_pp = jax.jit(jax.value_and_grad(loss_pp))(params, x)
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-6)
+    for k in g_ref:
+        np.testing.assert_allclose(np.asarray(g_pp[k]), np.asarray(g_ref[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# flags-off bitwise gate + HLO structural gate
+# ---------------------------------------------------------------------------
+
+
+def _lowered(backend_flags, pp=4, M=4):
+    paddle.set_flags({"FLAGS_comm_backend": backend_flags})
+    cfg = _mini(num_layers=pp)
+    mesh = dist_env.create_single_axis_mesh("pp", pp)
+    params = init_gpt_params(cfg, jax.random.key(0))["blocks"]
+    x = jax.random.normal(jax.random.key(1), (8, 16, cfg.hidden_size))
+    block = gpt_block_fn(cfg)
+    backend = cb.pp_requested() or "gspmd"
+    kw = _pp_kwargs(backend, cfg, mesh, pp)
+
+    def loss(p, xx):
+        return jnp.sum(pl.run_pipeline(block, p, xx, M, mesh=mesh,
+                                       schedule="gpipe", **kw) ** 2)
+
+    with mesh:
+        return jax.jit(jax.grad(loss)).lower(params, x).as_text()
+
+
+def test_flags_unset_bitwise_identical_to_gspmd():
+    """FLAGS_comm_backend unset and 'pp=gspmd' produce the IDENTICAL
+    lowered module — the default path is bitwise-untouched."""
+    assert _lowered("") == _lowered("pp=gspmd")
+
+
+def test_hlo_no_replicated_stage_select_under_ring():
+    """GSPMD's scan carries the full replicated microbatch buffer and
+    masks it per-stage with `stage == k` selects; the explicit schedule
+    must leave NO select over the [M, mb, S, H] buffer (the structural
+    form of 'zero involuntary remats/repartitions' on this harness —
+    XLA CPU emits no remat log warnings to grep)."""
+    # M=4, B=8 -> mb=2, S=16, H=32: the full buffer is 4x2x16x32
+    pat = "4x2x16x32"
+    gspmd = [l for l in _lowered("pp=gspmd").splitlines()
+             if ("stablehlo.select" in l or "select_n" in l) and pat in l]
+    ring = [l for l in _lowered("pp=ring").splitlines()
+            if ("stablehlo.select" in l or "select_n" in l) and pat in l]
+    assert len(gspmd) > 0    # the baseline really does mask the buffer
+    assert len(ring) == 0, ring
+    # and the explicit schedule's boundary hops are explicit ppermutes
+    assert "collective_permute" in _lowered("pp=ring")
+
+
+# ---------------------------------------------------------------------------
+# fused boundary kernel: bitwise vs the unfused lax reference
+# ---------------------------------------------------------------------------
+
+
+def test_fused_gemm_ppsend_bitwise_vs_reference():
+    mesh = dist_env.create_single_axis_mesh("pp", 4)
+    meta = fc.meta_for(mesh, "pp")
+    rdma, _ = fc.supported(mesh, shapes=(32,))
+    assert rdma  # single-axis mesh: the interpret-mode RDMA kernel runs
+    R, K, F = 8, 16, 32
+    ks = [jax.random.PRNGKey(i) for i in range(6)]
+    x = jax.random.normal(ks[0], (4, R, K))
+    w = jax.random.normal(ks[1], (4, K, F))
+    b = jax.random.normal(ks[2], (4, F))
+    r = jax.random.normal(ks[3], (4, R, F))
+    cy = jax.random.normal(ks[4], (4, R, F))
+    cr = jax.random.normal(ks[5], (4, R, F))
+
+    def wrap(fn):
+        def g(x, w, b, r):
+            y, recv = fn(x[0], w[0], b[0], r[0])
+            return y[None], recv[None]
+        return dist_env.shard_map_compat(
+            g, mesh=mesh, in_specs=(P("pp"), P("pp"), P("pp"), P("pp")),
+            out_specs=(P("pp"), P("pp")), axis_names=None)
+
+    fused = wrap(lambda *a: fc.fused_gemm_ppsend(meta, rdma, None, *a))
+    local = wrap(lambda *a: fc.fused_gemm_ppsend(meta, False, None, *a))
+    ref = wrap(lambda *a: fc.gemm_ppsend_reference("pp", 4, *a))
+
+    def loss_of(fn):
+        def loss(x, w, b, r):
+            y, recv = fn(x, w, b, r)
+            return jnp.sum(y * cy) + jnp.sum(recv * cr)
+        return loss
+
+    for name, fn in (("rdma", fused), ("local", local)):
+        yv, rv = jax.jit(fn)(x, w, b, r)
+        yr, rr = jax.jit(ref)(x, w, b, r)
+        np.testing.assert_array_equal(np.asarray(yv), np.asarray(yr),
+                                      err_msg=f"{name} fwd y")
+        np.testing.assert_array_equal(np.asarray(rv), np.asarray(rr),
+                                      err_msg=f"{name} fwd recv")
+        gv = jax.jit(jax.grad(loss_of(fn), argnums=(0, 1, 2, 3)))(x, w, b, r)
+        gr = jax.jit(jax.grad(loss_of(ref), argnums=(0, 1, 2, 3)))(x, w, b, r)
+        for gn, a, c in zip(("dx", "dw", "db", "dr"), gv, gr):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c),
+                                          err_msg=f"{name} bwd {gn}")
+    counts = fc.trace_counts()
+    assert counts.get("gemm_ppsend", 0) + \
+        counts.get("gemm_ppsend_local", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# HybridTrainStep wiring on the dp x pp mesh
+# ---------------------------------------------------------------------------
+
+_IDS = np.random.RandomState(0).randint(0, 128, (16, 32), dtype=np.int64)
+
+
+def _hybrid_losses(flags, steps=3, dp=2, pp=4, mp=1, dtype="float32", M=4,
+                   schedule="gpipe", wire="auto"):
+    paddle.set_flags({"FLAGS_comm_backend": flags,
+                      "FLAGS_sequence_parallel": bool(mp > 1),
+                      "FLAGS_pp_wire_dtype": wire})
+    pl.reset_pp_counters()
+    mesh = dist_env.create_hybrid_mesh(dp=dp, mp=mp, pp=pp)
+    cfg = _mini(compute_dtype=dtype, pp_schedule=schedule)
+    step = HybridTrainStep(cfg, paddle.optimizer.AdamW(1e-3), mesh=mesh,
+                           num_microbatches=M, seed=0)
+    return [float(np.asarray(jax.device_get(step(_IDS))))
+            for _ in range(steps)]
+
+
+def test_hybrid_ring_fused_bitwise_and_counters():
+    ring = _hybrid_losses("pp=ring")
+    ring_counters = pl.pp_counters()
+    fc.reset_trace_counts()
+    fused = _hybrid_losses("pp=fused")
+    assert all(np.isfinite(ring)) and ring[-1] < ring[0]
+    # the fused boundary degrades to the trace-identical local path on the
+    # multi-axis CPU mesh (fused_rdma off) -> bitwise equal to ring
+    assert ring == fused
+    assert fc.trace_counts().get("gemm_ppsend_local", 0) > 0
+    c = ring_counters
+    assert c["steps"] == 3
+    assert c["backend"] == {"pp": "ring"}
+    assert c["schedule"] == "gpipe" and c["stages"] == 4
+    assert c["boundary_bytes"] > 0 and c["ppermute_hops"] > 0
+    assert c["fused_dispatches"] == 0
+    assert 0.0 < c["bubble_fraction"] < 1.0
+    # gpipe bubble: (S-1)/(M+S-1) with S=4, M=4
+    assert abs(c["bubble_fraction"] - 3 / 7) < 1e-9
+
+
+def test_pp_comm_surfaces():
+    _hybrid_losses("pp=ring", steps=2)
+    s = profiler.pp_comm_summary()
+    assert "ring" in s and "gpipe" in s
+    assert "pp" in profiler.comm_summary()
+    assert profiler.pp_comm_counters()["backend"]["pp"] == "ring"
+    from paddle_tpu import observability
+    snap = observability.snapshot()
+    assert snap["pp_comm.ppermute_hops"] > 0
+    assert snap["pp_comm.boundary_bytes"] > 0
+    profiler.reset_pp_comm_counters()
+    assert profiler.pp_comm_counters()["steps"] == 0
+
+
+def test_bf16_lift_under_explicit_schedule():
+    """The CPU bf16 pipeline refusal lifts under pp=ring; the remaining
+    GSPMD refusal names the fixing flag."""
+    losses = _hybrid_losses("pp=ring", dtype="bfloat16")
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+    with pytest.raises(ValueError, match="pp=ring"):
+        _hybrid_losses("", dtype="bfloat16", steps=1)
+
+
+def test_wire_dtype_halves_boundary_bytes():
+    _hybrid_losses("pp=ring", steps=1, wire="auto")
+    full = pl.pp_counters()["boundary_bytes"]
+    _hybrid_losses("pp=ring", steps=1, wire="bfloat16")
+    half = pl.pp_counters()["boundary_bytes"]
+    assert full == 2 * half > 0
+
+
+def test_mp_ring_composes_with_pp_ring():
+    """seq-parallel mp=ring inside each stage of the explicit pp
+    schedule: both explicit backends active on one mesh."""
+    tp.reset_mp_counters()
+    losses = _hybrid_losses("mp=ring,pp=ring", dp=2, pp=2, mp=2)
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+    assert pl.pp_counters()["ppermute_hops"] > 0
+    assert tp.mp_counters()["ppermute_hops"] > 0
+    # both explicit schedules land in the mp summary's composed label
+    assert "mp=ring" in profiler.mp_comm_summary()
+    assert "pp=ring" in profiler.mp_comm_summary()
+    assert "pp=ring" in profiler.comm_summary()
+
+
+# ---------------------------------------------------------------------------
+# resolve/bail matrix
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_bail_matrix():
+    paddle.set_flags({"FLAGS_comm_backend": "pp=ring"})
+    mesh = dist_env.create_hybrid_mesh(dp=2, pp=4)
+    cfg = _mini()
+    ok = cb.resolve_pp(cfg, mesh, batch=16, num_microbatches=4)
+    assert ok is not None and ok.backend == "ring" and ok.n == 4
+    # microbatches must divide the batch
+    assert cb.resolve_pp(cfg, mesh, batch=14, num_microbatches=4) is None
+    assert any(k == "pp-mb" or (isinstance(k, tuple) and "pp-mb" in k)
+               for k in cb._warned)
+    # zero-3 parameter sharding composes only with GSPMD
+    cfg3 = _mini()
+    cfg3.zero3_params = True
+    assert cb.resolve_pp(cfg3, mesh, batch=16, num_microbatches=4) is None
+    # an active mp axis needs the explicit sp schedule resolved first
+    mesh_mp = dist_env.create_hybrid_mesh(dp=2, mp=2, pp=2)
+    assert cb.resolve_pp(cfg, mesh_mp, batch=16, num_microbatches=4,
+                         sp=None) is None
+    # virtual-pipeline interleaving stays GSPMD
+    cfgv = _mini(pp_interleave=2)
+    assert cb.resolve_pp(cfgv, mesh, batch=16, num_microbatches=4) is None
+
+
+def test_resolve_fused_degradations():
+    paddle.set_flags({"FLAGS_comm_backend": "pp=fused"})
+    mesh = dist_env.create_hybrid_mesh(dp=2, pp=4)
+    # fused + 1f1b degrades to the gpipe fused schedule
+    cfg = _mini(pp_schedule="1f1b")
+    ppc = cb.resolve_pp(cfg, mesh, batch=16, num_microbatches=4)
+    assert ppc is not None and ppc.backend == "fused"
+    assert ppc.schedule == "gpipe"
+    # on the multi-axis CPU mesh the RDMA epilogue is unavailable: the
+    # boundary runs the unfused GEMM tail with an explicit ppermute hop
+    assert ppc.fused_rdma == fc.supported(mesh, shapes=(32,))[0]
+    assert ppc.fused_rdma is False
+
+
+def test_bubble_fraction_ledger():
+    assert pl.bubble_fraction("gpipe", S=4, M=4) == pytest.approx(3 / 7)
+    assert pl.bubble_fraction("1f1b", S=4, M=4) == pytest.approx(6 / 10)
+    assert pl.bubble_fraction("gpipe", S=1, M=4) == 0.0
+    # more microbatches shrink the bubble, monotonically
+    fr = [pl.bubble_fraction("gpipe", S=4, M=m) for m in (2, 4, 8, 16)]
+    assert fr == sorted(fr, reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# elastic: pp4 -> pp2 -> pp4 kill-shrink-grow resume
+# ---------------------------------------------------------------------------
+
+
+def _mlp_factory(width=8, seed=7):
+    from paddle_tpu import nn
+
+    def factory(mesh):
+        paddle.seed(seed)
+        model = nn.Sequential(nn.Linear(width, width), nn.ReLU(),
+                              nn.Linear(width, 1))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        return paddle.jit.TrainStep(model, nn.MSELoss(), opt, mesh=mesh)
+    return factory
+
+
+def test_viable_pp_selection():
+    sup = elastic.ElasticMeshSupervisor(_mlp_factory(), None,
+                                        global_batch=16, min_dp=2, pp=4,
+                                        num_layers=8)
+    assert sup.viable_pp(8) == 4      # pp4 x dp2
+    assert sup.viable_pp(7) == 2      # pp4 leaves dp=1 < min_dp; 3 ∤ 8
+    assert sup.viable_pp(4) == 2
+    assert sup.viable_pp(3) == 1
+    with pytest.raises(RuntimeError, match="pp_target=4"):
+        sup.viable_pp(1)
+    # layer-balance: pp must divide num_layers
+    sup6 = elastic.ElasticMeshSupervisor(_mlp_factory(), None,
+                                         global_batch=16, min_dp=1, pp=4,
+                                         num_layers=6)
+    assert sup6.viable_pp(8) == 3     # 4 ∤ 6 -> largest divisor <= 4
+
+
+def test_supervisor_pp_shrink_grow_resume(tmp_path):
+    """Kill a rank on pp4 x dp2: the supervisor re-forms pp2 x dp2 from
+    the 7 survivors (pp must keep dividing num_layers=8 and leave
+    min_dp=2), resumes from the resharded snapshot, and grows back to
+    pp4 x dp2 when the chip returns."""
+    from paddle_tpu.incubate.checkpoint import CheckpointManager
+    profiler.reset_elastic_counters()
+    rng = np.random.RandomState(0)
+    X = rng.rand(12, 16, 8).astype(np.float32)
+    Y = rng.rand(12, 16, 1).astype(np.float32)
+    mgr = CheckpointManager(tmp_path, async_save=False, keep_last_n=50)
+    sup = elastic.ElasticMeshSupervisor(_mlp_factory(), mgr, global_batch=16,
+                                        save_every=2, min_dp=2, pp=4,
+                                        num_layers=8)
+    with fi.inject(fi.FaultPlan(chip_loss_at={4: [2]},
+                                chip_return_at={7: [2]})):
+        sup.run(lambda t: (X[t], Y[t]), 10)
+    kinds = [(e["kind"], e["dp"], e["pp"]) for e in sup.events]
+    assert kinds == [("start", 2, 4), ("shrink", 2, 2), ("grow", 2, 4)]
+    assert sup.pp == 4 and sup.dp == 2 and sup.failed == frozenset()
+    shrink = next(e for e in sup.events if e["kind"] == "shrink")
+    assert shrink["restored_step"] is not None
+    c = profiler.elastic_counters()
+    assert c["shrinks"] == 1 and c["grows"] == 1
+    assert c["active_pp"] == 4 and c["active_dp"] == 2
+    # the grown pp4 x dp2 step is the memoized start step
+    assert len(sup._steps) == 2
+
+
+# ---------------------------------------------------------------------------
+# tier-1 sub-rung of the tools_comm_smoke pp ladder
+# ---------------------------------------------------------------------------
+
+
+def _smoke():
+    path = pathlib.Path(__file__).resolve().parents[1] / "tools_comm_smoke.py"
+    spec = importlib.util.spec_from_file_location("tools_comm_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pp_ladder_deterministic_rung():
+    out = _smoke().run_pp_ladder(deterministic=True)
+    assert out["ok"], out
+
+
+@pytest.mark.slow
+def test_pp_ladder_perf_gate():
+    """Perf rung: the explicit schedule's partial-send wire moves
+    >= 1.15x fewer boundary bytes than the fp32 boundary the GSPMD
+    schedule sends (bf16 wire: measured 2.0x), and ring wall-clock does
+    not regress vs gspmd. On this CPU harness the 8 'devices' are
+    threads on shared cores, so the overlapped-send wall-clock win is a
+    TPU property (tools_mfu_sweep pp rung); CPU gates the wire bytes —
+    the same currency every other COMM_SMOKE ratio gates."""
+    out = _smoke().run_pp_ladder(deterministic=False)
+    assert out["ok"], out
+    assert out["wire_ratio"] >= 1.15, out
+    assert out["speedup"] >= 0.7, out

@@ -15,6 +15,12 @@ Prints, machine-greppable for the BENCH trajectory:
   COMM_SMOKE <name>: <ms>/step  reduce <MB>MB  gather <MB>MB  \
       collectives <n>  buckets <n>  fill <pct>%  loss <x>
   COMM_SMOKE ratio: rs/ag reduce bytes = <x> of allreduce
+
+``--pp`` runs the pipeline-parallel backend ladder instead (gspmd vs
+FLAGS_comm_backend='pp=ring' vs 'pp=fused' on a pp=4 mesh): per-rung
+``COMM_SMOKE pp/<backend>`` lines with boundary MB / ppermute hops /
+bubble %%, and the ring-over-gspmd speedup ratio (``--deterministic``
+for the tiny parity-only tier-1 sub-rung).
 """
 from __future__ import annotations
 
@@ -107,6 +113,146 @@ def run_config(name, flags, args):
             "gather_bytes": per("gather_bytes")}
 
 
+def _pp_case(backend, pp, layers, hidden, batch, seq, M, iters, warmup,
+             wire="auto"):
+    """One rung: jitted value_and_grad of a GPT-block run_pipeline on a
+    single-axis pp mesh (the GSPMD schedule compiles there on the CPU
+    harness; the hybrid dp x pp mesh trips a pre-existing PartitionId
+    limitation of SPMD CPU partitioning)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import comm_backend as cb
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.distributed import pipeline as pl
+    from paddle_tpu.models.gpt import GPTConfig, gpt_block_fn
+    from paddle_tpu.models.gpt_hybrid import gpt_param_specs, init_gpt_params
+
+    paddle.set_flags({"FLAGS_comm_backend":
+                      "" if backend == "gspmd" else f"pp={backend}",
+                      "FLAGS_pp_wire_dtype": wire})
+    mesh = dist_env.create_single_axis_mesh("pp", pp)
+    cfg = GPTConfig(vocab_size=64, hidden_size=hidden, num_layers=layers,
+                    num_heads=4, max_seq_len=seq, use_flash=False,
+                    compute_dtype="float32", pp_schedule="gpipe")
+    params = init_gpt_params(cfg, jax.random.key(0))["blocks"]
+    x = jax.random.normal(jax.random.key(1), (batch, seq, hidden))
+    block = gpt_block_fn(cfg)
+    kw = {}
+    ppc = None
+    if backend != "gspmd":
+        from paddle_tpu.models.gpt import gpt_fused_boundary
+        from paddle_tpu.ops.pallas_kernels import fused_collectives as fc
+        specs = {k: P(*(a if (a is None or a in mesh.axis_names) else None
+                        for a in tuple(s)))
+                 for k, s in gpt_param_specs(cfg, pp=pp)["blocks"].items()}
+        ppc = cb.resolve_pp(cfg, mesh, batch=batch, num_microbatches=M)
+        kw = dict(backend=backend, pp_param_specs=specs,
+                  x_spec=P(None, None, None),
+                  wire_dtype=ppc.wire_dtype if ppc is not None else None)
+        if backend == "fused":
+            kw["boundary"] = gpt_fused_boundary(
+                cfg, fc.meta_for(mesh, "pp"),
+                fc.supported(mesh, shapes=(hidden,))[0])
+
+    def loss(p, xx):
+        return jnp.mean(pl.run_pipeline(block, p, xx, M, mesh=mesh,
+                                        schedule="gpipe", **kw) ** 2)
+
+    g = jax.jit(jax.value_and_grad(loss))
+    with mesh:
+        for _ in range(max(1, warmup)):
+            l, grads = g(params, x)
+        jax.block_until_ready(grads)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            l, grads = g(params, x)
+        jax.block_until_ready(grads)
+    dt = (time.perf_counter() - t0) / max(iters, 1)
+    c = {}
+    if ppc is not None:
+        pl.reset_pp_counters()
+        for _ in range(iters):
+            pl.record_pp_step(
+                pl.gpt_pp_step_record(cfg, ppc, batch, seq, M, S=pp))
+        c = pl.pp_counters()
+    dist_env.set_mesh(None)
+    paddle.set_flags({"FLAGS_comm_backend": "", "FLAGS_pp_wire_dtype": "auto"})
+    return float(l), dt * 1e3, c
+
+
+def run_pp_ladder(deterministic=False, pp=4, iters=None, warmup=2):
+    """Pipeline-parallel backend ladder: gspmd vs ring vs ring/bf16-wire
+    vs fused, one greppable COMM_SMOKE line per rung plus two ratios —
+    boundary wire bytes (the explicit schedule's partial-send bf16 wire
+    vs the fp32 boundary the GSPMD schedule sends, Paddle's
+    ``enable_partial_send_recv`` analog; gated >= 1.15x by the slow test)
+    and wall-clock ring-over-gspmd (a regression guard only on this CPU
+    harness: the 8 'devices' are threads on shared cores, so the overlap
+    win is a TPU property — tools_mfu_sweep's pp rung measures it there).
+
+    ``deterministic=True`` is the tier-1 sub-rung: a tiny config, parity
+    and wire-ratio gates only (no timing gates — CI timing is noise).
+    """
+    if deterministic:
+        layers, hidden, batch, seq, M = pp, 32, 8, 16, 4
+        iters = iters or 1
+    else:
+        layers, hidden, batch, seq, M = pp, 64, 32, 64, 16
+        iters = iters or 8
+    out = {"ok": True, "pp": pp}
+    res = {}
+    bytes_per_step = {}
+    for name, backend, wire in (("gspmd", "gspmd", "auto"),
+                                ("ring", "ring", "auto"),
+                                ("ring/bf16-wire", "ring", "bfloat16"),
+                                ("fused", "fused", "auto")):
+        try:
+            l, ms, c = _pp_case(backend, pp, layers, hidden, batch, seq, M,
+                                iters, warmup, wire=wire)
+        except Exception as e:  # noqa: BLE001
+            print(f"COMM_SMOKE pp/{name}: FAILED {str(e)[:160]}", flush=True)
+            out["ok"] = False
+            continue
+        res[name] = (l, ms)
+        extra = ""
+        if c:
+            steps = max(c["steps"], 1)
+            bytes_per_step[name] = c["boundary_bytes"] / steps
+            extra = (f"  boundary {c['boundary_bytes'] / steps / 1e6:.3f}MB"
+                     f"  hops {c['ppermute_hops'] // steps}"
+                     f"  bubble {c['bubble_fraction'] * 100:.0f}%")
+        print(f"COMM_SMOKE pp/{name}: {ms:.1f}ms/step  loss {l:.6f}{extra}",
+              flush=True)
+    if len(res) == 4:
+        lg = res["gspmd"][0]
+        parity = (abs(res["ring"][0] - lg) <= 1e-5 * max(abs(lg), 1e-12)
+                  and abs(res["fused"][0] - res["ring"][0])
+                  <= 1e-6 * max(abs(res["ring"][0]), 1e-12)
+                  and abs(res["ring/bf16-wire"][0] - lg)
+                  <= 1e-2 * max(abs(lg), 1e-12))
+        speedup = res["gspmd"][1] / max(res["ring"][1], 1e-9)
+        # the GSPMD schedule has no partial-send wire: its boundary is the
+        # same fp32 hop the fp32-wire ring schedule sends (the ledger
+        # measures the rung that actually ran)
+        wire_ratio = (bytes_per_step.get("ring", 0.0)
+                      / max(bytes_per_step.get("ring/bf16-wire", 1e-9), 1e-9))
+        out.update(parity=parity, speedup=round(speedup, 3),
+                   wire_ratio=round(wire_ratio, 3),
+                   gspmd_ms=round(res["gspmd"][1], 2),
+                   ring_ms=round(res["ring"][1], 2),
+                   fused_ms=round(res["fused"][1], 2))
+        out["ok"] = out["ok"] and parity and wire_ratio >= 1.15
+        print(f"COMM_SMOKE pp ratio: partial-send wire bytes = "
+              f"{1 / max(wire_ratio, 1e-9):.2f}x of the gspmd fp32 boundary "
+              f"({wire_ratio:.2f}x reduction); ring wall-clock = "
+              f"{speedup:.2f}x over gspmd", flush=True)
+    else:
+        out["ok"] = False
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=10)
@@ -116,7 +262,15 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--bucket-kb", type=int, default=16 * 1024)
+    ap.add_argument("--pp", action="store_true",
+                    help="run the pipeline-parallel backend ladder instead")
+    ap.add_argument("--deterministic", action="store_true",
+                    help="tiny parity-only pp ladder (the tier-1 sub-rung)")
     args = ap.parse_args()
+
+    if args.pp:
+        run_pp_ladder(deterministic=args.deterministic)
+        return
 
     results = [run_config(name, flags, args) for name, flags in CONFIGS]
     by = {r["name"]: r for r in results}

@@ -13,6 +13,10 @@ results stream as they finish, and the script never kills a TPU claim.
                                      # MFU rung; sweeps FLAGS_comm_backend
                                      # gspmd/ring/fused alongside the tp
                                      # flags)
+  python tools_mfu_sweep.py pp [B]   # pipeline comm-backend ladder on a
+                                     # dp x pp mesh (FLAGS_comm_backend=
+                                     # 'pp=gspmd|ring|fused' + bf16 wire)
+                                     # with a bubble-fraction column
 """
 from __future__ import annotations
 
@@ -236,6 +240,75 @@ def gpt_tp_schedules(model_name="gpt3-1.3B", batch=8, seq=2048, steps=8,
             dist_env.set_mesh(None)
 
 
+def gpt_pp_schedules(model_name="gpt3-1.3B", batch=8, seq=2048, steps=8,
+                     pp=None, microbatches=8):
+    """Sweep the pipeline-parallel comm backend (FLAGS_comm_backend=
+    'pp=gspmd|ring|fused') on a dp x pp mesh — GSPMD's masked-select
+    schedule vs the explicit overlapped ring schedule vs the fused
+    last-GEMM RDMA boundary — reported as MFU plus the pp ledger's
+    boundary traffic and bubble-fraction estimate per rung."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.models.gpt import GPT_CONFIGS
+    from paddle_tpu.models.gpt_hybrid import HybridTrainStep
+
+    pp = pp or min(4, jax.device_count())
+    ladder = (("gspmd", {"FLAGS_comm_backend": ""}),
+              ("ring", {"FLAGS_comm_backend": "pp=ring"}),
+              ("ring+bf16-wire", {"FLAGS_comm_backend": "pp=ring",
+                                  "FLAGS_pp_wire_dtype": "bfloat16"}),
+              ("fused", {"FLAGS_comm_backend": "pp=fused"}))
+    for name, flags in ladder:
+        try:
+            paddle.set_flags({"FLAGS_sequence_parallel": False,
+                              "FLAGS_mp_overlap": False,
+                              "FLAGS_comm_backend": "",
+                              "FLAGS_pp_wire_dtype": "auto"})
+            paddle.set_flags(flags)
+            profiler.reset_pp_comm_counters()
+            mesh = dist_env.create_hybrid_mesh(dp=-1, pp=pp)
+            cfg = GPT_CONFIGS[model_name]
+            cfg.max_seq_len = max(cfg.max_seq_len, seq)
+            cfg.use_flash = True
+            cfg.compute_dtype = "bfloat16"
+            cfg.remat = True
+            opt = paddle.optimizer.AdamW(
+                2e-4, grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+            step = HybridTrainStep(cfg, opt, mesh=mesh,
+                                   num_microbatches=microbatches,
+                                   param_dtype=jnp.bfloat16)
+            ids = jax.random.randint(jax.random.key(0), (batch, seq), 0,
+                                     cfg.vocab_size, jnp.int32)
+            loss = step(ids)
+            _sync(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = step(ids)
+            _sync(loss)
+            dt = (time.perf_counter() - t0) / steps
+            tok_s = batch * seq / dt
+            from paddle_tpu.observability.flops import model_flops_per_token
+            fpt, _ = model_flops_per_token(cfg, seq)
+            peak = _peak() * jax.device_count()
+            c = profiler.pp_comm_counters()
+            per_step = max(c["steps"], 1)
+            print(f"PP {model_name} pp{pp} M{microbatches} {name}: "
+                  f"{tok_s:.0f} tok/s, {dt:.3f} s/step, "
+                  f"MFU {tok_s * fpt / peak * 100:.1f}%  "
+                  f"boundary {c['boundary_bytes'] / per_step / 1e6:.2f}MB  "
+                  f"hops {c['ppermute_hops'] // per_step}  "
+                  f"fused {c['fused_dispatches'] // per_step}  "
+                  f"bubble {c['bubble_fraction'] * 100:.1f}%",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"PP {name}: FAILED {str(e)[:160]}", flush=True)
+        finally:
+            dist_env.set_mesh(None)
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "resnet"
     if which == "flash":
@@ -243,6 +316,13 @@ def main():
         return
     if which == "tp":
         gpt_tp_schedules()
+        return
+    if which == "pp":
+        # pipeline comm-backend ladder (PR 18): gspmd vs explicit ring
+        # (plus bf16 partial-send wire) vs fused boundary, with the
+        # ledger's bubble-fraction column; argv[2] overrides the batch
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        gpt_pp_schedules(batch=batch)
         return
     if which == "tp67":
         # the ROADMAP 6.7B MFU rung: gspmd/ring/fused comm-backend ladder
