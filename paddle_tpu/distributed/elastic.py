@@ -370,7 +370,7 @@ class ElasticMeshSupervisor:
     def __init__(self, step_factory, ckpt, global_batch, devices=None,
                  save_every=None, min_dp=None, grow=None, max_reforms=16,
                  heartbeat_dir=None, heartbeat_timeout=None, on_event=None,
-                 pp=1, num_layers=None):
+                 pp=1, num_layers=None, quarantine=False):
         from .. import flags as _flags
         F = _flags._FLAGS
         self.step_factory = step_factory
@@ -393,6 +393,14 @@ class ElasticMeshSupervisor:
         self.grow = bool(F.get("FLAGS_elastic_grow", True)
                          if grow is None else grow)
         self.max_reforms = int(max_reforms)
+        # ``quarantine`` policy (distributed/integrity.py): a chip whose
+        # replica needed >= FLAGS_sdc_quarantine_threshold peer repairs is
+        # a repeat silent-corruption offender — treat it as LOST and
+        # re-form the mesh over the survivors (the ordinary reform path),
+        # instead of letting it keep flipping bits or rewinding everyone
+        # to disk. Quarantined ranks are sticky regardless of ``grow``
+        # (the signal is accumulated damage, not a recovered heartbeat).
+        self.quarantine = bool(quarantine)
         self.on_event = on_event
         self.events = []            # audit trail of reform events
         self.step = None            # current TrainStep
@@ -434,9 +442,21 @@ class ElasticMeshSupervisor:
             candidates = [r for r in range(self.world) if r not in lost]
             stale = set(self.monitor.failed_ranks(candidates))
         failed = lost | stale
+        if self.quarantine:
+            from . import integrity as _integrity
+            failed |= set(_integrity.quarantined_ranks()) \
+                & set(range(self.world))
         if not self.grow:
             failed |= set(self.failed)
         return frozenset(failed)
+
+    def scrub(self, max_steps=None):
+        """Delegate an at-rest integrity scrub to the attached checkpoint
+        manager (see CheckpointManager.scrub) — the supervisor-cadence
+        entry point beside the opportunistic ``_prune`` hook."""
+        if self.ckpt is None:
+            return {"scrubbed": 0, "rot": []}
+        return self.ckpt.scrub(max_steps=max_steps)
 
     # -- mesh re-forming -----------------------------------------------------
     def viable_dp(self, n_survivors):

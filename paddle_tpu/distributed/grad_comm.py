@@ -694,7 +694,8 @@ class StepComm:
 
 def make_step_record(plan, wire_dtype, weight_update_sharding,
                      with_update=True, emulated_gather=False,
-                     backend="ring", fused_kernels=False, fixed16=False):
+                     backend="ring", fused_kernels=False, fixed16=False,
+                     sdc=False):
     """Byte/collective ledger for one executed step of this plan. The
     explicit all-reduce baseline (weight_update_sharding=False) counts
     RS+grad-AG as reduce bytes (= ring all-reduce); the sharded-update
@@ -702,7 +703,9 @@ def make_step_record(plan, wire_dtype, weight_update_sharding,
     `emulated_gather` (mp-composed partial-manual steps) doubles the
     gather-side bytes — see all_gather_shards. Under the fused backend
     (`fused_kernels`) each eligible bucket's RS/AG is one Pallas kernel
-    launch, counted in `fused_dispatches`."""
+    launch, counted in `fused_dispatches`. ``sdc`` accounts the integrity
+    check step's extra collective: one all-gather of a per-replica uint32
+    fingerprint (4*(n-1) wire bytes per device)."""
     rec = StepComm()
     rec.backend = backend
     by_dtype, coll = plan.reduce_record(
@@ -730,6 +733,9 @@ def make_step_record(plan, wire_dtype, weight_update_sharding,
         gb, gcoll = plan.gather_record(emulated=emulated_gather)
         rec.gather_bytes = gb
         rec.collectives += gcoll
+    if sdc:
+        rec.gather_bytes += 4 * (plan.n - 1)
+        rec.collectives += 1
     return rec
 
 

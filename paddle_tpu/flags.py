@@ -389,6 +389,42 @@ _FLAGS = {
     # still accumulates fp32 (pp=fused ignores this — its RDMA leaves the
     # GEMM epilogue at the compute dtype).
     "FLAGS_pp_wire_dtype": "auto",
+    # -- silent-data-corruption sentinel (distributed/integrity.py) ---------
+    # Fuse a per-replica integrity fingerprint (uint32 bit-reduction over
+    # params + replicated optimizer slots) into every Nth step executable
+    # and cross-check it over the dp axis: a flipped bit in ONE replica's
+    # copy shows up as a fingerprint minority, is localized by majority
+    # vote, and is repaired in place from a healthy peer's bytes — no disk
+    # rewind, zero steps lost. The verdict rides the step's existing
+    # combined host fetch (host_syncs per update step unchanged; the
+    # fault_counters ledger audits it). 0 = OFF (the default): the step
+    # executable is byte-identical to flags-off.
+    "FLAGS_sdc_check_every": 0,
+    # Peer repairs charged to one rank before the rank is declared a
+    # repeat offender: integrity.quarantined_ranks() reports it and the
+    # ElasticMeshSupervisor (policy "quarantine") treats it as a lost
+    # chip — the PR 11 reform path, not a fleet-wide disk rewind.
+    "FLAGS_sdc_quarantine_threshold": 2,
+    # Serving shadow audit: this fraction of FINISHED requests (chosen
+    # deterministically from the request id) is replayed through
+    # generate_from_params and bitwise-compared before the result is
+    # delivered. A mismatch refuses delivery, replays the request, and
+    # bumps the owning replica's suspicion score. 0.0 = OFF.
+    "FLAGS_serving_audit_rate": 0.0,
+    # Audit failures charged to one replica before the supervisor fails
+    # it over (fresh engine; the corrupted KV pool and prefix cache are
+    # discarded before corruption spreads through cached prefixes).
+    "FLAGS_serving_audit_threshold": 2,
+    # CRC32 end-to-end checksums on disaggregated KV-transfer page
+    # payloads (page bytes + quant scale columns, stamped at stream time,
+    # verified before install). A mismatched page refuses the transfer;
+    # the supervisor re-offers the retained clean payload. Default OFF:
+    # payloads carry crc=None and verification is a no-op.
+    "FLAGS_kv_transfer_crc": False,
+    # Background checkpoint scrub cadence: every Nth save, re-verify the
+    # retained snapshots' CRC manifests from _prune and quarantine rot
+    # (*.corrupt) BEFORE restore time needs them. 0 = OFF.
+    "FLAGS_ckpt_scrub_every": 0,
 }
 
 

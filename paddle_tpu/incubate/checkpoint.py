@@ -151,6 +151,14 @@ class CheckpointManager:
         # pre-topology checkpoints): what mesh/flags produced the bytes
         self.last_restored_topology = None
         self._last_verified_topology = None
+        # opportunistic at-rest scrub cadence (FLAGS_ckpt_scrub_every):
+        # every Nth successful save, _prune re-verifies the retained
+        # snapshots' CRC manifests and quarantines rot. 0 = only explicit
+        # scrub() calls.
+        from .. import flags as _flags
+        self._scrub_every = int(
+            _flags._FLAGS.get("FLAGS_ckpt_scrub_every", 0) or 0)
+        self._saves_since_scrub = 0
         self._recover()
 
     # -- querying ----------------------------------------------------------
@@ -315,6 +323,39 @@ class CheckpointManager:
             # ignore_errors: another rank/process may prune the same step
             # concurrently; losing the race is success
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        if self._scrub_every > 0:
+            self._saves_since_scrub += 1
+            if self._saves_since_scrub >= self._scrub_every:
+                self._saves_since_scrub = 0
+                self.scrub()
+
+    def scrub(self, max_steps=None):
+        """Proactive at-rest integrity: re-verify the CRC manifests of the
+        retained snapshots NEWEST-first (the exact fallback chain
+        ``restore(None)`` would walk) and quarantine rot to ``*.corrupt``
+        — a later emergency restore finds its chain pre-cleaned instead
+        of discovering rotten bytes at the worst moment. Transient read
+        failures (OSError) are skipped, not condemned: those bytes may be
+        fine once the filesystem recovers. ``max_steps`` bounds the work
+        per call. Returns ``{"scrubbed": n, "rot": [steps]}`` and feeds
+        the sdc ledger (scrubs / rot_found)."""
+        from ..distributed import integrity as _integrity
+        steps = list(reversed(self.all_steps()))
+        if max_steps is not None:
+            steps = steps[: max(0, int(max_steps))]
+        rot = []
+        for s in steps:
+            try:
+                self._verify_step(s)
+            except CheckpointCorruptError:
+                self._quarantine(s)
+                rot.append(s)
+            except OSError:
+                continue
+        _integrity._count("scrubs")
+        if rot:
+            _integrity._count("rot_found", len(rot))
+        return {"scrubbed": len(steps), "rot": rot}
 
     def wait(self):
         """Block until any in-flight async save has finished; re-raise IO errors."""

@@ -141,6 +141,15 @@ class TrainStep:
         self._tel = StepSampler("jit.TrainStep")
         self.flops_per_step = None
         self.tokens_per_step = None
+        # silent-data-corruption sentinel (FLAGS_sdc_check_every, resolved
+        # at first call): every Nth step dispatches a separate executable
+        # with a per-replica integrity fingerprint fused in; the verdict
+        # rides the combined host fetch and a minority replica is repaired
+        # in place from a healthy peer (distributed/integrity.py). 0 = off
+        # — the regular executable is byte-identical to flags-off.
+        self._sdc_every = 0
+        self._sdc_jitted = None
+        self._sdc_devices = None
 
     # -- sharding helpers ----------------------------------------------------
     def _sharding_for(self, spec):
@@ -231,7 +240,7 @@ class TrainStep:
         return bool(self.donate and
                     _flags._FLAGS.get("FLAGS_donate_buffers", True))
 
-    def _build(self, batch_treedef, n_inputs):
+    def _build(self, batch_treedef, n_inputs, sdc=False):
         from ..framework.compilation_cache import ensure_persistent_cache
         ensure_persistent_cache()
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
@@ -274,7 +283,7 @@ class TrainStep:
             return optimizer.apply_gradients(params, grads, opt_state, lr)
 
         if self._gc_cfg is not None:
-            return self._build_grad_comm(loss_from, apply_update)
+            return self._build_grad_comm(loss_from, apply_update, sdc=sdc)
 
         # compiled anomaly guard: an all-finite reduction over loss+grads is
         # fused into the executable and the update is gated on it with
@@ -388,15 +397,25 @@ class TrainStep:
         return jax.jit(step_fn, donate_argnums=donate)
 
     # -- explicit gradient-communication step (grad_comm.py) ----------------
-    def _build_grad_comm(self, loss_from, apply_update):
+    def _build_grad_comm(self, loss_from, apply_update, sdc=False):
         """Compile the step under shard_map over the dp axis with the
         explicit bucketed reduce-scatter / sharded-update / all-gather
         schedule (or the explicit all-reduce baseline when weight-update
         sharding is off). Returns one jitted fn, or for accumulate_steps>1
         a {"micro", "fire"} pair — micro steps issue only the per-bucket
         reduce-scatter into the sharded accumulator, so their collectives
-        overlap the (asynchronously dispatched) next micro-batch compute."""
+        overlap the (asynchronously dispatched) next micro-batch compute.
+
+        ``sdc=True`` (k==1, non-composed only) builds the check-step
+        variant: a per-replica integrity fingerprint over the device-local
+        input state is fused in, the dp-gathered fingerprint vector rides
+        the output tuple (after the anomaly flag), and the update is gated
+        on cross-replica agreement — a mismatch step performs NO update, so
+        the host can peer-repair and re-dispatch the SAME step. The check
+        variant is built WITHOUT donation so the (possibly corrupt) input
+        state stays alive for in-place repair."""
         from ..distributed import grad_comm as _gc
+        from ..distributed import integrity as _integrity
         from ..distributed.env import shard_map_compat as shard_map
         cfg = self._gc_cfg
         mesh, axis, n = self.mesh, cfg.axis, cfg.n
@@ -437,6 +456,8 @@ class TrainStep:
             "micro": _gc.make_step_record(plan, wire, wus, with_update=False,
                                           **rec_kw),
             "fire": _gc.make_step_record(plan, wire, wus, **rec_kw),
+            # integrity check step: + one fingerprint all-gather
+            "sdc": _gc.make_step_record(plan, wire, wus, sdc=True, **rec_kw),
         }
         self._gc_extra = (jnp.arange(n, dtype=jnp.int32),) if composed \
             else ()
@@ -566,20 +587,39 @@ class TrainStep:
             def body(params, opt_state, buffers, lr, key, inputs, labels,
                      *ridx):
                 idx = replica_idx(ridx)
+                if sdc:
+                    # per-replica integrity fingerprint over the device-LOCAL
+                    # input bytes (params; plus the slots when they are
+                    # replicated — packed wus shards legitimately differ per
+                    # replica and carry no peer redundancy). The all_gather
+                    # makes the full per-replica vector visible to every
+                    # replica AND to the host via the step's one combined
+                    # fetch — zero extra syncs.
+                    fp = _integrity.fingerprint_arrays(
+                        (params,) if wus else (params, opt_state))
+                    fps = lax.all_gather(fp, axis, tiled=False)
+                    fp_ok = jnp.all(fps == fps[0])
                 loss, new_buffers, grads = local_loss_grads(
                     params, buffers, key, inputs, labels, idx)
                 gshards = reduce_mean_shards(grads, idx)
                 ok = shard_ok(loss, gshards) if guard else None
+                # update gate: anomaly verdict, fingerprint verdict, or both
+                # — a gated-off step passes all state through untouched
+                gate = ok
+                if sdc:
+                    gate = fp_ok if gate is None else jnp.logical_and(
+                        gate, fp_ok)
+                gated = gate is not None
                 if grad_clip is not None:
                     gshards = _gc.clip_shards(grad_clip, gshards, axis)
                 if wus:
                     pshards, new_psh, upd_opt = sharded_update_core(
                         params, opt_state, gshards, lr, idx)
-                    if guard:
+                    if gated:
                         # pure select; the publish gather below runs
                         # unconditionally (no collectives under the cond)
                         sel_psh, new_opt = lax.cond(
-                            ok, lambda _: (new_psh, upd_opt),
+                            gate, lambda _: (new_psh, upd_opt),
                             lambda _: (pshards, opt_state), None)
                     else:
                         sel_psh, new_opt = new_psh, upd_opt
@@ -588,22 +628,23 @@ class TrainStep:
                     # explicit all-reduce baseline: finish the reduce with a
                     # grad all-gather (ring AR = RS+AG), replicated update
                     grads_full = gather_full(gshards, idx)
-                    if guard:
+                    if gated:
                         new_params, new_opt = lax.cond(
-                            ok, lambda _: optimizer.apply_gradients(
+                            gate, lambda _: optimizer.apply_gradients(
                                 params, grads_full, opt_state, lr),
                             lambda _: (params, opt_state), None)
                     else:
                         new_params, new_opt = optimizer.apply_gradients(
                             params, grads_full, opt_state, lr)
                 synced = sync_buffers(new_buffers)
-                out_bufs = (lax.cond(ok, lambda _: synced,
+                out_bufs = (lax.cond(gate, lambda _: synced,
                                      lambda _: buffers, None)
-                            if guard else synced)
+                            if gated else synced)
                 return (lax.pmean(loss, axis),) + \
-                    ((ok,) if guard else ()) + (new_params, new_opt, out_bufs)
+                    ((ok,) if guard else ()) + ((fps,) if sdc else ()) + \
+                    (new_params, new_opt, out_bufs)
 
-            ok_spec = (P_rep,) if guard else ()
+            ok_spec = ((P_rep,) if guard else ()) + ((P_rep,) if sdc else ())
             smap = shard_map(
                 body, mesh=mesh,
                 in_specs=(p_spec, o_spec, b_spec, P_rep, P_rep, in_data,
@@ -618,7 +659,12 @@ class TrainStep:
                             bufs)
             else:
                 stepped = smap
-            donate = (0, 1, 2) if self._effective_donate() else ()
+            # the sdc check variant keeps its inputs alive (no donation):
+            # on a fingerprint mismatch the gated step produced no update
+            # and the host repairs the INPUT state in place, then re-runs
+            # the same step — donated buffers would already be dead
+            donate = ((0, 1, 2)
+                      if self._effective_donate() and not sdc else ())
             return jax.jit(
                 stepped, donate_argnums=donate,
                 in_shardings=(to_sh(p_jit), o_jit, to_sh(b_spec),
@@ -820,6 +866,38 @@ class TrainStep:
                 self._opt_state = self._move_opt(self._opt_state,
                                                  self._opt_host_shardings())
             self._jitted = self._build(None, len(in_arrays))
+            every = int(_flags._FLAGS.get("FLAGS_sdc_check_every", 0) or 0)
+            if every > 0:
+                # sdc sentinel needs per-replica redundancy AND a manual dp
+                # region to gather per-device fingerprints from: the
+                # explicit grad-comm schedule on a pure-dp mesh, single-shot
+                # (k==1), dp>=2. Anything else: warn once and stay off.
+                cfg = self._gc_cfg
+                if (cfg is not None and self.accumulate_steps == 1
+                        and cfg.n >= 2 and not cfg.auto_axes
+                        and self.mesh is not None
+                        and self.mesh.devices.size == cfg.n):
+                    self._sdc_every = every
+                    self._sdc_devices = list(self.mesh.devices.flat)
+                else:
+                    import warnings
+                    warnings.warn(
+                        "FLAGS_sdc_check_every requires the explicit dp "
+                        "grad-comm schedule (FLAGS_grad_comm / dp mesh) "
+                        "with dp>=2 and accumulate_steps=1; the "
+                        "silent-data-corruption sentinel is disabled")
+        # deterministic chaos: FaultPlan.bitflip_at makes ONE replica's
+        # param copy diverge by a single bit — after shard_params, so the
+        # divergent-copy state matches what a flaky chip leaves behind
+        if _fi._plan is not None and _fi._plan.bitflip_at:
+            flips = _fi.param_bitflips(self._step)
+            if flips:
+                from ..distributed import integrity as _integrity
+                devs = self._sdc_devices
+                if devs is None and self.mesh is not None:
+                    devs = list(self.mesh.devices.flat)
+                self._params = _integrity.inject_bitflips(
+                    self._params, flips, devs or jax.devices()[:1])
         # offload on backends without in-jit memory transfers (CPU): move the
         # slots chip-side around the compiled call instead
         offload_out = self._offload and not self._offload_in_jit
@@ -829,6 +907,7 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         guard = self._anomaly is not None
         ok = None
+        sdc_now = False
         t_tel = self._tel.begin(self._step)
         if self.accumulate_steps > 1:
             if isinstance(self._jitted, dict):
@@ -851,14 +930,21 @@ class TrainStep:
                  self._grad_accum, self._micro) = out
             self._micro_py += 1
         else:
-            rec = self._comm_records["step"] if self._comm_records else None
-            out = self._jitted(
-                self._params, self._opt_state, self._buffers, lr, next_key(),
-                in_arrays, lab_arrays, *self._gc_extra)
-            if guard:
-                loss, ok, self._params, self._opt_state, self._buffers = out
+            sdc_now = bool(self._sdc_every) and \
+                (self._step + 1) % self._sdc_every == 0
+            rec = (self._comm_records["sdc" if sdc_now else "step"]
+                   if self._comm_records else None)
+            if sdc_now:
+                loss, ok = self._sdc_step(lr, in_arrays, lab_arrays, guard)
             else:
-                loss, self._params, self._opt_state, self._buffers = out
+                out = self._jitted(
+                    self._params, self._opt_state, self._buffers, lr,
+                    next_key(), in_arrays, lab_arrays, *self._gc_extra)
+                if guard:
+                    loss, ok, self._params, self._opt_state, \
+                        self._buffers = out
+                else:
+                    loss, self._params, self._opt_state, self._buffers = out
         if rec is not None:
             from ..distributed import grad_comm as _gc
             _gc.record_step(rec)
@@ -886,19 +972,80 @@ class TrainStep:
                 if self._micro_py % self.accumulate_steps == 0:
                     loss = self._anomaly_policy_flush(loss)
             else:
-                loss = self._anomaly_policy_step(loss, ok)
+                loss = self._anomaly_policy_step(loss, ok, fetched=sdc_now)
         self._maybe_autosave()
         return Tensor(loss)
 
+    # -- silent-data-corruption check step (distributed/integrity.py) --------
+    def _sdc_step(self, lr, in_arrays, lab_arrays, guard):
+        """Dispatch the fingerprint-fused check-step executable and act on
+        the verdict. The per-replica fingerprint vector rides the ONE
+        combined host fetch the guard was paying for anyway (host_syncs is
+        audited either way). On a localized mismatch the gated executable
+        performed NO update, so the minority replica's input state is
+        peer-repaired in place and the SAME step re-dispatched with the
+        same key and batch — zero disk restores, zero steps lost."""
+        from ..distributed import integrity as _integrity
+        if self._sdc_jitted is None:
+            self._sdc_jitted = self._build(None, len(in_arrays), sdc=True)
+        key = next_key()
+        devs = self._sdc_devices
+
+        def dispatch():
+            out = self._sdc_jitted(
+                self._params, self._opt_state, self._buffers, lr, key,
+                in_arrays, lab_arrays, *self._gc_extra)
+            if guard:
+                f_loss, f_ok, f_fps = jax.device_get(
+                    (out[0], out[1], out[2]))
+                rest = out[3:]
+            else:
+                f_loss, f_fps = jax.device_get((out[0], out[1]))
+                f_ok = None
+                rest = out[2:]
+            _anomaly_counters["host_syncs"] += 1
+            return f_loss, f_ok, f_fps, rest
+
+        loss, ok, fps, rest = dispatch()
+        _integrity._count("fingerprint_checks")
+        bad = _integrity.localize_minority(fps)
+        if bad:
+            # majority vote localized the minority replica(s); the check
+            # executable is built without donation, so the corrupt input
+            # state is still alive — overwrite the bad replica buffers
+            # with a healthy peer's bytes and re-run this step
+            _integrity._count("fingerprint_mismatches")
+            for r in bad:
+                _integrity.note_repair(r)
+            _integrity._count("repairs", len(bad))
+            self._params = _integrity.repair_tree(self._params, bad, devs)
+            self._opt_state = _integrity.repair_tree(
+                self._opt_state, bad, devs)
+            self._buffers = _integrity.repair_tree(self._buffers, bad, devs)
+            _integrity._count("repair_redispatches")
+            loss, ok, fps, rest = dispatch()
+        elif bad is None:
+            # dp=2 tie: detected but unlocalizable. The gate already
+            # skipped the update; surface it through the anomaly flag so
+            # the skip/rollback policy takes over
+            _integrity._count("fingerprint_mismatches")
+            if ok is not None:
+                ok = False
+        self._params, self._opt_state, self._buffers = rest
+        return loss, ok
+
     # -- anomaly policy layer (host side of the compiled guard) --------------
-    def _anomaly_policy_step(self, loss, ok):
+    def _anomaly_policy_step(self, loss, ok, fetched=False):
         """Consume the step_ok flag: ONE combined (loss, step_ok) device
         fetch — the loss fetch the caller was doing anyway — then streak
         accounting and, under the rollback policy, checkpoint restore after
-        K consecutive bad steps. Returns the host-resident loss."""
+        K consecutive bad steps. Returns the host-resident loss.
+        ``fetched=True`` (sdc check steps): loss/ok are already host values
+        from the check step's own combined fetch, counted there."""
         policy, max_bad = self._anomaly
-        loss, ok = jax.device_get((loss, ok))
-        _anomaly_counters["host_syncs"] += 1
+        if not fetched:
+            loss, ok = jax.device_get((loss, ok))
+            _anomaly_counters["host_syncs"] += 1
         self.last_step_ok = bool(ok)
         if self.last_step_ok:
             self._bad_streak = 0

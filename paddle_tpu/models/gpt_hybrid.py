@@ -67,6 +67,17 @@ def init_gpt_params(config: GPTConfig, key, param_dtype=jnp.float32):
     }
 
 
+def gpt_params_fingerprint(params):
+    """Device-independent uint32 digest of a GPT param tree (the same
+    bit-exact fingerprint the sdc sentinel fuses into check steps — see
+    distributed/integrity.py). Two trees agree iff their raw bits agree:
+    the serving shadow audit's ``audit_ref`` copy, a peer-repaired
+    training replica, and a checkpoint round-trip can all be compared
+    with one host int instead of a leaf-by-leaf array diff."""
+    from ..distributed.integrity import fingerprint_arrays
+    return int(jax.device_get(fingerprint_arrays(params)))
+
+
 def gpt_param_specs(config: GPTConfig, pp=1, zero_stage=1):
     """PartitionSpecs per param. Block leaves get a leading 'pp' axis when
     pipelining; matmul weights shard over 'mp' Megatron-style.

@@ -51,6 +51,11 @@ def _serving():
     return metrics.serving_counters()
 
 
+def _sdc():
+    from ..distributed import integrity
+    return integrity.sdc_counters()
+
+
 _RECOVERY_KEYS = ("snapshots", "snapshot_restores", "preempt_drains",
                   "requeued", "replayed", "respawns", "stale_failovers",
                   "rolling_restarts", "dropped")
@@ -86,6 +91,7 @@ def register_default_families():
     REGISTRY.register_family("recovery", _recovery)
     REGISTRY.register_family("step", _step)
     REGISTRY.register_family("elastic", _elastic)
+    REGISTRY.register_family("sdc", _sdc)
 
 
 def register_supervisor(sup):

@@ -390,14 +390,25 @@ def reset_fault_counters():
 
 
 def fault_summary():
-    """One-line human-readable fault-tolerance report."""
+    """One-line human-readable fault-tolerance report (an ``sdc:``
+    segment appears only when the integrity sentinel did any work)."""
     c = fault_counters()
     a, k = c["anomaly"], c["checkpoint"]
-    return (f"steps: {a['steps']}  host-syncs: {a['host_syncs']}  "
+    line = (f"steps: {a['steps']}  host-syncs: {a['host_syncs']}  "
             f"bad: {a['bad_steps']}  skipped: {a['skipped_updates']}  "
             f"rollbacks: {a['rollbacks']}  saves: {k['saves']}  "
             f"retries: {k['save_retries']}  quarantined: {k['quarantined']}  "
             f"preempt-saves: {k['preempt_saves']}")
+    from ..distributed import integrity as _integrity
+    s = _integrity.sdc_counters()
+    if any(s.values()):
+        line += (f"  sdc: checks={s['fingerprint_checks']} "
+                 f"mismatches={s['fingerprint_mismatches']} "
+                 f"repairs={s['repairs']} "
+                 f"redispatches={s['repair_redispatches']} "
+                 f"scrubs={s['scrubs']} rot={s['rot_found']} "
+                 f"quarantined={s['quarantined_ranks']}")
+    return line
 
 
 # -- serving counters ---------------------------------------------------------

@@ -697,6 +697,9 @@ class Engine:
         self._install_progress = {}    # rid -> pages installed so far
         self._transfer_budget = int(
             flags.get("FLAGS_serving_transfer_pages_per_boundary", 4))
+        # end-to-end KV wire integrity: stamp outbound page payloads with
+        # CRC32 at creation, re-verify at install (kv_transfer.py)
+        self._kv_crc = bool(flags.get("FLAGS_kv_transfer_crc", False))
         self._page_read = None
         self._page_write = None
         # per-role trace gates (host counters beside the global
@@ -993,6 +996,10 @@ class Engine:
         # chaos hook: simulated ABRUPT engine death (no flush) — recovery
         # must come from the last periodic snapshot or request replay
         _fi.maybe_kill_serving(self.tag, self._step_count)
+        # chaos hook: FINITE silent corruption of the live KV pool — the
+        # all-finite anomaly guard cannot see it; only the shadow audit can
+        if _fi._plan is not None and _fi._plan.kv_bitflip_at:
+            self._maybe_kv_bitflip()
         now = time.perf_counter()
 
         # 1) evict running requests whose deadline passed (same boundary
@@ -1470,9 +1477,12 @@ class Engine:
             if self._kv_quant:
                 ks = self.pool.k_scale[:, phys].copy()
                 vs = self.pool.v_scale[:, phys].copy()
-            tr.append(PagePayload(li, np.asarray(jax.device_get(kpage)),
+            payload = PagePayload(li, np.asarray(jax.device_get(kpage)),
                                   np.asarray(jax.device_get(vpage)),
-                                  ks, vs))
+                                  ks, vs)
+            if self._kv_crc:
+                payload.stamp()
+            tr.append(payload)
 
     def _finish_handoff(self, b):
         """Prefill complete on a PREFILL worker: stream the remaining
@@ -1526,6 +1536,22 @@ class Engine:
         """Is a transfer for request ``rid`` currently installing here?"""
         return rid in self._install_progress
 
+    def _maybe_kv_bitflip(self):
+        """Chaos hook body (``FaultPlan.kv_bitflip_at``): flip scheduled
+        bits in the live K cache via a host round-trip. A mantissa flip
+        stays FINITE — exactly the corruption class the all-finite guard
+        is blind to and the sampled shadow audit exists for."""
+        flips = _fi.maybe_kv_bitflip(self.tag, self._step_count)
+        if not flips or self._kc is None:
+            return
+        host = np.asarray(jax.device_get(self._kc)).copy()
+        for page, layer, bit in flips:
+            view = host[int(layer) % host.shape[0], int(page) % host.shape[1]]
+            flat = view.view(np.uint8).reshape(-1)
+            byte, off = divmod(int(bit), 8)
+            flat[byte] ^= np.uint8(1 << off)
+        self._kc = jax.device_put(host, self._kc.sharding)
+
     def _install_page(self, payload, dst):
         """Write one page payload into physical page ``dst`` (ONE traced
         executable for every page of every transfer)."""
@@ -1562,13 +1588,34 @@ class Engine:
                 self._resolve(req, EXPIRED, count="expired")
                 continue
             installed = self._install_progress[rid]
+            refused = False
             while budget > 0 and installed < len(tr.pages):
                 dst = self.pool.stage(rid, 1)
                 if dst is None:
                     break                  # page pressure: retry next boundary
-                self._install_page(tr.pages[installed], dst[0])
+                payload = _fi.maybe_corrupt_kv_payload(tr.pages[installed])
+                if payload.crc is not None:
+                    from ..distributed import integrity as _integrity
+                    from .kv_transfer import KVIntegrityError
+                    _integrity._count("crc_checks")
+                    try:
+                        payload.verify()
+                    except KVIntegrityError:
+                        # typed refusal: corrupt bytes never reach the
+                        # pool. Drop the whole inbound stream — the
+                        # supervisor sees has_transfer() go False and
+                        # re-offers the RETAINED (still clean) payloads
+                        _integrity._count("crc_refusals")
+                        metrics.bump("transfer_crc_refusals")
+                        self.pool.release_staged(rid)
+                        self._install_progress.pop(rid, None)
+                        refused = True
+                        break
+                self._install_page(payload, dst[0])
                 installed += 1
                 budget -= 1
+            if refused:
+                continue
             self._install_progress[rid] = installed
             if tr.done and installed == tr.total_pages \
                     and self._seat_transfer(tr, now):

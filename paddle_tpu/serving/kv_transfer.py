@@ -35,12 +35,41 @@ State flags (host-side, single supervising thread — no locking):
 - ``failed``   the transfer itself is unusable (e.g. the decode worker's
                params_version moved mid-flight) while the request is
                still live — the supervisor MUST replay it.
+
+End-to-end wire integrity (``FLAGS_kv_transfer_crc``): the prefill side
+stamps each payload with a CRC32 over the page bytes and scale columns
+at creation; the decode side re-computes it just before installing the
+page and raises ``KVIntegrityError`` on mismatch — a typed refusal the
+engine turns into dropping the transfer so the supervisor re-offers the
+RETAINED (still clean) host payloads. Default off: ``crc=None`` and
+``verify()`` is a no-op, wire format unchanged.
 """
 from __future__ import annotations
 
 import time
+import zlib
 
 import numpy as np
+
+
+class KVIntegrityError(RuntimeError):
+    """A streamed KV page's bytes no longer match the CRC stamped at the
+    prefill side — wire/host corruption. The payload must be refused
+    (never installed); the retained transfer can be re-offered."""
+
+
+def payload_crc(payload):
+    """CRC32 over a payload's K/V page bytes and (when quantized) the
+    fp32 scale columns — the exact bytes ``_install_page`` will seat."""
+    crc = zlib.crc32(np.ascontiguousarray(payload.k).view(np.uint8))
+    crc = zlib.crc32(np.ascontiguousarray(payload.v).view(np.uint8), crc)
+    if payload.k_scale is not None:
+        crc = zlib.crc32(
+            np.ascontiguousarray(payload.k_scale).view(np.uint8), crc)
+    if payload.v_scale is not None:
+        crc = zlib.crc32(
+            np.ascontiguousarray(payload.v_scale).view(np.uint8), crc)
+    return crc & 0xFFFFFFFF
 
 
 class PagePayload:
@@ -48,14 +77,31 @@ class PagePayload:
     every layer (``[L, page_size, nh, d]`` at the pool's storage dtype)
     and, for quantized pools, the fp32 per-page scale columns ``[L]``."""
 
-    __slots__ = ("index", "k", "v", "k_scale", "v_scale")
+    __slots__ = ("index", "k", "v", "k_scale", "v_scale", "crc")
 
-    def __init__(self, index, k, v, k_scale=None, v_scale=None):
+    def __init__(self, index, k, v, k_scale=None, v_scale=None, crc=None):
         self.index = int(index)          # logical page number within the prompt
         self.k = np.asarray(k)
         self.v = np.asarray(v)
         self.k_scale = None if k_scale is None else np.asarray(k_scale)
         self.v_scale = None if v_scale is None else np.asarray(v_scale)
+        self.crc = None if crc is None else int(crc)
+
+    def stamp(self):
+        """Record the current bytes' CRC32 (prefill side, at creation)."""
+        self.crc = payload_crc(self)
+        return self
+
+    def verify(self):
+        """Raise ``KVIntegrityError`` if the bytes drifted from the
+        stamped CRC. No-op for unstamped payloads (CRC flag off)."""
+        if self.crc is None:
+            return
+        got = payload_crc(self)
+        if got != self.crc:
+            raise KVIntegrityError(
+                f"KV page {self.index}: crc {got:#010x} != stamped "
+                f"{self.crc:#010x}")
 
     @property
     def nbytes(self):

@@ -87,6 +87,9 @@ def _zero():
         "prefill_handoffs": 0, "transfers": 0, "transfer_pages": 0,
         "transfer_bytes": 0, "transfer_installs": 0,
         "transfer_time_s": 0.0,
+        # KV wire integrity (FLAGS_kv_transfer_crc): payloads whose bytes
+        # failed the stamped CRC32 at install time — refused, never seated
+        "transfer_crc_refusals": 0,
         "affinity_hits": 0, "disagg_fallbacks": 0, "role_rebalances": 0,
         # page read/write executables for the transfer path (memoized like
         # every other builder — frozen after warmup)
@@ -429,6 +432,14 @@ def serving_summary():
                f"scale: +{c['scale_ups']}/-{c['scale_downs']}  "
                f"weight-swaps: {c['weight_swaps']}"
                + (f"  {cls_p99}" if cls_p99 else ""))
+    sdc = ""
+    from ..distributed import integrity as _integrity
+    s = _integrity.sdc_counters()
+    if s["audits"] or s["crc_checks"] or c["transfer_crc_refusals"]:
+        sdc = (f"  sdc: audits: {s['audits']} "
+               f"({s['audit_failures']} failed)  "
+               f"crc: {s['crc_checks']} checked / "
+               f"{s['crc_refusals']} refused")
     return (f"requests: {c['submitted']} submitted / {c['completed']} done "
             f"({c['expired']} expired, {c['rejected']} rejected)  "
             f"tokens: {c['tokens_out']}  tokens/s: {c['tokens_per_s']:.1f}  "
@@ -436,4 +447,4 @@ def serving_summary():
             f"queue: {c['queue_depth_mean']:.1f} avg/{c['queue_depth_max']} max  "
             f"executables: {c['prefill_traces']} prefill + "
             f"{c['decode_traces']} decode + {c['paged_traces']} paged"
-            f"{paged}{quant}{spec}{mp}{disagg}{waste}{slo}{heal}")
+            f"{paged}{quant}{spec}{mp}{disagg}{waste}{slo}{heal}{sdc}")

@@ -56,6 +56,15 @@ class ChipLossError(RuntimeError):
     over the survivors."""
 
 
+class AuditFailure(RuntimeError):
+    """A replica's shadow-audit suspicion score reached
+    ``FLAGS_serving_audit_threshold``: its outputs diverged from the
+    ``generate_from_params`` oracle repeatedly — silent state corruption
+    (e.g. a finite KV bit flip the all-finite guard cannot see). The
+    replica is failed over through the ordinary reform/respawn machinery
+    before the corruption spreads through its prefix cache."""
+
+
 class _Replica:
     """One supervised engine slot: the engine itself is replaceable (it
     dies and respawns), the snapshot manager and heartbeat are not."""
@@ -164,9 +173,21 @@ class ServingSupervisor:
                  snapshot_every=None, max_restarts=None, heartbeat_dir=None,
                  heartbeat_timeout=None, autoscale=None, tenant_rate=None,
                  tenant_burst=None, mp=None, devices=None,
-                 elastic_grow=None, roles=None):
+                 elastic_grow=None, roles=None, audit_ref=None):
         flags = get_flags()
         self.engine_factory = engine_factory
+        # -- sampled shadow audit (FLAGS_serving_audit_rate): replay that
+        # fraction of finished greedy requests through the
+        # generate_from_params oracle and bitwise-compare tokens. Needs
+        # ``audit_ref=(raw_params, config)`` — the engine transforms its
+        # own params at construction (logical-qkv / mp-shard / quantize),
+        # so the supervisor keeps an untransformed reference copy.
+        self._audit_ref = audit_ref
+        self._audit_rate = float(
+            flags.get("FLAGS_serving_audit_rate", 0.0) or 0.0)
+        self._audit_threshold = max(1, int(
+            flags.get("FLAGS_serving_audit_threshold", 2)))
+        self._audit_warned = False
         # -- disaggregated prefill/decode serving (serving/kv_transfer.py):
         # ``roles`` assigns each replica a serving role — "prefill"
         # workers run only the big-chunk rungs over all their slots and
@@ -956,6 +977,13 @@ class ServingSupervisor:
 
     def _collect(self, rep):
         popped = rep.engine.pop_results()
+        failed_audit = ()
+        if self._audit_rate > 0.0 and popped:
+            failed_audit = self._audit(rep, popped)
+            for rid in failed_audit:
+                # a mismatched result is NEVER delivered: the request is
+                # still unacked and will be recomputed bitwise elsewhere
+                popped.pop(rid, None)
         with self._lock:
             for rid, res in popped.items():
                 # first result wins: a snapshot-respawned replica recomputes
@@ -963,6 +991,74 @@ class ServingSupervisor:
                 # deterministic, so dropping the duplicate loses nothing
                 if not self._acked(rid):
                     self._results[rid] = res
+        if failed_audit:
+            from ..distributed import integrity as _integrity
+            sus = _integrity.sdc_counters().get(
+                f"suspicion_replica{rep.idx}", 0)
+            if sus >= self._audit_threshold:
+                # repeat offender: fail the whole replica over before its
+                # corrupted state spreads through the prefix cache — the
+                # ordinary respawn path replays everything it still owed
+                _integrity.clear_suspicion(rep.idx)
+                self._on_failure(rep, AuditFailure(
+                    f"replica {rep.idx}: {sus} shadow-audit mismatches "
+                    f"(threshold {self._audit_threshold})"))
+            else:
+                self._replay(failed_audit)
+
+    def _audit_sampled(self, rid):
+        """Deterministic per-request sampling decision (stable across
+        replays: the same rid always lands on the same side of the
+        rate)."""
+        import zlib
+        u = (zlib.crc32(str(rid).encode()) % 1000000) / 1000000.0
+        return u < self._audit_rate
+
+    def _audit(self, rep, popped):
+        """Sampled shadow audit: re-run sampled finished GREEDY requests
+        through the raw-params ``generate_from_params`` oracle and
+        bitwise-compare the token streams (the engine parity contract
+        makes any divergence corruption, not noise). Returns the rids
+        that failed; their suspicion is charged to ``rep``."""
+        if self._audit_ref is None:
+            if not self._audit_warned:
+                self._audit_warned = True
+                import warnings
+                warnings.warn(
+                    "FLAGS_serving_audit_rate > 0 but no audit_ref="
+                    "(params, config) was passed to ReplicatedEngines; "
+                    "the shadow audit is disabled")
+            return ()
+        from ..distributed import integrity as _integrity
+        from ..models.generation import generate_from_params
+        import numpy as np
+        params, config = self._audit_ref
+        failed = []
+        for rid, res in popped.items():
+            if res.finish_reason not in ("stop", "length"):
+                continue
+            if not self._audit_sampled(rid):
+                continue
+            with self._lock:
+                req = self._requests.get(rid)
+            if req is None or getattr(req, "do_sample", False):
+                continue            # greedy-only oracle
+            prompt = np.asarray(res.prompt).reshape(-1)
+            out = generate_from_params(
+                params, prompt[None, :].astype(np.int32), config,
+                max_new_tokens=req.max_new_tokens, do_sample=False,
+                eos_token_id=req.eos_token_id,
+                stop_token_ids=req.stop_token_ids)
+            expect = [int(t) for t in
+                      np.asarray(out._data)[0, len(prompt):].tolist()]
+            got = [int(t) for t in res.tokens]
+            # prefix compare: a finished row's oracle tail is eos padding;
+            # any real corruption flips tokens INSIDE the emitted stream
+            ok = bool(got) and got == expect[:len(got)]
+            _integrity.note_audit(ok, rep.idx)
+            if not ok:
+                failed.append(rid)
+        return tuple(failed)
 
     def _on_failure(self, rep, err):
         """Replica death: respawn from its last snapshot when one exists

@@ -58,6 +58,20 @@ Serving chaos (the self-healing serving ladder):
                           loss in one plan. Ranks are GLOBAL chip indices
                           into the fleet's device list — losing one chip
                           marks its whole mp group down.
+  * ``bitflip_at``        — silent-data-corruption schedule: ``{step:
+                          (rank, leaf, bit)}`` flips one MANTISSA bit of a
+                          param leaf in exactly ONE dp replica's copy (the
+                          value stays finite — invisible to the all-finite
+                          guard, caught only by the cross-replica
+                          fingerprint under ``FLAGS_sdc_check_every``).
+                          One-shot per step, like a real flipped bit.
+  * ``kv_bitflip_at`` /   — the serving twins: a finite bit flip in an
+    ``corrupt_kv_wire``     engine's KV pool at a serving step (caught by
+                          the shadow audit, not the anomaly guard), and
+                          1-based page-install indices whose kv_transfer
+                          wire payload is corrupted as a COPY with the CRC
+                          stamp preserved (refused by the CRC check; the
+                          retained clean payload is re-offered).
   * ``surge``             — an ``ArrivalSurge``: a deterministic per-step
                           arrival-count schedule (seeded Poisson base rate
                           with a surge window at a multiplied rate). The
@@ -132,7 +146,8 @@ _ZERO_STATS = {"poisoned_steps": 0, "io_errors": 0, "preemptions": 0,
                "snapshot_writes_seen": 0, "snapshot_io_errors": 0,
                "heartbeats_dropped": 0, "surged_arrivals": 0,
                "chip_losses": 0, "chip_returns": 0,
-               "serving_chip_losses": 0, "serving_chip_returns": 0}
+               "serving_chip_losses": 0, "serving_chip_returns": 0,
+               "bitflips": 0, "kv_bitflips": 0, "kv_wire_corruptions": 0}
 
 
 class FaultPlan:
@@ -143,7 +158,9 @@ class FaultPlan:
                  kill_engine_tag=None, io_error_on_snapshots=(),
                  stale_heartbeat_ranks=(), surge=None,
                  chip_loss_at=None, chip_return_at=None,
-                 serving_chip_loss_at=None, serving_chip_return_at=None):
+                 serving_chip_loss_at=None, serving_chip_return_at=None,
+                 bitflip_at=None, kv_bitflip_at=None,
+                 kv_bitflip_engine_tag=None, corrupt_kv_wire=()):
         self.nan_at_steps = frozenset(int(s) for s in nan_at_steps)
         self.io_error_on_writes = frozenset(int(n) for n in io_error_on_writes)
         self.preempt_at_step = (None if preempt_at_step is None
@@ -170,6 +187,40 @@ class FaultPlan:
         self.chip_return_at = _ranks_by_step(chip_return_at)
         self.serving_chip_loss_at = _ranks_by_step(serving_chip_loss_at)
         self.serving_chip_return_at = _ranks_by_step(serving_chip_return_at)
+
+        def _flips_by_step(sched, width):
+            # {step: entry | [entries]} -> {step: (entry, ...)}; each entry
+            # is padded with a default mantissa bit (a SILENT flip — the
+            # value stays finite, invisible to the all-finite guard)
+            out = {}
+            for s, entries in (sched or {}).items():
+                if entries and not isinstance(entries[0], (tuple, list)):
+                    entries = (entries,)
+                norm = []
+                for e in entries:
+                    e = tuple(e)
+                    if len(e) == width - 1:
+                        e = e + (12,)          # default: mantissa bit 12
+                    norm.append(e)
+                out[int(s)] = tuple(norm)
+            return out
+
+        # {step: (rank, leaf_name, bit)} — flip one bit of element 0 of
+        # that param leaf in exactly ONE dp replica's copy
+        self.bitflip_at = _flips_by_step(bitflip_at, 3)
+        # {step: (page, layer, bit)} — flip one bit of a KV-pool page in
+        # the engine that polls at that serving step
+        self.kv_bitflip_at = _flips_by_step(kv_bitflip_at, 3)
+        self.kv_bitflip_engine_tag = kv_bitflip_engine_tag
+        # 1-based page-install indices whose wire payload is corrupted (a
+        # COPY is corrupted at install time; the sender's retained payload
+        # stays clean, so a CRC refusal can re-offer it)
+        self.corrupt_kv_wire = frozenset(int(n) for n in corrupt_kv_wire)
+        self._kv_wire_seen = 0
+        # one-shot: re-walking a step after a repair/restore must not
+        # re-corrupt (the physical flip happened once)
+        self._bitflips_fired = set()
+        self._kv_bitflips_fired = set()
         # high-water marks of steps each run has REACHED: a restore that
         # rewinds the step counter must keep already-fired losses visible.
         # Training and serving walk SEPARATE watermarks — their step
@@ -194,7 +245,10 @@ class FaultPlan:
                 f"chip_loss_at={dict(sorted((k, sorted(v)) for k, v in self.chip_loss_at.items()))}, "
                 f"chip_return_at={dict(sorted((k, sorted(v)) for k, v in self.chip_return_at.items()))}, "
                 f"serving_chip_loss_at={dict(sorted((k, sorted(v)) for k, v in self.serving_chip_loss_at.items()))}, "
-                f"serving_chip_return_at={dict(sorted((k, sorted(v)) for k, v in self.serving_chip_return_at.items()))})")
+                f"serving_chip_return_at={dict(sorted((k, sorted(v)) for k, v in self.serving_chip_return_at.items()))}, "
+                f"bitflip_at={dict(sorted(self.bitflip_at.items()))}, "
+                f"kv_bitflip_at={dict(sorted(self.kv_bitflip_at.items()))}, "
+                f"corrupt_kv_wire={sorted(self.corrupt_kv_wire)})")
 
 
 _plan: FaultPlan | None = None
@@ -375,6 +429,70 @@ def maybe_drop_heartbeat(rank):
         return False
     _plan.stats["heartbeats_dropped"] += 1
     return True
+
+
+def param_bitflips(step):
+    """Silent-data-corruption schedule for training: the ``(rank, leaf,
+    bit)`` entries the active plan flips at ``step``, fired ONCE per step
+    (a repair/restore that re-walks the step must not re-corrupt — the
+    physical flip happened once). The caller (jit.TrainStep under
+    ``FLAGS_sdc_check_every``) applies each entry to exactly one dp
+    replica's copy of the named param leaf via
+    ``distributed.integrity.inject_bitflips``. Zero-cost inactive;
+    returns a tuple."""
+    if _plan is None or not _plan.bitflip_at:
+        return ()
+    step = int(step)
+    if step in _plan._bitflips_fired:
+        return ()
+    entries = _plan.bitflip_at.get(step, ())
+    if entries:
+        _plan._bitflips_fired.add(step)
+        _plan.stats["bitflips"] += len(entries)
+    return entries
+
+
+def maybe_kv_bitflip(tag, step):
+    """Serving twin: the ``(page, layer, bit)`` entries to flip in the
+    KV pool of the engine whose tag matches (any engine when
+    ``kv_bitflip_engine_tag`` is None) at serving step ``step`` —
+    one-shot per step. The flip stays FINITE (mantissa bit), so the
+    all-finite anomaly guard cannot see it; only the shadow audit can.
+    Zero-cost inactive; returns a tuple."""
+    if _plan is None or not _plan.kv_bitflip_at:
+        return ()
+    if _plan.kv_bitflip_engine_tag is not None \
+            and tag != _plan.kv_bitflip_engine_tag:
+        return ()
+    step = int(step)
+    if step in _plan._kv_bitflips_fired:
+        return ()
+    entries = _plan.kv_bitflip_at.get(step, ())
+    if entries:
+        _plan._kv_bitflips_fired.add(step)
+        _plan.stats["kv_bitflips"] += len(entries)
+    return entries
+
+
+def maybe_corrupt_kv_payload(payload):
+    """Wire-corruption hook, called by the decode engine for each page
+    payload at INSTALL time: the nth install (1-based, across engines)
+    scheduled in ``corrupt_kv_wire`` returns a corrupted COPY — one bit
+    flipped in the page bytes, the original CRC stamp preserved — so a
+    CRC verify must refuse it while the sender's retained payload stays
+    clean for the re-offer. Returns ``payload`` unchanged otherwise
+    (same object identity; zero-cost inactive)."""
+    if _plan is None or not _plan.corrupt_kv_wire:
+        return payload
+    _plan._kv_wire_seen += 1
+    if _plan._kv_wire_seen not in _plan.corrupt_kv_wire:
+        return payload
+    _plan.stats["kv_wire_corruptions"] += 1
+    from ..serving.kv_transfer import PagePayload
+    k = payload.k.copy()
+    k.view(np.uint8).reshape(-1)[0] ^= 0x10
+    return PagePayload(payload.index, k, payload.v,
+                       payload.k_scale, payload.v_scale, crc=payload.crc)
 
 
 def stats():

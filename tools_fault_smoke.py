@@ -70,8 +70,37 @@ mp groups on mp-portable snapshots):
                               chip returns, the group grows back with
                               zero drops and ZERO new traces
 
+Silent-data-corruption ladder (run_sdc_ladder; the integrity sentinel —
+FLAGS_sdc_check_every fingerprints, peer repair, shadow audit, wire CRC):
+
+ 15. sdc-train-bitflip-repair — a mantissa flip on one replica's params
+                              is caught by the fused cross-replica
+                              fingerprint, localized by majority vote,
+                              peer-repaired IN PLACE and the step
+                              re-dispatched: final params bitwise equal
+                              the fault-free run, zero disk restores,
+                              and the verdict rides the guard's one
+                              combined fetch (host_syncs == steps)
+ 16. sdc-train-quarantine   — two flips on the SAME rank cross the
+                              repair-charge threshold; the elastic
+                              supervisor's quarantine policy reports the
+                              chip as LOST (reform, not rewind)
+ 17. sdc-serve-audit-catch  — FINITE KV corruption (exponent-bit flip)
+                              the all-finite guard cannot see; the
+                              sampled shadow audit catches the token
+                              divergence and fails the replica over —
+                              zero drops, bitwise
+ 18. sdc-kv-wire-crc        — a prefill->decode page payload corrupted
+                              on the wire is refused by its CRC32 stamp;
+                              the retained stream is re-offered and
+                              seats bitwise
+ 19. sdc-ckpt-scrub         — bit rot in a retained snapshot is found by
+                              the cadence scrub and quarantined to
+                              *.corrupt before any restore needs it
+
   python tools_fault_smoke.py [--steps N] [--kill-step K] [--seed S]
                               [--skip-serving] [--skip-elastic]
+                              [--skip-sdc]
 
 Prints, machine-greppable:
 
@@ -862,6 +891,291 @@ def run_serving_ladder(quick=True, deterministic=False, seed=7):
     return out
 
 
+# ---------------------------------------------------------------------------
+# silent-data-corruption (SDC) ladder — fingerprints, peer repair, shadow
+# audit, wire CRC, at-rest scrub
+
+
+_SDC_FLAG_DEFAULTS = {
+    "FLAGS_sdc_check_every": 0,
+    "FLAGS_sdc_quarantine_threshold": 2,
+    "FLAGS_serving_audit_rate": 0.0,
+    "FLAGS_serving_audit_threshold": 2,
+    "FLAGS_kv_transfer_crc": False,
+}
+
+
+def _sdc_train_run(flags, plan=None, steps=6, seed=7):
+    """One short dp=8 data-parallel run under ``flags`` (and an optional
+    fault plan); returns (loss, params, sdc counters, anomaly counters)."""
+    import contextlib
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import env as dist_env, integrity
+    from paddle_tpu.jit.train_step import (anomaly_counters,
+                                           reset_anomaly_counters)
+    from paddle_tpu.utils import fault_injection as fi
+
+    integrity.reset_sdc_counters()
+    reset_anomaly_counters()
+    dist_env.set_mesh(None)
+    merged = dict(_SDC_FLAG_DEFAULTS)
+    merged["FLAGS_grad_comm"] = "on"
+    merged.update(flags or {})
+    step = build_step(paddle, nn, seed, flags=merged,
+                      mesh=dist_env.create_hybrid_mesh(dp=8))
+    X, Y = make_data(steps, seed + 1)
+    ctx = fi.inject(plan) if plan is not None else contextlib.nullcontext()
+    with ctx:
+        params, loss = run(paddle, step, X, Y)
+    out = (loss, params, dict(integrity.sdc_counters()),
+           dict(anomaly_counters()))
+    dist_env.set_mesh(None)
+    paddle.set_flags(dict(_SDC_FLAG_DEFAULTS))
+    return out
+
+
+def leg_sdc_train_repair(steps, seed, deterministic=False):
+    """A mantissa bit flip lands on one replica's replicated params: the
+    fused fingerprint catches it at the next check boundary, the majority
+    vote localizes the minority replica, the peer repair rewrites its
+    bytes in place and re-dispatches the SAME step — the final params are
+    BITWISE the fault-free run's, with zero disk restores and zero steps
+    lost. Also asserts the exactness contract (sdc-on clean == sdc-off
+    clean, bitwise) and, in the full rung, that the verdict rides the
+    guard's existing combined fetch (host_syncs == steps)."""
+    from paddle_tpu.utils import fault_injection as fi
+
+    g_loss, g_params, _, _ = _sdc_train_run({}, steps=steps, seed=seed)
+    c_loss, c_params, c_sdc, _ = _sdc_train_run(
+        {"FLAGS_sdc_check_every": 1}, steps=steps, seed=seed)
+    clean_ok = (c_loss == g_loss
+                and all(np.array_equal(c_params[n], g_params[n])
+                        for n in g_params)
+                and c_sdc["fingerprint_checks"] == steps
+                and c_sdc["fingerprint_mismatches"] == 0)
+    plan = fi.FaultPlan(bitflip_at={2: (3, None, 12)})
+    f_loss, f_params, f_sdc, _ = _sdc_train_run(
+        {"FLAGS_sdc_check_every": 1}, plan=plan, steps=steps, seed=seed)
+    repaired_ok = (f_sdc["fingerprint_mismatches"] == 1
+                   and f_sdc["repairs"] == 1
+                   and f_sdc["repair_redispatches"] == 1
+                   and f_sdc.get("repairs_rank3") == 1)
+    bitwise = (f_loss == g_loss
+               and all(np.array_equal(f_params[n], g_params[n])
+                       for n in g_params))
+    syncs_ok = True
+    if not deterministic:
+        _, _, _, s_an = _sdc_train_run(
+            {"FLAGS_sdc_check_every": 1, "FLAGS_anomaly_policy": "skip"},
+            steps=steps, seed=seed)
+        syncs_ok = (s_an["steps"] == steps
+                    and s_an["host_syncs"] == steps)
+    return {"ok": clean_ok and repaired_ok and bitwise and syncs_ok,
+            "clean_bitwise": clean_ok, "repaired": repaired_ok,
+            "bitwise": bitwise, "host_syncs_flat": syncs_ok,
+            "sdc": f_sdc}
+
+
+def leg_sdc_train_quarantine(steps, seed):
+    """A repeat offender: two flips land on the SAME rank across the run.
+    Every one is repaired in place (training never rewinds), the repair
+    ledger charges the rank, and once the charge crosses
+    FLAGS_sdc_quarantine_threshold the quarantine policy reports the chip
+    to the elastic supervisor's failure detector as LOST."""
+    from paddle_tpu.distributed import integrity
+    from paddle_tpu.distributed.elastic import ElasticMeshSupervisor
+    from paddle_tpu.utils import fault_injection as fi
+
+    plan = fi.FaultPlan(bitflip_at={1: (2, None, 12), 3: (2, None, 14)})
+    loss, _, sdc, _ = _sdc_train_run(
+        {"FLAGS_sdc_check_every": 1, "FLAGS_sdc_quarantine_threshold": 2},
+        plan=plan, steps=steps, seed=seed)
+    charged = sdc.get("repairs_rank2") == 2 and sdc["repairs"] == 2
+    # the ledger survives the run teardown until reset: re-arm the
+    # threshold flag and ask the detector what it would do about it
+    import paddle_tpu as paddle
+    paddle.set_flags({"FLAGS_sdc_check_every": 1,
+                      "FLAGS_sdc_quarantine_threshold": 2})
+    for _ in range(2):
+        integrity.note_repair(2)
+    sup = ElasticMeshSupervisor(lambda *a, **kw: None, None, 8,
+                                quarantine=True)
+    detected = 2 in sup._detect(0)
+    sup_off = ElasticMeshSupervisor(lambda *a, **kw: None, None, 8)
+    policy_gated = 2 not in sup_off._detect(0)
+    integrity.reset_sdc_counters()
+    paddle.set_flags(dict(_SDC_FLAG_DEFAULTS))
+    return {"ok": charged and detected and policy_gated,
+            "charged": charged, "detected": detected,
+            "policy_gated": policy_gated, "loss_finite": np.isfinite(loss)}
+
+
+def leg_sdc_serve_audit(seed):
+    """FINITE KV-cache corruption on one replica: the all-finite anomaly
+    guard is blind to it, but the sampled shadow audit replays finished
+    greedy requests through the raw-params oracle, catches the token
+    divergence, charges suspicion, and fails the replica over through the
+    ordinary reform path — zero drops, every delivered stream bitwise."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import integrity
+    from paddle_tpu.serving.supervisor import ServingSupervisor
+    from paddle_tpu.utils import fault_injection as fi
+
+    serving, factory, ref, _, _ = _serving_fixture()
+    integrity.reset_sdc_counters()
+    rng = np.random.default_rng(seed)
+    reqs = [serving.Request(rng.integers(0, 97, 6 + (i % 3)),
+                            max_new_tokens=8) for i in range(4)]
+    gold = {r.request_id: ref(r.prompt, 8) for r in reqs}
+    paddle.set_flags({"FLAGS_serving_audit_rate": 1.0,
+                      "FLAGS_serving_audit_threshold": 1})
+    try:
+        sup = ServingSupervisor(factory, num_replicas=2,
+                                audit_ref=(_SERVING_PC["params"],
+                                           _SERVING_PC["cfg"]))
+        # flip the top exponent bit of dim 0 of EVERY position's key in
+        # one page of replica0's live pool: huge but FINITE numbers that
+        # saturate the softmax — invisible to any isfinite sweep, fatal
+        # to the owning stream's tokens (2048 bits span one position)
+        flips = [(1, 0, 2048 * p + 30) for p in range(8)]
+        with fi.inject(fi.FaultPlan(kv_bitflip_at={2: flips},
+                                    kv_bitflip_engine_tag="replica0")):
+            results = sup.run(reqs)
+        sup.shutdown()
+    finally:
+        paddle.set_flags(dict(_SDC_FLAG_DEFAULTS))
+    s = integrity.sdc_counters()
+    miss = [r.request_id for r in reqs if r.request_id not in results]
+    wrong = [r.request_id for r in reqs if r.request_id in results
+             and list(results[r.request_id].tokens) != gold[r.request_id]]
+    stats = fi.stats()
+    integrity.reset_sdc_counters()
+    return {"ok": (not miss and not wrong and s["audit_failures"] >= 1
+                   and stats["kv_bitflips"] == 8),
+            "dropped": len(miss), "wrong": len(wrong),
+            "audits": s["audits"], "audit_failures": s["audit_failures"]}
+
+
+def leg_sdc_kv_wire_crc(seed):
+    """A KV page payload is corrupted ON THE WIRE between the prefill and
+    decode workers: the CRC32 stamped at stream time refuses the seat,
+    the transfer is dropped (typed, counted), the supervisor re-offers
+    the RETAINED clean payloads, and the stream seats bitwise."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import integrity
+    from paddle_tpu.serving import metrics as smetrics
+    from paddle_tpu.serving.supervisor import ServingSupervisor
+    from paddle_tpu.utils import fault_injection as fi
+
+    serving, factory, ref, _, _ = _serving_fixture()
+    integrity.reset_sdc_counters()
+    before = smetrics.serving_counters()["transfer_crc_refusals"]
+    rng = np.random.default_rng(seed)
+    reqs = [serving.Request(rng.integers(0, 97, 13 + 4 * i),
+                            max_new_tokens=4) for i in range(3)]
+    gold = {r.request_id: ref(r.prompt, 4) for r in reqs}
+    paddle.set_flags({"FLAGS_kv_transfer_crc": True})
+    try:
+        sup = ServingSupervisor(factory, num_replicas=2,
+                                roles=("prefill", "decode"))
+        with fi.inject(fi.FaultPlan(corrupt_kv_wire=[1])):
+            results = sup.run(reqs)
+        sup.shutdown()
+    finally:
+        paddle.set_flags(dict(_SDC_FLAG_DEFAULTS))
+    s = integrity.sdc_counters()
+    refused = smetrics.serving_counters()["transfer_crc_refusals"] - before
+    miss = [r.request_id for r in reqs if r.request_id not in results]
+    wrong = [r.request_id for r in reqs if r.request_id in results
+             and list(results[r.request_id].tokens) != gold[r.request_id]]
+    integrity.reset_sdc_counters()
+    return {"ok": (not miss and not wrong and s["crc_refusals"] == 1
+                   and s["crc_checks"] >= 1 and refused == 1),
+            "dropped": len(miss), "wrong": len(wrong),
+            "crc_checks": s["crc_checks"], "crc_refusals": s["crc_refusals"]}
+
+
+def leg_sdc_ckpt_scrub(seed):
+    """Bit rot in a RETAINED snapshot: the cadence scrub re-verifies the
+    CRC manifests newest-first, quarantines the rotten step to
+    ``*.corrupt``, and the fallback chain stays clean."""
+    from paddle_tpu.distributed import integrity
+    from paddle_tpu.incubate.checkpoint import CheckpointManager
+
+    integrity.reset_sdc_counters()
+    d = tempfile.mkdtemp(prefix="sdc_scrub_")
+    try:
+        mgr = CheckpointManager(d, keep_last_n=4, async_save=False)
+        state = {"w": np.arange(8, dtype=np.float32),
+                 "b": np.full((3,), float(seed), np.float32)}
+        for s in (1, 2, 3):
+            mgr.save(s, state)
+        with open(os.path.join(d, "step_2", "state.pdckpt"), "r+b") as f:
+            f.seek(-8, 2)
+            f.write(b"\x00" * 8)            # rot the middle snapshot
+        out = mgr.scrub()
+        counters = integrity.sdc_counters()
+        ok = (out["rot"] == [2] and out["scrubbed"] == 3
+              and counters["rot_found"] == 1
+              and not os.path.isdir(os.path.join(d, "step_2"))
+              and os.path.isdir(os.path.join(d, "step_2.corrupt"))
+              and mgr.latest_step() == 3
+              and mgr.restore() is not None)
+        integrity.reset_sdc_counters()
+        return {"ok": ok, **out}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def run_sdc_ladder(deterministic=False, seed=7):
+    """The silent-data-corruption ladder. ``deterministic=True`` is the
+    fast tier-1 sub-rung: the train detect-repair leg (tiny, no host-sync
+    audit run) + the at-rest scrub leg only. The full ladder adds the
+    quarantine policy, the serving shadow audit and the wire-CRC refusal.
+    Returns a machine-readable dict; ``ok`` must be True."""
+    import paddle_tpu as paddle
+
+    paddle.set_flags(dict(_SDC_FLAG_DEFAULTS))
+    if deterministic:
+        tr = leg_sdc_train_repair(steps=3, seed=seed, deterministic=True)
+        sc = leg_sdc_ckpt_scrub(seed=seed + 10)
+        return {"ok": tr["ok"] and sc["ok"], "train_repair": tr,
+                "ckpt_scrub": sc}
+    tr = leg_sdc_train_repair(steps=6, seed=seed)
+    print(f"FAULT_SMOKE sdc-train-bitflip-repair: "
+          f"{'OK' if tr['ok'] else 'FAIL'}  "
+          f"mismatches={tr['sdc']['fingerprint_mismatches']} "
+          f"repairs={tr['sdc']['repairs']} "
+          f"redispatches={tr['sdc']['repair_redispatches']} "
+          f"bitwise-equal host-syncs-flat={tr['host_syncs_flat']}")
+    qa = leg_sdc_train_quarantine(steps=6, seed=seed + 20)
+    print(f"FAULT_SMOKE sdc-train-quarantine: "
+          f"{'OK' if qa['ok'] else 'FAIL'}  "
+          f"charged={qa['charged']} detected-as-lost={qa['detected']} "
+          f"policy-gated={qa['policy_gated']}")
+    au = leg_sdc_serve_audit(seed=seed + 40)
+    print(f"FAULT_SMOKE sdc-serve-audit-catch: "
+          f"{'OK' if au['ok'] else 'FAIL'}  "
+          f"audits={au['audits']} failures={au['audit_failures']} "
+          f"dropped={au['dropped']} wrong={au['wrong']} bitwise-equal")
+    wc = leg_sdc_kv_wire_crc(seed=seed + 60)
+    print(f"FAULT_SMOKE sdc-kv-wire-crc: "
+          f"{'OK' if wc['ok'] else 'FAIL'}  "
+          f"checked={wc['crc_checks']} refused={wc['crc_refusals']} "
+          f"dropped={wc['dropped']} wrong={wc['wrong']} bitwise-equal")
+    sc = leg_sdc_ckpt_scrub(seed=seed + 80)
+    print(f"FAULT_SMOKE sdc-ckpt-scrub: "
+          f"{'OK' if sc['ok'] else 'FAIL'}  "
+          f"scrubbed={sc['scrubbed']} rot={sc['rot']}")
+    out = {"ok": all(x["ok"] for x in (tr, qa, au, wc, sc)),
+           "train_repair": tr, "quarantine": qa, "serve_audit": au,
+           "kv_wire_crc": wc, "ckpt_scrub": sc}
+    print(f"FAULT_SMOKE sdc-ladder: {'OK' if out['ok'] else 'FAIL'}")
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=12)
@@ -872,6 +1186,8 @@ def main():
                     help="skip the serving chaos ladder")
     ap.add_argument("--skip-elastic", action="store_true",
                     help="skip the topology-elastic ladder")
+    ap.add_argument("--skip-sdc", action="store_true",
+                    help="skip the silent-data-corruption ladder")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -897,6 +1213,9 @@ def main():
         out = run_serving_ladder(quick=False, seed=args.seed)
         assert out["requests_dropped"] == 0, out
         out = run_serving_elastic_ladder(seed=args.seed)
+        assert out["ok"], out
+    if not args.skip_sdc:
+        out = run_sdc_ladder(seed=args.seed)
         assert out["ok"], out
     print("FAULT_SMOKE all: OK")
 
