@@ -167,6 +167,29 @@ _FLAGS = {
     # Number of transformer blocks the "shallow" draft keeps. 0 = auto
     # (num_layers // 2, at least 1). Ignored by source="quant".
     "FLAGS_serving_draft_layers": 0,
+    # -- many-model serving: per-slot LoRA-class adapters (serving/
+    # adapters.py). N low-rank deltas of ONE base checkpoint live stacked
+    # in fixed-shape device slabs; each slot's adapter id is a TRACED
+    # operand of the fused paged step, so a mixed-adapter batch (base
+    # model included) shares the engine's two steady-state executables
+    # and adapter hot-load/evict/swap are content-only slab rewrites —
+    # zero retraces, the swap_params machinery. Attention is never
+    # adapted; adapted requests' prefix-cache keys are salted with
+    # (adapter id, version) while base traffic shares unsalted keys, so
+    # adapter ops skip the prefix-cache flush base-weight swaps require.
+    # Loadable adapter slots (ids 1..N; id 0 = base model). 0 = OFF: the
+    # engine is byte-identical to the adapter-less one.
+    "FLAGS_serving_adapter_slots": 0,
+    # Max (padded) adapter rank r: every loaded delta's true rank must be
+    # <= this; smaller ranks zero-pad (bitwise-exact — padding columns
+    # contribute exact zeros). Static: changing it is a restart, like
+    # page_size.
+    "FLAGS_serving_adapter_rank": 8,
+    # Tenant -> default adapter id mapping, dict ({"acme": 1}) or string
+    # ("acme:1,beta:2"): requests that don't name adapter= explicitly are
+    # served with their tenant's delta; unmapped tenants get the base
+    # model.
+    "FLAGS_serving_tenant_adapters": {},
     # -- self-healing serving (serving/engine.py + serving/supervisor.py) ---
     # Engine-snapshot cadence: with a CheckpointManager attached
     # (Engine.attach_checkpoint), every N step boundaries the FULL engine

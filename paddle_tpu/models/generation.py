@@ -18,11 +18,28 @@ import jax.numpy as jnp
 from .gpt import ln_fp32
 
 
-def _layer_cached(p, h, kc, vc, start, nh, eps):
+def _delta_proj(x, w, aid, ad_l, name):
+    """Base projection ``x @ w`` plus the per-row LoRA delta when this
+    layer's adapter slab covers ``name`` — the SAME ops (take + batched
+    einsum pair + masked compose, ops/pallas_kernels/quant_gemm.py) the
+    serving engine runs, so a solo reference decode with ``adapters=`` is
+    bitwise comparable to the engine's mixed-adapter batch rows."""
+    base = x @ w.astype(x.dtype)
+    if ad_l is None or name not in ad_l:
+        return base
+    from ..ops.pallas_kernels.quant_gemm import lora_delta, compose_delta
+    A_l, B_l = ad_l[name]
+    return compose_delta(base, lora_delta(x, A_l, B_l, aid), aid)
+
+
+def _layer_cached(p, h, kc, vc, start, nh, eps, aid=None, ad_l=None):
     """One transformer block over h [B,T,H] with KV cache [B,Smax,nh,d].
     Positions [start, start+T) are written; attention keys are the cache
     prefix up to start+T (mask below). Mirrors gpt_block_fn math
-    (models/gpt.py) plus cache read/write."""
+    (models/gpt.py) plus cache read/write. ``aid``/``ad_l`` (serving
+    adapters reference path): per-row adapter ids + this layer's slab
+    rows, joined into the out/up/down projections — qkv stays un-adapted
+    by construction (serving/adapters.py)."""
     B, T, H = h.shape
     d = H // nh
 
@@ -46,14 +63,15 @@ def _layer_cached(p, h, kc, vc, start, nh, eps):
     probs = jax.nn.softmax(scores, axis=-1)
     ctx = jnp.einsum("bhts,bshd->bthd", probs,
                      vc.astype(jnp.float32)).astype(h.dtype)
-    attn = ctx.reshape(B, T, H) @ p["out_w"].astype(h.dtype) + \
-        p["out_b"].astype(h.dtype)
+    attn = _delta_proj(ctx.reshape(B, T, H), p["out_w"], aid, ad_l,
+                       "out_w") + p["out_b"].astype(h.dtype)
     h = h + attn
     h2 = ln(h, p["ln2_g"], p["ln2_b"])
-    up = h2 @ p["up_w"].astype(h.dtype) + p["up_b"].astype(h.dtype)
+    up = _delta_proj(h2, p["up_w"], aid, ad_l, "up_w") + \
+        p["up_b"].astype(h.dtype)
     up = jax.nn.gelu(up, approximate=True)
-    return h + up @ p["down_w"].astype(h.dtype) + p["down_b"].astype(h.dtype), \
-        kc, vc
+    return h + _delta_proj(up, p["down_w"], aid, ad_l, "down_w") + \
+        p["down_b"].astype(h.dtype), kc, vc
 
 
 def _final_ln(params, config, xlast):
@@ -74,26 +92,37 @@ def _final_logits(params, config, xlast):
         params["head_w"].astype(jnp.float32)
 
 
-def _forward_cached(params, config, ids, kc, vc, start, last_index=None):
+def _forward_cached(params, config, ids, kc, vc, start, last_index=None,
+                    adapters=None):
     """ids [B,T] at absolute positions [start, start+T); returns logits of
     the LAST position [B,V] and the updated cache. ``last_index`` (traced
     scalar) selects which position's logits to return instead of T-1 — the
     serving engine prefills prompts right-padded to a bucket length and
-    reads logits at the true last prompt token."""
+    reads logits at the true last prompt token. ``adapters`` = (aid [B],
+    slabs) — the solo-reference adapter path (slabs ride the layer scan,
+    exactly like the paged engine's fused step)."""
     compute = jnp.dtype(config.compute_dtype or "float32")
     B, T = ids.shape
     pos = start + jnp.arange(T)
     x = params["wte"].astype(compute)[ids] + \
         jnp.take(params["wpe"].astype(compute), pos, axis=0)[None]
     nh = config.num_heads
+    aid, slabs = adapters if adapters is not None else (None, None)
 
     def layer_fn(h, xs):
+        if adapters is not None:
+            xs, ad_l = xs[:-1], xs[-1]
+        else:
+            ad_l = None
         p_l, kc_l, vc_l = xs
         h, kc_l, vc_l = _layer_cached(p_l, h, kc_l, vc_l, start, nh,
-                                      config.layer_norm_epsilon)
+                                      config.layer_norm_epsilon, aid, ad_l)
         return h, (kc_l, vc_l)
 
-    x, (kc, vc) = jax.lax.scan(layer_fn, x, (params["blocks"], kc, vc))
+    xs = (params["blocks"], kc, vc)
+    if adapters is not None:
+        xs = xs + (slabs,)
+    x, (kc, vc) = jax.lax.scan(layer_fn, x, xs)
     if last_index is None:
         xlast = x[:, -1]
     else:
@@ -275,8 +304,8 @@ _gen_traces = 0
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "do_sample",
                                    "top_k", "stop_token_ids"))
-def _generate_jit(params, ids, key, *, cfg, max_new_tokens, do_sample,
-                  temperature, top_k, top_p, stop_token_ids):
+def _generate_jit(params, ids, key, adapters=None, *, cfg, max_new_tokens,
+                  do_sample, temperature, top_k, top_p, stop_token_ids):
     global _gen_traces
     _gen_traces += 1
     config = _cfg_view(cfg)
@@ -284,7 +313,8 @@ def _generate_jit(params, ids, key, *, cfg, max_new_tokens, do_sample,
     total = P + max_new_tokens
     kc, vc = _alloc_cache(config, B, total)
 
-    logits, kc, vc = _forward_cached(params, config, ids, kc, vc, 0)
+    logits, kc, vc = _forward_cached(params, config, ids, kc, vc, 0,
+                                     adapters=adapters)
     key, sub = jax.random.split(key)
     tok = _select_token(logits, sub, do_sample, temperature, top_k, top_p)
     finished = jnp.zeros((B,), bool) if stop_token_ids is None else \
@@ -295,7 +325,7 @@ def _generate_jit(params, ids, key, *, cfg, max_new_tokens, do_sample,
         key, sub = jax.random.split(key)
         # tok was produced for absolute position P+i; feed it there
         logits, kc, vc = _forward_cached(params, config, tok[:, None],
-                                         kc, vc, P + i)
+                                         kc, vc, P + i, adapters=adapters)
         nxt = _select_token(logits, sub, do_sample, temperature, top_k, top_p)
         if stop_token_ids is not None:
             nxt = jnp.where(finished, stop_token_ids[0], nxt)
@@ -453,10 +483,17 @@ def _check_temperature(do_sample, temperature):
 def generate_from_params(params, input_ids, config, max_new_tokens=32,
                          do_sample=False, temperature=1.0, top_k=None,
                          top_p=None, eos_token_id=None, seed=0,
-                         stop_token_ids=None):
+                         stop_token_ids=None, adapters=None):
     """Generate from a FUNCTIONAL param tree (models/gpt_hybrid.py
     init_gpt_params layout) — the public decode entry for params produced
-    by HybridTrainStep / the serving Engine, no Layer required."""
+    by HybridTrainStep / the serving Engine, no Layer required.
+
+    ``adapters=(adapter_id, slabs)`` is the solo-reference path for the
+    adapter serving parity gates: slabs is an AdapterRegistry's
+    ``device_slabs()`` dict and every row of this generation runs under
+    ``adapter_id`` (0 = base) through the SAME take/einsum/compose ops
+    the engine's mixed-adapter fused step uses — so engine rows are
+    bitwise comparable against this for any batch composition."""
     from ..tensor_impl import Tensor
     ids = jnp.asarray(input_ids._data if isinstance(input_ids, Tensor)
                       else input_ids, jnp.int32)
@@ -468,7 +505,12 @@ def generate_from_params(params, input_ids, config, max_new_tokens=32,
     assert ids.shape[1] + max_new_tokens <= config.max_seq_len, \
         "prompt + max_new_tokens exceeds config.max_seq_len (wpe table)"
     params = _logical_qkv(params, config)
-    out = _generate_jit(params, ids, jax.random.key(seed), cfg=_cfg_key(config),
+    if adapters is not None:
+        aid, slabs = adapters
+        adapters = (jnp.full((ids.shape[0],), int(aid), jnp.int32),
+                    {n: tuple(s) for n, s in slabs.items()})
+    out = _generate_jit(params, ids, jax.random.key(seed), adapters,
+                        cfg=_cfg_key(config),
                         max_new_tokens=int(max_new_tokens),
                         do_sample=bool(do_sample),
                         temperature=float(temperature),

@@ -140,3 +140,46 @@ def quant_gemm(x, wq, scale, use_kernel=False, interpret=False):
                                 interpret=interpret)
         return out.reshape(lead + (F,))
     return (x @ wq.astype(x.dtype)) * scale.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# LoRA-class adapter deltas: the other GEMM epilogue (serving/adapters.py)
+#
+# A quantized base projection and a full-precision low-rank delta COMPOSE:
+# the base GEMM dequantizes in its epilogue (above) and the delta joins
+# right after, before bias — so the adapted projection is
+#
+#     y = dequant(x @ wq) * s  (+)  (x @ A[aid]) @ B[aid]
+#
+# with (+) the masked compose below. The delta path is deliberately jnp:
+# rank-r contractions are tiny (r ~ 8-64) and XLA fuses the pair of
+# batched einsums into the surrounding epilogue on TPU.
+
+
+def lora_delta(h, A_l, B_l, aid):
+    """Per-slot low-rank delta for one layer: h [B, T, K] against the
+    layer's adapter slabs A_l [cap, K, r] / B_l [cap, r, F], routed by the
+    TRACED per-slot row ids aid [B] -> delta [B, T, F].
+
+    Each batch row contracts only against ITS OWN adapter rows (a take
+    then two batched einsums), so every row's result is bitwise
+    independent of the rest of the batch — the property that lets a
+    mixed-adapter engine batch stay bitwise-equal to per-adapter solo
+    runs, exactly like the base matmuls. The LoRA ``alpha/r`` scale was
+    folded into B at load time (AdapterRegistry.load) and rank padding
+    is zero columns/rows, so this is scale-free and padding-exact."""
+    Aa = jnp.take(A_l, aid, axis=0).astype(h.dtype)          # [B, K, r]
+    Ba = jnp.take(B_l, aid, axis=0).astype(h.dtype)          # [B, r, F]
+    xa = jnp.einsum("btk,bkr->btr", h, Aa)
+    return jnp.einsum("btr,brf->btf", xa, Ba)
+
+
+def compose_delta(base, delta, aid):
+    """Join a delta onto the base projection output, per slot: rows with
+    aid == 0 (base model) keep ``base`` BITWISE — a where-select, not
+    ``base + 0.0``, because IEEE ``-0.0 + 0.0`` is ``+0.0`` and the
+    mixed-batch parity contract requires base-model rows to be
+    byte-identical to an adapters-off engine. Element-wise, so under mp
+    it commutes with the output-channel all-gather: composing the local
+    column block before the gather equals composing after it."""
+    return jnp.where((aid > 0)[:, None, None], base + delta, base)

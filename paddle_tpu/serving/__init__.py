@@ -77,6 +77,29 @@ roles when a chip loss strands decode capacity (pure-decode fallback,
 zero drops; transfers retain payloads until seated so a decode-worker
 death mid-stream re-offers, not recomputes).
 
+Many-model serving (adapters.py; default-off behind
+``FLAGS_serving_adapter_slots``): one paged engine serves N low-rank
+(LoRA-class) adapter variants of the base model at once. Adapter deltas
+live as stacked device slabs (one row per adapter id; id 0 is the
+pinned all-zeros base row), each slot's ``adapter_id`` is a TRACED
+operand, and the per-slot delta GEMM fuses into the base projection
+epilogue — so a mixed-adapter batch reuses the SAME two steady-state
+executables (``paged_traces==2`` holds with adapters on), and hot
+``load_adapter`` / ``evict_adapter`` / ``swap_adapter`` are pure
+content rewrites with ZERO retraces. Attention projections are
+deliberately un-adapted (no delta GEMM in the attention inner loop);
+adapted requests' prefix-cache keys carry their (adapter id, content
+version) while base traffic keeps shared unsalted keys — so adapter ops
+never flush the prefix cache (a swap strands the old version's entries
+to age out of the LRU) and base-weight swaps keep the full flush.
+Per-slot outputs stay bitwise identical to solo
+``generate_from_params(adapters=...)`` runs regardless of batch
+composition or admission order, greedy and sampled, single-chip and mp.
+Requests pick a model via ``Request(adapter=...)`` or the
+``FLAGS_serving_tenant_adapters`` tenant mapping; WFQ fairness rotates
+across adapters; snapshots and supervisor respawn/reform carry the
+resident adapter set.
+
 SLO traffic management (slo.py; all default-off, host-side policy over
 the machinery above): priority classes with WFQ tenant fairness and
 deadline-driven preemption (``FLAGS_serving_priority_classes``),
@@ -109,4 +132,7 @@ from .metrics import (  # noqa: F401
 from . import quant  # noqa: F401
 from .quant import (  # noqa: F401
     QuantSpec, QuantSpecError, QuantDtypeMismatchError,
+)
+from .adapters import (  # noqa: F401
+    AdapterRegistry, AdapterSpec, UnknownAdapterError,
 )

@@ -1,0 +1,316 @@
+"""Per-slot LoRA-class adapters: many fine-tuned models on ONE paged engine.
+
+A fleet serves thousands of fine-tuned variants of one base checkpoint, not
+thousands of checkpoints. Holding a full weight copy per variant multiplies
+HBM by the variant count; low-rank deltas (LoRA: W' = W + A @ B * alpha/r)
+make each variant a few MB, so N variants fit where two full copies would
+not. This module is the residency layer for those deltas:
+
+  * Deltas live STACKED: for each adapted projection, one device slab
+    ``A: [L, A_max, K, r]`` / ``B: [L, A_max, r, F]`` holding every resident
+    adapter as a ROW (leading L so the slabs ride the per-layer ``lax.scan``
+    exactly like the base block weights). Row 0 is the base model and is
+    pinned all-zeros — adapter_id 0 means "no delta".
+  * ``adapter_id`` is a TRACED per-slot operand, and capacity
+    (``slots``/``rank``/targets) is the only static axis. A mixed-adapter
+    batch — including base-model rows — therefore shares the engine's two
+    steady-state executables (``paged_traces == 2`` holds with adapters on),
+    and load/evict/swap are pure DATA updates on fixed-shape slabs: zero
+    retraces, same mechanism as ``Engine.swap_params``.
+  * Ranks are padded to the spec rank with zero columns/rows and the LoRA
+    ``alpha/r`` scale is folded into B at load time (host-side, once), so
+    the traced math is a scale-free pair of batched einsums whose rows are
+    bitwise independent of batch composition — the property the engine's
+    mixed-batch-vs-solo parity gates rely on.
+  * Attention projections (``qkv_w``) are NOT adaptable, by construction:
+    adapted Q/K/V would put the delta GEMM inside the attention inner loop
+    and make even LAYER-0 keys adapter-dependent. Note the residual stream
+    still carries the out/up/down deltas into every LATER layer's K/V, so
+    an adapted request's prompt pages depend on its delta bits regardless —
+    the engine therefore salts adapted requests' prefix-cache keys with
+    (adapter id, content version) while base traffic (id 0) keeps unsalted
+    keys shared across every tenant. That per-content keying — not KV
+    independence — is what lets ``Engine.load_adapter`` / ``evict_adapter``
+    / ``swap_adapter`` skip the prefix-cache flush that base-weight swaps
+    require (see ``Engine.swap_params``): ops on one adapter cannot
+    invalidate base pages or another adapter's pages, and a swap merely
+    strands the old version's entries to age out of the LRU.
+
+Under tensor parallelism the B slabs shard with their OUTPUT channels
+(``P(None, None, None, "mp")`` — same placement rule as the PR 14
+quantization scales) while A slabs replicate, so the delta is computed
+locally against the local column block and joins the base product BEFORE
+the all-gather; the gather stays pure data movement and the single-chip
+bitwise contract is preserved.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# Projections that may carry a delta. qkv_w is deliberately absent — see
+# the module docstring (no delta GEMM inside the attention inner loop) —
+# and load() rejects it by name.
+TARGETS = ("out_w", "up_w", "down_w")
+
+
+class UnknownAdapterError(ValueError):
+    """Request or registry op named an adapter id outside the configured
+    capacity (or adapters are disabled on this engine). Carries
+    ``adapter_id`` so a router can surface WHICH id was bad."""
+
+    def __init__(self, adapter_id, message=None):
+        super().__init__(
+            message or f"unknown adapter id {adapter_id!r}")
+        self.adapter_id = adapter_id
+
+
+@dataclass(frozen=True)
+class AdapterSpec:
+    """STATIC adapter capacity: the only adapter axis that can retrace.
+
+    ``slots`` loadable adapters (ids 1..slots; id 0 is the pinned base
+    row), every rank padded up to ``rank``. Changing capacity changes slab
+    shapes — that is a restart-class reconfiguration, exactly like
+    ``num_slots`` or ``page_size``, and it keys ``_make_paged_step`` and
+    the snapshot meta. Everything else (which adapters are resident, their
+    weights, their true ranks) is data."""
+
+    slots: int
+    rank: int
+    targets: tuple = TARGETS
+
+    def __post_init__(self):
+        if int(self.slots) < 1:
+            raise ValueError(f"adapter slots must be >= 1, got {self.slots}")
+        if int(self.rank) < 1:
+            raise ValueError(f"adapter rank must be >= 1, got {self.rank}")
+        bad = [t for t in self.targets if t not in TARGETS]
+        if bad:
+            raise ValueError(
+                f"unsupported adapter targets {bad}; attention projections "
+                f"cannot be adapted (no delta GEMM in the attention inner "
+                f"loop) — supported: {TARGETS}")
+        object.__setattr__(self, "slots", int(self.slots))
+        object.__setattr__(self, "rank", int(self.rank))
+        object.__setattr__(self, "targets", tuple(self.targets))
+
+    def key(self):
+        """Hashable identity for jit cache keys and snapshot meta."""
+        return (self.slots, self.rank, self.targets)
+
+    @staticmethod
+    def resolve(slots, rank, targets=TARGETS):
+        """None when adapters are off (slots in {0, None}) — mirrors
+        ``QuantSpec.resolve`` so call sites read ``if spec is None``."""
+        if not slots:
+            return None
+        return AdapterSpec(slots=int(slots), rank=int(rank),
+                           targets=tuple(targets))
+
+
+class AdapterRegistry:
+    """Residency manager for the stacked delta slabs of ONE engine.
+
+    The HOST numpy mirrors are the source of truth; every mutation
+    rewrites the mirror rows and re-places the device slabs (same shapes,
+    same dtypes — content-only, so downstream jits never retrace). The
+    mirrors also make snapshots trivial: ``state_dict`` is a copy of the
+    mirrors plus the residency table."""
+
+    def __init__(self, config, spec, mesh=None):
+        if spec is None:
+            raise ValueError("AdapterRegistry needs a resolved AdapterSpec")
+        self.spec = spec
+        H = int(config.hidden_size)
+        I = int(config.ffn_mult * config.hidden_size)
+        L = int(config.num_layers)
+        dims = {"out_w": (H, H), "up_w": (H, I), "down_w": (I, H)}
+        self._dims = {t: dims[t] for t in spec.targets}
+        self._mesh = mesh
+        cap = spec.slots + 1                       # + pinned base row 0
+        self._host = {}
+        for name, (K, F) in self._dims.items():
+            self._host[name] = (
+                np.zeros((L, cap, K, spec.rank), np.float32),
+                np.zeros((L, cap, spec.rank, F), np.float32))
+        # aid -> {"rank": true rank, "alpha": float|None, "version": int}
+        self._resident = {}
+        self._vc = 0
+        self._push()
+
+    # -- device placement ----------------------------------------------------
+    def _push(self):
+        """(Re)place device slabs from the host mirrors. A replicates; B
+        shards with its output channels under mp (the quant-scale rule),
+        so the per-chip delta lands on the same column block as the local
+        base product."""
+        slabs = {}
+        for name, (a, b) in self._host.items():
+            A, B = jnp.asarray(a), jnp.asarray(b)
+            if self._mesh is not None:
+                A = jax.device_put(A, NamedSharding(self._mesh, P()))
+                B = jax.device_put(
+                    B, NamedSharding(self._mesh, P(None, None, None, "mp")))
+            slabs[name] = (A, B)
+        self._slabs = slabs
+
+    def device_slabs(self):
+        """{target: (A [L,cap,K,r], B [L,cap,r,F])} — the traced operands
+        a forward pass consumes (leading L rides the layer scan)."""
+        return self._slabs
+
+    # -- residency -----------------------------------------------------------
+    def _check_id(self, adapter_id):
+        aid = int(adapter_id)
+        if not 1 <= aid <= self.spec.slots:
+            raise UnknownAdapterError(
+                adapter_id,
+                f"adapter id {adapter_id!r} outside capacity 1.."
+                f"{self.spec.slots} (id 0 is the base model and is not "
+                f"loadable)")
+        return aid
+
+    def load(self, adapter_id, tree, alpha=None, *, replace=False):
+        """Make ``adapter_id`` resident from ``tree``: a dict mapping an
+        adapted projection name to ``(A [L, K, r_true], B [L, r_true, F])``.
+        Targets absent from ``tree`` keep zero deltas. ``alpha`` folds the
+        LoRA ``alpha/r_true`` scale into B here, once, on the host — the
+        device math is scale-free. Loading over a resident id requires
+        ``replace=True`` (the ``swap_adapter`` path) so an accidental id
+        collision is an error, not a silent overwrite."""
+        aid = self._check_id(adapter_id)
+        if aid in self._resident and not replace:
+            raise ValueError(
+                f"adapter {aid} is already resident; use swap_adapter to "
+                f"replace it or evict_adapter first")
+        if "qkv_w" in tree:
+            raise ValueError(
+                "adapter adapts qkv_w: attention projections cannot be "
+                "adapted — that would put the delta GEMM inside the "
+                "attention inner loop (see serving/adapters.py)")
+        bad = [n for n in tree if n not in self._dims]
+        if bad:
+            raise ValueError(
+                f"adapter {aid} adapts unknown/unsupported projections "
+                f"{bad}; supported targets: {tuple(self._dims)}")
+        L = next(iter(self._host.values()))[0].shape[0]
+        true_rank = 0
+        staged = {}
+        for name, (A, B) in tree.items():
+            K, F = self._dims[name]
+            A = np.asarray(A, np.float32)
+            B = np.asarray(B, np.float32)
+            rt = A.shape[-1]
+            if A.shape != (L, K, rt) or B.shape != (L, rt, F):
+                raise ValueError(
+                    f"adapter {aid} target {name}: expected A [L={L}, "
+                    f"K={K}, r] and B [L, r, F={F}], got A {A.shape} / "
+                    f"B {B.shape}")
+            if rt > self.spec.rank:
+                raise ValueError(
+                    f"adapter {aid} target {name} rank {rt} exceeds "
+                    f"configured max rank {self.spec.rank}")
+            if alpha is not None:
+                B = B * (float(alpha) / float(rt))
+            staged[name] = (A, B, rt)
+            true_rank = max(true_rank, rt)
+        for name, (hA, hB) in self._host.items():
+            hA[:, aid] = 0.0
+            hB[:, aid] = 0.0
+            if name in staged:
+                A, B, rt = staged[name]
+                hA[:, aid, :, :rt] = A
+                hB[:, aid, :rt, :] = B
+        self._vc += 1
+        self._resident[aid] = {"rank": true_rank,
+                               "alpha": None if alpha is None
+                               else float(alpha),
+                               "version": self._vc}
+        self._push()
+        return self._vc
+
+    def evict(self, adapter_id):
+        """Zero ``adapter_id``'s rows and free its residency slot. The id
+        becomes loadable again; queued requests bound to it wait at
+        admission until it is reloaded."""
+        aid = self._check_id(adapter_id)
+        if aid not in self._resident:
+            raise UnknownAdapterError(
+                adapter_id, f"adapter {aid} is not resident; nothing to "
+                            f"evict")
+        for hA, hB in self._host.values():
+            hA[:, aid] = 0.0
+            hB[:, aid] = 0.0
+        del self._resident[aid]
+        self._push()
+
+    def resident(self, adapter_id):
+        aid = int(adapter_id)
+        return aid == 0 or aid in self._resident
+
+    def resident_ids(self):
+        return tuple(sorted(self._resident))
+
+    def version(self, adapter_id):
+        """Monotonic per-adapter content version (0 for the base row) —
+        the adapter analogue of ``Engine.params_version``, stamped onto
+        requests at admission so a result names exactly which delta bits
+        produced it."""
+        aid = int(adapter_id)
+        if aid == 0:
+            return 0
+        if aid not in self._resident:
+            raise UnknownAdapterError(
+                adapter_id, f"adapter {aid} is not resident")
+        return self._resident[aid]["version"]
+
+    # -- accounting ----------------------------------------------------------
+    def row_bytes(self):
+        """HBM bytes one resident adapter occupies (rank-padded rows
+        across every adapted projection and layer)."""
+        total = 0
+        for hA, hB in self._host.values():
+            L = hA.shape[0]
+            total += L * (hA.shape[2] * hA.shape[3]
+                          + hB.shape[2] * hB.shape[3]) * hA.itemsize
+        return total
+
+    def delta_bytes(self):
+        """Bytes attributable to RESIDENT adapters."""
+        return len(self._resident) * self.row_bytes()
+
+    def slab_bytes(self):
+        """Total slab capacity bytes ((slots+1) rows, paid up front)."""
+        return (self.spec.slots + 1) * self.row_bytes()
+
+    # -- snapshot ------------------------------------------------------------
+    def state_dict(self):
+        return {
+            "spec": self.spec.key(),
+            "resident": {int(a): dict(m) for a, m in
+                         self._resident.items()},
+            "vc": self._vc,
+            "host": {n: (a.copy(), b.copy())
+                     for n, (a, b) in self._host.items()},
+        }
+
+    def load_state_dict(self, state):
+        if tuple(state["spec"][2]) != self.spec.targets or \
+                (int(state["spec"][0]), int(state["spec"][1])) != \
+                (self.spec.slots, self.spec.rank):
+            raise ValueError(
+                f"adapter capacity mismatch: snapshot "
+                f"{tuple(state['spec'])} vs engine {self.spec.key()}")
+        for n, (a, b) in state["host"].items():
+            hA, hB = self._host[n]
+            hA[...] = np.asarray(a, np.float32)
+            hB[...] = np.asarray(b, np.float32)
+        self._resident = {int(a): dict(m)
+                          for a, m in state["resident"].items()}
+        self._vc = int(state["vc"])
+        self._push()

@@ -68,6 +68,7 @@ from ..models.generation import (
 )
 from . import metrics
 from . import quant as _squant
+from .adapters import AdapterRegistry, AdapterSpec, UnknownAdapterError
 from .kv_transfer import KVTransfer, PagePayload
 from .paged_attention import (
     paged_draft_forward, paged_forward, paged_kernel_supported,
@@ -79,7 +80,7 @@ from .request import (
     STOP, GenerationResult, Request,
 )
 from .scheduler import QueueFullError, Scheduler, ShedError
-from .slo import ShedPolicy
+from .slo import ShedPolicy, resolve_tenant_adapters
 
 
 class EngineStoppedError(RuntimeError):
@@ -163,7 +164,7 @@ def _make_decode(cfg, top_k, donate):
 @lru_cache(maxsize=None)
 def _make_paged_step(cfg, top_k, page_size, use_kernel, donate,
                      mp_key=None, anomaly=False, quant=None,
-                     qkernel=False):
+                     qkernel=False, adapters=None):
     """Build the FUSED chunk/decode executable over the paged pool: every
     batch row is a slot processing a T-token window (ids' second dim) at
     its own offset. The engine dispatches it at exactly two steady-state
@@ -195,26 +196,41 @@ def _make_paged_step(cfg, top_k, page_size, use_kernel, donate,
     params tree (same signature), a quantized KV pool appends the
     per-page ``ksc``/``vsc`` [L, P] traced scale operands AFTER
     ``key_data`` (donate indices untouched). quant=None is byte-identical
-    to the PR 13 builder."""
+    to the PR 13 builder.
+
+    ``adapters`` = ``AdapterSpec.key()`` (serving/adapters.py) keys the
+    per-slot LoRA-delta variants: the per-slot adapter row id [B] and the
+    stacked delta slabs {target: (A, B)} arrive as traced operands AFTER
+    the kv scales. The id is DATA — a mixed-adapter batch (base rows
+    included) shares this one executable at its two steady-state shapes,
+    and adapter load/evict/swap (content-only slab rewrites) never
+    retrace. adapters=None is byte-identical to the adapter-less
+    builder."""
     config = _cfg_view(cfg)
     kvq = quant is not None and quant[1] != "bf16"
 
     def fn(params, kc, vc, ids, start, valid, emit, table, do_sample,
-           temperature, top_p, key_data, *kv_scales):
+           temperature, top_p, key_data, *extra):
         metrics.bump("paged_traces")  # body runs only when traced
-        scales = tuple(kv_scales) if kvq else None
+        rest = list(extra)
+        scales = None
+        if kvq:
+            scales = (rest[0], rest[1])
+            rest = rest[2:]
+        ad = (rest[0], rest[1]) if adapters is not None else None
         if mp_key is None:
             logits, kc, vc = paged_forward(params, config, ids, kc, vc,
                                            start, valid, table, page_size,
                                            use_kernel, kv_scales=scales,
-                                           wq_kernel=qkernel)
+                                           wq_kernel=qkernel, adapters=ad)
         else:
             from .mp_forward import mp_paged_forward
             logits, kc, vc = mp_paged_forward(params, config, ids, kc, vc,
                                               start, valid, table,
                                               page_size, use_kernel,
                                               mp_key[0], mp_key[1],
-                                              kv_scales=scales)
+                                              kv_scales=scales,
+                                              adapters=ad)
         keys = jax.random.wrap_key_data(key_data)           # [B] keys
         pair = jax.vmap(jax.random.split)(keys)             # [B, 2] keys
         subs = pair[:, 1]
@@ -372,7 +388,9 @@ class Engine:
                  tag=None, trace=None, priority=None, tenant_weights=None,
                  shed=None, params_version=0, mesh=None, mp=None,
                  comm_backend=None, anomaly=None, quant=None, role=None,
-                 speculate_k=None, draft_source=None, draft_layers=None):
+                 speculate_k=None, draft_source=None, draft_layers=None,
+                 adapter_slots=None, adapter_rank=None,
+                 tenant_adapters=None):
         if model is not None:
             params = _collect_params(model)
             config = model.config
@@ -494,11 +512,35 @@ class Engine:
         }
         self._preempt_margin_s = float(
             flags.get("FLAGS_serving_preempt_margin_s", 0.0))
+        # -- per-slot LoRA-class adapters (serving/adapters.py): resolve
+        # the CAPACITY spec before the scheduler — WFQ lanes rotate across
+        # ADAPTERS when adapters are on (the many-model fairness axis),
+        # across tenants otherwise. Off (the default
+        # FLAGS_serving_adapter_slots=0) resolves to None and every
+        # adapter code path below is skipped: executables, dispatch
+        # signatures and trace counters are byte-identical to the
+        # adapter-less engine (the flags-off parity contract).
+        self._adapter_spec = AdapterSpec.resolve(
+            flags.get("FLAGS_serving_adapter_slots", 0)
+            if adapter_slots is None else adapter_slots,
+            flags.get("FLAGS_serving_adapter_rank", 8)
+            if adapter_rank is None else adapter_rank)
+        self.adapters = None            # AdapterRegistry once constructed
+        self._tenant_adapters = {}
+        if self._adapter_spec is not None and self.kv_layout != "paged":
+            raise ValueError(
+                "adapter serving rides the paged layout (per-slot adapter "
+                "ids are traced operands of the fused paged step; the "
+                "pooled layout is the parity baseline); use "
+                "kv_layout='paged' with FLAGS_serving_adapter_slots > 0")
+        lane_key = (None if self._adapter_spec is None
+                    else (lambda r: r.adapter or 0))
         self.scheduler = Scheduler(
             buckets,
             max_queue=int(max_queue or
                           flags.get("FLAGS_serving_max_queue", 256)),
-            priority=self.priority_mode, tenant_weights=tenant_weights)
+            priority=self.priority_mode, tenant_weights=tenant_weights,
+            lane_key=lane_key)
         shed_on = (bool(flags.get("FLAGS_serving_shed", False))
                    if shed is None else bool(shed))
         self._shed = None
@@ -559,6 +601,29 @@ class Engine:
                 "speculative decoding is single-chip for now (the draft/"
                 "verify pair would double the mp collective schedule); "
                 "use mp=1 with FLAGS_serving_speculate_k > 0")
+        if self._adapter_spec is not None:
+            if self._spec is not None:
+                raise ValueError(
+                    "adapter serving is mutually exclusive with "
+                    "speculative decoding for now (the draft would need "
+                    "its own per-slot delta routing to keep accept rates "
+                    "honest); use FLAGS_serving_speculate_k=0 with "
+                    "FLAGS_serving_adapter_slots > 0")
+            self.adapters = AdapterRegistry(config, self._adapter_spec,
+                                            mesh=self._mesh)
+            self._tenant_adapters = (
+                resolve_tenant_adapters(flags) if tenant_adapters is None
+                else {str(k): int(v)
+                      for k, v in dict(tenant_adapters).items()})
+            for t, a in self._tenant_adapters.items():
+                if not 0 <= int(a) <= self._adapter_spec.slots:
+                    raise UnknownAdapterError(
+                        a, f"tenant {t!r} maps to adapter id {a} outside "
+                           f"capacity 0..{self._adapter_spec.slots}")
+            metrics.set_adapter_info(self._adapter_spec.slots,
+                                     self._adapter_spec.rank,
+                                     self.adapters.row_bytes())
+            metrics.set_adapter_residency(0, 0)
 
         cfg = _cfg_key(config)
         donate_ok = jax.default_backend() != "cpu"  # cpu: donation unimplemented
@@ -619,18 +684,21 @@ class Engine:
                        and bool(flags.get("FLAGS_serving_quant_kernel",
                                           True))
                        and jax.default_backend() == "tpu")
+            adapter_key = (None if self._adapter_spec is None
+                           else self._adapter_spec.key())
             if self.mp > 1:
                 self._paged_step = _make_paged_step(
                     cfg, self.top_k, self.page_size, use_kernel,
                     (1, 2) if donate_ok else (),
                     mp_key=(self._mesh, self._mp_cfg),
                     anomaly=self._anomaly, quant=quant_key,
-                    qkernel=qkernel)
+                    qkernel=qkernel, adapters=adapter_key)
             else:
                 self._paged_step = _make_paged_step(
                     cfg, self.top_k, self.page_size, use_kernel,
                     (1, 2) if donate_ok else (), anomaly=self._anomaly,
-                    quant=quant_key, qkernel=qkernel)
+                    quant=quant_key, qkernel=qkernel,
+                    adapters=adapter_key)
             self._page_copy = _make_page_copy((0, 1) if donate_ok else ())
             if self._spec is not None:
                 # one draft + one verify builder, memoized per config like
@@ -676,6 +744,7 @@ class Engine:
         self._temp = np.ones(B, np.float32)
         self._top_p = np.ones(B, np.float32)
         self._do_sample = np.zeros(B, bool)
+        self._aid = np.zeros(B, np.int32)       # per-slot adapter row id
         # paged: next prompt index to prefill for slot b (== prompt_len once
         # prefill is done and the slot is decoding), plus the admission
         # sequence number that keeps chunked prefill FCFS across slots
@@ -779,6 +848,12 @@ class Engine:
             raise ValueError(
                 "disaggregated roles ride the paged layout (KV pages are "
                 "the transfer unit); use kv_layout='paged'")
+        if role != "both" and getattr(self, "adapters", None) is not None:
+            raise ValueError(
+                "adapter serving is single-role for now (a prefill/decode "
+                "handoff would have to carry the adapter-residency "
+                "contract across workers); use role='both' with "
+                "FLAGS_serving_adapter_slots > 0")
         if (any(r is not None for r in self._slots)
                 or self.scheduler.qsize() > 0
                 or self._outbound or self._transfers_in):
@@ -902,6 +977,28 @@ class Engine:
                 f"with static top_k={self.top_k}; pass top_k={self.top_k} "
                 f"to accept the engine's truncation, or serve it from an "
                 f"Engine built with top_k=None")
+        if request.adapter is None:
+            # tenant default mapping (FLAGS_serving_tenant_adapters):
+            # unmapped tenants serve the base model
+            request.adapter = int(
+                self._tenant_adapters.get(request.tenant, 0))
+        if request.adapter != 0:
+            # typed refusal UP FRONT for ids the engine can never serve
+            # (disabled adapters / outside capacity). A merely
+            # NON-RESIDENT id is NOT an error: the request queues and
+            # admission blocks until load_adapter makes it resident.
+            if self.adapters is None:
+                metrics.bump("rejected")
+                raise UnknownAdapterError(
+                    request.adapter,
+                    f"request names adapter {request.adapter} but this "
+                    f"engine serves no adapters "
+                    f"(FLAGS_serving_adapter_slots=0)")
+            try:
+                self.adapters._check_id(request.adapter)
+            except UnknownAdapterError:
+                metrics.bump("rejected")
+                raise
         if request.max_new_tokens == 0:
             # parity with generate(max_new_tokens=0): prompt unchanged
             request.submit_t = time.perf_counter()
@@ -1155,6 +1252,19 @@ class Engine:
         return (jnp.asarray(self.pool.k_scale),
                 jnp.asarray(self.pool.v_scale))
 
+    def _adapter_args(self, sl=None):
+        """Traced adapter operands of the fused step (AFTER the kv
+        scales): the per-slot adapter row ids (host-authoritative,
+        re-uploaded every dispatch exactly like the page table) and the
+        stacked delta slabs (device-resident; re-placed only by
+        load/evict/swap). Empty when adapters are off, so the
+        adapter-less dispatch signature is untouched. ``sl`` slices the
+        id row for the [1, chunk] prefill dispatch."""
+        if self.adapters is None:
+            return ()
+        aid = self._aid if sl is None else self._aid[sl]
+        return (jnp.asarray(aid), self.adapters.device_slabs())
+
     def _cow(self, b, start, end):
         """Copy-on-write guard: a slot may only WRITE pages it exclusively
         owns — split any shared page in [start, end) to a fresh physical
@@ -1224,7 +1334,8 @@ class Engine:
             jnp.asarray(valid), jnp.asarray(emit),
             jnp.asarray(self.pool.table), jnp.asarray(self._do_sample),
             jnp.asarray(self._temp), jnp.asarray(self._top_p),
-            jnp.asarray(self._keys), *self._kv_scale_args())
+            jnp.asarray(self._keys), *self._kv_scale_args(),
+            *self._adapter_args())
         if self._anomaly:
             self._kc, self._vc, nxt, keys, ok = out
             ok = np.asarray(ok)
@@ -1411,7 +1522,8 @@ class Engine:
             jnp.asarray(self._do_sample[b:b + 1]),
             jnp.asarray(self._temp[b:b + 1]),
             jnp.asarray(self._top_p[b:b + 1]),
-            jnp.asarray(self._keys[b:b + 1]), *self._kv_scale_args())
+            jnp.asarray(self._keys[b:b + 1]), *self._kv_scale_args(),
+            *self._adapter_args(slice(b, b + 1)))
         if self._anomaly:
             # the verdict is only consulted on the emitting (final) chunk
             # — fetch it there, not per chunk (no extra host sync on the
@@ -1758,6 +1870,12 @@ class Engine:
             risk = self.scheduler.deadline_risk(now, margin)
             if risk is None:
                 return
+            if self.adapters is not None \
+                    and not self.adapters.resident(risk.adapter or 0):
+                # evicting running slots cannot make a non-resident
+                # adapter appear — preemption would burn a victim for
+                # nothing; the request waits for load_adapter instead
+                return
             if not self._capacity_for(risk):
                 b = self._preempt_slot(risk.class_rank)
                 if b is None:
@@ -1779,6 +1897,26 @@ class Engine:
             free_b = next(b for b, r in enumerate(self._slots) if r is None)
             self._admit(risk, free_b)
 
+    def _prefix_salt(self, req, version=None):
+        """Prefix-cache key salt for ``req``. Base traffic (adapter id 0,
+        or an adapter-less engine) gets b"" — base-model prompt pages are
+        keyed by tokens alone and stay shared across every tenant AND
+        across adapter load/evict/swap. Adapted requests get their
+        (adapter id, content version): the adapted out/up/down projections
+        feed the residual stream the NEXT layer's K/V is computed from, so
+        a prompt page prefilled under one set of delta bits is only
+        bitwise-reusable under those SAME bits. Versioned keys are what
+        makes ``swap_adapter`` flush-free — the old version's entries just
+        become unreachable and age out of the LRU."""
+        if self.adapters is None:
+            return b""
+        aid = int(req.adapter or 0)
+        if aid == 0:
+            return b""
+        if version is None:
+            version = self.adapters.version(aid)
+        return b"a%d:%d|" % (aid, int(version))
+
     def _try_reserve(self, req, probe=False):
         """Page-aware admission predicate (the scheduler's ``fits``): pin
         the longest cached prompt prefix, then allocate every page the
@@ -1786,7 +1924,15 @@ class Engine:
         plus a copy-on-write spare when sharing overlaps the write range).
         Returns False — pool untouched — when pages don't suffice yet; the
         head then waits for running requests to release pages (strict
-        FCFS, no starvation)."""
+        FCFS, no starvation). A request bound to a NON-RESIDENT adapter
+        never fits — admission blocks (strict in-order: the scheduler
+        stops at the first non-fitting head) until ``load_adapter`` makes
+        the id resident; pages are untouched."""
+        if self.adapters is not None \
+                and not self.adapters.resident(req.adapter or 0):
+            if not probe:
+                metrics.bump("adapter_admit_blocked")
+            return False
         pool = self.pool
         ps = self.page_size
         plen = req.prompt_len
@@ -1796,7 +1942,8 @@ class Engine:
         total = pages_for(
             plen + (0 if self.role == "prefill" else req.max_new_tokens),
             ps)
-        m, shared, exact = pool.lookup(req.prompt)
+        m, shared, exact = pool.lookup(req.prompt,
+                                       salt=self._prefix_salt(req))
         # at least the last prompt token must be (re-)forwarded so the
         # first emitted token has logits — even on an exact-prompt hit
         chunk_start = min(m, plen - 1)
@@ -1852,6 +1999,15 @@ class Engine:
         req.state = RUNNING
         req.slot = b
         req.params_version = self.params_version
+        if self.adapters is not None:
+            aid = int(req.adapter or 0)
+            self._aid[b] = aid
+            # the adapter analogue of params_version: which delta bits
+            # produced this request's tokens (rides snapshots + results)
+            req.adapter_version = self.adapters.version(aid)
+            if req.trace is not None:
+                req.trace.instant("adapter", adapter_id=aid,
+                                  adapter_version=req.adapter_version)
         self._slots[b] = req
         self._chunk_off[b] = chunk_start
         self._admit_count += 1
@@ -1962,7 +2118,12 @@ class Engine:
             # page is harmless — a consumer always CoW-copies that page
             # before its first write, and never unmasks a position it has
             # not itself written.
-            self.pool.register(req.prompt, b)
+            # salt with the version STAMPED at admission (a bound adapter
+            # cannot be mutated, but the stamped value is the truth of
+            # which bits produced these pages)
+            self.pool.register(
+                req.prompt, b,
+                salt=self._prefix_salt(req, version=req.adapter_version))
         self._slots[b] = None
         self._pos[b] = 0
         self._tok[b] = 0
@@ -1976,6 +2137,12 @@ class Engine:
         self._temp[b] = 1.0
         self._top_p[b] = 1.0
         self._do_sample[b] = False
+        if self.adapters is not None and req is not None and req.tokens:
+            # per-adapter token share (base id 0 included): the fairness
+            # gauge the WFQ-across-adapters policy is audited against
+            metrics.observe_adapter_tokens(int(self._aid[b]),
+                                           len(req.tokens))
+        self._aid[b] = 0
         if self.kv_layout == "paged":
             self.pool.release_slot(b)
 
@@ -2082,7 +2249,10 @@ class Engine:
             # the prefix cache holds KV pages COMPUTED UNDER THE OLD
             # WEIGHTS — a post-swap prompt that prefix-hit them would
             # decode against stale KV (caught by the parity gate). Version
-            # bump invalidates the whole cache.
+            # bump invalidates the whole cache. This full flush is scoped
+            # to BASE-weight swaps only: adapter load/evict/swap
+            # (load_adapter & co.) never touch attention, so their pages
+            # stay valid and those ops deliberately skip this.
             self.pool.clear_cache()
         if self._spec is not None:
             # the draft must propose against the NEW weights (a stale
@@ -2093,6 +2263,85 @@ class Engine:
         if count:
             metrics.bump("weight_swaps")
         return self
+
+    # -- adapter hot-load / evict / swap -------------------------------------
+    def _require_adapters(self):
+        if self.adapters is None:
+            raise RuntimeError(
+                "this engine serves no adapters; construct it with "
+                "adapter_slots > 0 (or FLAGS_serving_adapter_slots)")
+        return self.adapters
+
+    def _check_adapter_unbound(self, adapter_id, verb):
+        """Refuse to mutate an adapter some RUNNING slot is decoding
+        against: its stream would silently switch delta bits mid-request
+        — the adapter analogue of the mid-stream version mix swap_params
+        drains against. Queued requests are fine (admission re-checks
+        residency and stamps the version at seat time)."""
+        busy = [b for b, r in enumerate(self._slots)
+                if r is not None and int(self._aid[b]) == int(adapter_id)]
+        if busy:
+            raise RuntimeError(
+                f"cannot {verb} adapter {adapter_id}: bound to running "
+                f"slot(s) {busy}; wait for them to finish (or cancel)")
+
+    def _adapter_gauges(self):
+        metrics.set_adapter_residency(len(self.adapters.resident_ids()),
+                                      self.adapters.delta_bytes())
+
+    def load_adapter(self, adapter_id, tree, alpha=None, count=True):
+        """Make ``adapter_id`` resident (hot — while serving): a
+        content-only rewrite of the fixed-shape delta slabs, so like
+        ``swap_params`` it re-dispatches the already-compiled fused step
+        with ZERO retraces (gated in tests). Queued requests blocked on
+        this id admit at the next boundary.
+
+        Unlike ``swap_params``, loading an adapter does NOT flush the
+        prefix-page cache: attention projections are never adapted
+        (serving/adapters.py rejects ``qkv_w``), so every KV page is
+        computed under the BASE weights only and stays valid for every
+        adapter — shared-base prefix reuse across adapters is the point.
+
+        ``count=False`` skips the ``adapter_loads`` ledger bump (the
+        supervisor RE-applying a live adapter set onto a respawned
+        replica — not a new load)."""
+        reg = self._require_adapters()
+        self._check_stopped()
+        self._check_adapter_unbound(adapter_id, "load over")
+        version = reg.load(adapter_id, tree, alpha=alpha)
+        if count:
+            metrics.bump("adapter_loads")
+        self._adapter_gauges()
+        return version
+
+    def evict_adapter(self, adapter_id, count=True):
+        """Drop a resident adapter (hot): its slab rows zero and its id
+        becomes loadable again. Queued requests bound to it WAIT at
+        admission (strict in-order) until a reload. No prefix-cache
+        flush — see ``load_adapter``. Zero retraces."""
+        reg = self._require_adapters()
+        self._check_stopped()
+        self._check_adapter_unbound(adapter_id, "evict")
+        reg.evict(adapter_id)
+        if count:
+            metrics.bump("adapter_evicts")
+        self._adapter_gauges()
+
+    def swap_adapter(self, adapter_id, tree, alpha=None, count=True):
+        """Replace a RESIDENT adapter's delta in place (hot): bumps the
+        per-adapter version — requests admitted after the swap are
+        stamped with it — and, like every adapter op, costs zero retraces
+        and no prefix-cache flush. ``count=False`` is the supervisor
+        applying one fleet-level swap across its replicas (counted
+        once)."""
+        reg = self._require_adapters()
+        self._check_stopped()
+        self._check_adapter_unbound(adapter_id, "swap")
+        version = reg.load(adapter_id, tree, alpha=alpha, replace=True)
+        if count:
+            metrics.bump("adapter_swaps")
+        self._adapter_gauges()
+        return version
 
     # -- self-healing: snapshot / restore / drain ----------------------------
     def attach_checkpoint(self, mgr, every=None):
@@ -2151,7 +2400,11 @@ class Engine:
                 "weight_dtype": (self._quant.weight_dtype
                                  if self._quant is not None else "bf16"),
                 "kv_dtype": (self._quant.kv_dtype
-                             if self._quant is not None else "bf16")}
+                             if self._quant is not None else "bf16"),
+                # adapter CAPACITY is a compatibility axis (slab shapes);
+                # the resident SET is data and rides state["adapters"]
+                "adapters": (None if self._adapter_spec is None
+                             else self._adapter_spec.key())}
         if self.kv_layout == "paged":
             meta.update(page_size=self.page_size,
                         prefill_chunk=self.prefill_chunk,
@@ -2169,6 +2422,8 @@ class Engine:
                 "ttft": res.ttft, "latency": res.latency,
                 "priority": res.priority, "tenant": res.tenant,
                 "params_version": res.params_version,
+                "adapter": res.adapter,
+                "adapter_version": res.adapter_version,
                 "retry_after": res.retry_after,
                 # exceptions may not pickle; the repr is enough postmortem
                 "callback_error": (None if res.callback_error is None
@@ -2201,6 +2456,7 @@ class Engine:
             "top_p": self._top_p.copy(),
             "do_sample": self._do_sample.copy(),
             "chunk_off": self._chunk_off.copy(),
+            "aid": self._aid.copy(),
             "admit_seq": self._admit_seq.copy(),
             "admit_count": int(self._admit_count),
             "step_count": int(self._step_count),
@@ -2218,6 +2474,11 @@ class Engine:
         }
         if self.kv_layout == "paged":
             state["pool"] = self.pool.state_dict()
+        if self.adapters is not None:
+            # the resident adapter SET rides every snapshot: a restored
+            # (or supervisor-respawned) engine serves the same many-model
+            # surface without re-issuing load_adapter calls
+            state["adapters"] = self.adapters.state_dict()
         if self._spec is not None:
             # draft/speculation state. Drafts are BOUNDARY-ATOMIC — a
             # draft+verify pair completes inside one step boundary and
@@ -2262,6 +2523,12 @@ class Engine:
         # pre-quant snapshots carry no dtype fields: they are bf16/bf16
         meta.setdefault("weight_dtype", "bf16")
         meta.setdefault("kv_dtype", "bf16")
+        # pre-adapter snapshots carry no capacity field: adapter-less.
+        # Normalize the key's tuple-of-tuples (JSON round trips lists)
+        meta.setdefault("adapters", None)
+        if meta["adapters"] is not None:
+            s, r, t = meta["adapters"]
+            meta["adapters"] = (int(s), int(r), tuple(t))
         mine = self._snapshot_meta()
         snap_q = (meta["weight_dtype"], meta["kv_dtype"])
         mine_q = (mine["weight_dtype"], mine["kv_dtype"])
@@ -2298,6 +2565,13 @@ class Engine:
         self._top_p = np.asarray(state["top_p"], np.float32).copy()
         self._do_sample = np.asarray(state["do_sample"], bool).copy()
         self._chunk_off = np.asarray(state["chunk_off"], np.int32).copy()
+        if "aid" in state:
+            self._aid = np.asarray(state["aid"], np.int32).copy()
+        else:                      # pre-adapter snapshot: all base
+            self._aid = np.zeros(self.num_slots, np.int32)
+        if self.adapters is not None and "adapters" in state:
+            self.adapters.load_state_dict(state["adapters"])
+            self._adapter_gauges()
         self._admit_seq = np.asarray(state["admit_seq"], np.int64).copy()
         self._admit_count = int(state["admit_count"])
         self._step_count = int(state["step_count"])
@@ -2351,6 +2625,8 @@ class Engine:
                 priority=d.get("priority", "batch"),
                 tenant=d.get("tenant", "default"),
                 params_version=d.get("params_version"),
+                adapter=d.get("adapter", 0),
+                adapter_version=d.get("adapter_version"),
                 retry_after=d.get("retry_after"))
             for d in state["results"]}
         if restore_metrics:

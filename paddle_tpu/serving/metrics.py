@@ -103,6 +103,15 @@ def _zero():
         "draft_dispatches": 0, "verify_dispatches": 0,
         "spec_proposed": 0, "spec_accepted": 0, "spec_tokens_out": 0,
         "spec_draft_traces": 0, "spec_verify_traces": 0,
+        # many-model serving (serving/adapters.py): adapter residency ops
+        # (hot load / evict / in-place swap — all zero-retrace), admission
+        # boundaries a request spent blocked on a non-resident adapter,
+        # and the residency gauges (resident count, HBM bytes their
+        # rank-padded delta rows occupy). Capacity labels (slots/rank/
+        # per-adapter row bytes) live in _adapter_info.
+        "adapter_loads": 0, "adapter_evicts": 0, "adapter_swaps": 0,
+        "adapter_admit_blocked": 0,
+        "adapters_resident": 0, "adapter_delta_bytes": 0,
         # tokens / time
         "tokens_out": 0,
         "decode_time_s": 0.0, "prefill_time_s": 0.0,
@@ -119,6 +128,14 @@ _C = _zero()
 _mp_info = {}
 # quant dtype labels (summary display): set by the last quantized engine
 _quant_info = {}
+# adapter capacity labels (summary display + registry export): slot count,
+# padded rank, per-adapter row bytes — engine CONFIGURATION like _mp_info,
+# set once at build and surviving reset_serving_counters
+_adapter_info = {}
+# per-adapter token tally (lazy: an adapter id appears once a request it
+# served frees its slot) — feeds the per-adapter token-share gauges that
+# make WFQ-across-adapters fairness observable
+_adapter_tokens = {}
 # ring buffers: percentiles track the LAST window of traffic, not the
 # first — a long-running server must surface a late latency regression
 _MAX_SAMPLES = 65536
@@ -154,6 +171,36 @@ def set_quant_info(weight_dtype, kv_dtype, scale_bytes=0,
         _quant_info["kv_dtype"] = str(kv_dtype)
         _C["quant_scale_bytes"] = int(scale_bytes)
         _C["quant_kv_bytes_per_token"] = int(kv_bytes_per_token)
+
+
+def set_adapter_info(slots, rank, row_bytes):
+    """Record the adapter-capacity config (serving/adapters.py) — slot
+    count, padded rank, per-adapter delta row bytes — set once at engine
+    build. Configuration labels like ``_mp_info``: they survive
+    ``reset_serving_counters`` so a benchmark resetting counters between
+    rungs keeps the summary's capacity context."""
+    with _lock:
+        _adapter_info["slots"] = int(slots)
+        _adapter_info["rank"] = int(rank)
+        _adapter_info["row_bytes"] = int(row_bytes)
+
+
+def set_adapter_residency(resident, delta_bytes):
+    """Residency gauges, rewritten after every load/evict/swap: how many
+    adapters are resident and how many HBM bytes their (rank-padded)
+    delta rows actually occupy."""
+    with _lock:
+        _C["adapters_resident"] = int(resident)
+        _C["adapter_delta_bytes"] = int(delta_bytes)
+
+
+def observe_adapter_tokens(adapter_id, n):
+    """Tally ``n`` emitted tokens against ``adapter_id`` (0 = base model)
+    — recorded when a slot frees, so the per-adapter token-share gauges
+    reflect work actually delivered per model."""
+    with _lock:
+        _adapter_tokens[int(adapter_id)] = (
+            _adapter_tokens.get(int(adapter_id), 0) + int(n))
 
 
 def observe_logit_drift(drift):
@@ -248,6 +295,7 @@ def serving_counters():
         ttft = list(_ttft)
         lat = list(_tok_lat)
         cls_samples = {c: list(v) for c, v in _ttft_cls.items()}
+        ad_tokens = dict(_adapter_tokens)
     out["ttft_p50"] = float(np.percentile(ttft, 50)) if ttft else None
     out["ttft_p99"] = float(np.percentile(ttft, 99)) if ttft else None
     for c, v in cls_samples.items():
@@ -285,6 +333,14 @@ def serving_counters():
     spec_disp = out["draft_dispatches"] + out["verify_dispatches"]
     out["tokens_per_dispatch"] = (out["spec_tokens_out"] / spec_disp
                                   if spec_disp else 0.0)
+    # many-model serving: per-adapter token counts and shares (fraction of
+    # all adapter-attributed tokens, base id 0 included) — the WFQ
+    # fairness gauges. Keys appear only for adapters that emitted tokens.
+    ad_total = sum(ad_tokens.values())
+    for aid, n in sorted(ad_tokens.items()):
+        out[f"adapter_tokens_{aid}"] = n
+        out[f"adapter_token_share_{aid}"] = (n / ad_total if ad_total
+                                             else 0.0)
     return out
 
 
@@ -295,9 +351,11 @@ def reset_serving_counters():
         _ttft.clear()
         _tok_lat.clear()
         _ttft_cls.clear()
-        # _mp_info survives on purpose: it is engine CONFIGURATION (the
-        # live rung/degree labels), not a counter — a benchmark resetting
-        # counters between rungs must not blank the summary's mp labels
+        _adapter_tokens.clear()
+        # _mp_info / _adapter_info survive on purpose: they are engine
+        # CONFIGURATION (the live rung/degree/capacity labels), not
+        # counters — a benchmark resetting counters between rungs must
+        # not blank the summary's config labels
 
 
 _PREFIX_KEYS = ("prefix_lookups", "prefix_hits", "prefix_tokens_reused")
@@ -330,7 +388,8 @@ def export_state():
     with _lock:
         return {"counters": dict(_C), "ttft": list(_ttft),
                 "token_latency": list(_tok_lat),
-                "ttft_cls": {c: list(v) for c, v in _ttft_cls.items()}}
+                "ttft_cls": {c: list(v) for c, v in _ttft_cls.items()},
+                "adapter_tokens": dict(_adapter_tokens)}
 
 
 def import_state(state):
@@ -349,6 +408,10 @@ def import_state(state):
         _ttft_cls.clear()
         for c, v in state.get("ttft_cls", {}).items():
             _ttft_cls[c] = deque(v, maxlen=_MAX_SAMPLES)
+        _adapter_tokens.clear()
+        for aid, n in state.get("adapter_tokens", {}).items():
+            # JSON round-trips stringify int keys; normalize back
+            _adapter_tokens[int(aid)] = int(n)
 
 
 def serving_summary():
@@ -432,6 +495,26 @@ def serving_summary():
                f"scale: +{c['scale_ups']}/-{c['scale_downs']}  "
                f"weight-swaps: {c['weight_swaps']}"
                + (f"  {cls_p99}" if cls_p99 else ""))
+    adapters = ""
+    with _lock:
+        ainfo = dict(_adapter_info)
+        ad_tokens = dict(_adapter_tokens)
+    if ainfo and (c["adapters_resident"] or c["adapter_loads"]
+                  or c["adapter_evicts"] or c["adapter_swaps"]
+                  or c["adapter_admit_blocked"]):
+        ad_total = sum(ad_tokens.values())
+        top = sorted(ad_tokens.items(), key=lambda kv: -kv[1])[:4]
+        share = " ".join(
+            f"a{aid}:{n / ad_total * 100:.0f}%" for aid, n in top
+            if ad_total) if top else ""
+        adapters = (f"  adapters: {c['adapters_resident']}/"
+                    f"{ainfo.get('slots', '?')} resident "
+                    f"(r{ainfo.get('rank', '?')}, "
+                    f"{c['adapter_delta_bytes'] / 1e6:.2f}MB delta)  "
+                    f"load/evict/swap: {c['adapter_loads']}/"
+                    f"{c['adapter_evicts']}/{c['adapter_swaps']}  "
+                    f"admit-blocked: {c['adapter_admit_blocked']}"
+                    + (f"  tok-share: {share}" if share else ""))
     sdc = ""
     from ..distributed import integrity as _integrity
     s = _integrity.sdc_counters()
@@ -447,4 +530,5 @@ def serving_summary():
             f"queue: {c['queue_depth_mean']:.1f} avg/{c['queue_depth_max']} max  "
             f"executables: {c['prefill_traces']} prefill + "
             f"{c['decode_traces']} decode + {c['paged_traces']} paged"
-            f"{paged}{quant}{spec}{mp}{disagg}{waste}{slo}{heal}{sdc}")
+            f"{paged}{quant}{spec}{mp}{adapters}{disagg}{waste}{slo}{heal}"
+            f"{sdc}")

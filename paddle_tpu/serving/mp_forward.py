@@ -52,6 +52,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..distributed.env import shard_map_compat
 from ..models.generation import _final_ln
 from ..models.gpt import ln_fp32
+from ..ops.pallas_kernels.quant_gemm import lora_delta, compose_delta
 from .paged_attention import paged_attention_read, paged_kv_scatter
 
 KV_SPEC = P(None, None, None, "mp", None)   # [L, P, page, nh@mp, d]
@@ -167,7 +168,7 @@ def ag_last(x, axis, n, backend, meta):
     return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
 
 
-def gemm_ag(x, w, axis, n, backend, meta, scale=None):
+def gemm_ag(x, w, axis, n, backend, meta, scale=None, epilogue=None):
     """Column-parallel projection: full-contraction local block
     ``x @ w_shard`` + all-gather of the output blocks. Bitwise equal to
     ``x @ w_full`` on every rung (the fused rung's GEMM epilogue feeds
@@ -178,18 +179,33 @@ def gemm_ag(x, w, axis, n, backend, meta, scale=None):
     multiply rides the local GEMM epilogue (inside the Pallas kernel on
     the fused rung), so the mp engine never materializes an fp weight
     copy, and the scaled block equals the column slice of the single-chip
-    quantized product bitwise."""
+    quantized product bitwise.
+
+    ``epilogue`` (adapter serving): element-wise function applied to the
+    LOCAL output block BEFORE the gather — the per-slot LoRA delta
+    compose. Element-wise maps commute with the pure-data-movement
+    gather, so composing pre-gather equals composing on the full product:
+    the bitwise contract survives. With an epilogue the fused rung routes
+    its gather through ``fused_ag_bucket`` (the epilogue has to land
+    between the GEMM and the ring, so the in-kernel fused_gemm_ag path
+    is skipped for that projection — still an exact gather)."""
     if n == 1:
         if scale is not None:
-            return (x @ w.astype(x.dtype)) * scale.astype(x.dtype)
-        return x @ w
-    if backend == "fused":
+            y = (x @ w.astype(x.dtype)) * scale.astype(x.dtype)
+        else:
+            y = x @ w
+        return y if epilogue is None else epilogue(y)
+    if backend == "fused" and epilogue is None:
         from ..ops.pallas_kernels import fused_collectives as _fc
         return _fc.fused_gemm_ag(meta, x, w, scale=scale)
     if scale is not None:
         y = (x @ w.astype(x.dtype)) * scale.astype(x.dtype)
     else:
         y = x @ w
+    if epilogue is not None:
+        y = epilogue(y)
+    if backend == "fused":
+        return ag_last(y, axis, n, backend, meta)
     if backend == "ring":
         return _ring_ag_last(y, axis, n)
     return lax.all_gather(y, axis, axis=y.ndim - 1, tiled=True)
@@ -211,7 +227,8 @@ def _local_proj(h, p, name):
 
 
 def _mp_block(p, h, kc_l, vc_l, table, pos, valid, nh, n, eps, page_size,
-              use_kernel, axis, backend, meta, ksc_l=None, vsc_l=None):
+              use_kernel, axis, backend, meta, ksc_l=None, vsc_l=None,
+              aid=None, ad_l=None):
     """One transformer block on PER-CHIP shards: h [B, T, H] replicated,
     weights column-sharded (qkv head-major: the local contiguous shard is
     nh/n whole heads), KV pool holding the local heads only. Every op is
@@ -221,10 +238,27 @@ def _mp_block(p, h, kc_l, vc_l, table, pos, valid, nh, n, eps, page_size,
     paged_attention._layer_paged on one chip, at EVERY dtype config
     (quantized weights dequantize in the epilogue against their own
     column-scale shard; the quantized KV pool's per-page scales are
-    replicated and head-independent)."""
+    replicated and head-independent).
+
+    Adapters (aid [B] + this layer's slab rows ``ad_l``): A slabs are
+    replicated and B slabs shard with their OUTPUT channels, so each
+    chip's delta is exactly the column slice of the single-chip delta
+    (the rank-r intermediate ``x @ A[aid]`` is replicated-identical
+    everywhere, full contraction). The delta composes onto the LOCAL
+    base block before each gather — element-wise, so it commutes with
+    the gather and the single-chip bitwise contract is untouched."""
     B, T, H = h.shape
     nh_l = nh // n
     d = H // nh
+
+    def _delta_epi(x, name):
+        """compose-epilogue for the local column block of ``name``, or
+        None when the layer carries no delta for it."""
+        if ad_l is None or name not in ad_l:
+            return None
+        A_l, B_l = ad_l[name]
+        dlt = lora_delta(x, A_l, B_l, aid)
+        return lambda y: compose_delta(y, dlt, aid)
 
     h1 = ln_fp32(h, p["ln1_g"], p["ln1_b"], eps)
     qkv = _local_proj(h1, p, "qkv_w") + p["qkv_b"].astype(h.dtype)
@@ -244,29 +278,40 @@ def _mp_block(p, h, kc_l, vc_l, table, pos, valid, nh, n, eps, page_size,
     attn = gemm_ag(ctx_full,
                    p["out_w"] if out_s is not None
                    else p["out_w"].astype(h.dtype),
-                   axis, n, backend, meta, scale=out_s) + \
+                   axis, n, backend, meta, scale=out_s,
+                   epilogue=_delta_epi(ctx_full, "out_w")) + \
         p["out_b"].astype(h.dtype)
     h = h + attn
     h2 = ln_fp32(h, p["ln2_g"], p["ln2_b"], eps)
-    up = _local_proj(h2, p, "up_w") + p["up_b"].astype(h.dtype)
+    up = _local_proj(h2, p, "up_w")
+    up_epi = _delta_epi(h2, "up_w")
+    if up_epi is not None:
+        up = up_epi(up)
+    up = up + p["up_b"].astype(h.dtype)
     up = jax.nn.gelu(up, approximate=True)
     act = ag_last(up, axis, n, backend, meta)                   # [B, T, I]
     down_s = p.get("down_w_s")
     down = gemm_ag(act,
                    p["down_w"] if down_s is not None
                    else p["down_w"].astype(h.dtype),
-                   axis, n, backend, meta, scale=down_s)
+                   axis, n, backend, meta, scale=down_s,
+                   epilogue=_delta_epi(act, "down_w"))
     return h + down + p["down_b"].astype(h.dtype), kc_l, vc_l
 
 
 def mp_paged_forward(params, config, ids, kc, vc, start, valid, table,
-                     page_size, use_kernel, mesh, mp_cfg, kv_scales=None):
+                     page_size, use_kernel, mesh, mp_cfg, kv_scales=None,
+                     adapters=None):
     """Fused chunk/decode forward over the mp-sharded engine: same
     signature and semantics as ``paged_attention.paged_forward`` but with
     params/KV sharded over ``mesh``'s 1-D mp axis. Returns replicated
     logits [B, V] plus the updated head-sharded pools. ``kv_scales`` =
     (k_scale, v_scale) [L, P] per-page dequant scales of a quantized
-    pool, replicated (a page's scale applies to every head shard)."""
+    pool, replicated (a page's scale applies to every head shard).
+    ``adapters`` = (aid [B], slabs) per-slot adapter operands: aid and
+    the A slabs replicate; B slabs shard with their output channels
+    (the quant-scale placement rule) so the per-chip delta lands on the
+    local column block before the gather."""
     compute = jnp.dtype(config.compute_dtype or "float32")
     n, axis, backend = mp_cfg.n, mp_cfg.axis, mp_cfg.backend
     meta = mp_cfg.kernel_meta(mesh)
@@ -274,7 +319,16 @@ def mp_paged_forward(params, config, ids, kc, vc, start, valid, table,
     eps = config.layer_norm_epsilon
     quant_weights = "head_w_s" in params
 
-    def device_fn(params, kc, vc, ids, start, valid, table, *scales):
+    def device_fn(params, kc, vc, ids, start, valid, table, *extra):
+        extra = list(extra)
+        if kv_scales is not None:
+            scales = (extra.pop(0), extra.pop(0))
+        else:
+            scales = ()
+        if adapters is not None:
+            aid_d, slabs_d = extra
+        else:
+            aid_d = slabs_d = None
         B, T = ids.shape
         pos = start[:, None] + jnp.arange(T)[None, :]           # [B, T]
         x = ag_last(params["wte"].astype(compute)[ids], axis, n, backend,
@@ -282,6 +336,10 @@ def mp_paged_forward(params, config, ids, kc, vc, start, valid, table,
             jnp.take(params["wpe"].astype(compute), pos, axis=0)
 
         def layer_fn(h, xs):
+            if adapters is not None:
+                xs, ad_l = xs[:-1], xs[-1]
+            else:
+                ad_l = None
             if scales:
                 p_l, kc_l, vc_l, ksc_l, vsc_l = xs
             else:
@@ -290,11 +348,12 @@ def mp_paged_forward(params, config, ids, kc, vc, start, valid, table,
             h, kc_l, vc_l = _mp_block(p_l, h, kc_l, vc_l, table, pos,
                                       valid, nh, n, eps, page_size,
                                       use_kernel, axis, backend, meta,
-                                      ksc_l, vsc_l)
+                                      ksc_l, vsc_l, aid_d, ad_l)
             return h, (kc_l, vc_l)
 
-        xs = ((params["blocks"], kc, vc) if not scales
-              else (params["blocks"], kc, vc) + tuple(scales))
+        xs = (params["blocks"], kc, vc) + tuple(scales)
+        if adapters is not None:
+            xs = xs + (slabs_d,)
         x, (kc2, vc2) = jax.lax.scan(layer_fn, x, xs)
         idx = jnp.maximum(valid - 1, 0)
         xlast = jax.vmap(
@@ -320,6 +379,13 @@ def mp_paged_forward(params, config, ids, kc, vc, start, valid, table,
     if kv_scales is not None:
         in_specs += [P(None, None), P(None, None)]
         args += [kv_scales[0], kv_scales[1]]
+    if adapters is not None:
+        aid_arr, slabs = adapters
+        in_specs += [P(None),
+                     {name: (P(None, None, None, None),
+                             P(None, None, None, "mp"))
+                      for name in slabs}]
+        args += [aid_arr, slabs]
     mapped = shard_map_compat(
         device_fn, mesh,
         in_specs=tuple(in_specs),

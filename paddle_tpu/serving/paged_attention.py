@@ -50,7 +50,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..models.gpt import ln_fp32
 from ..models.generation import _final_ln, _final_logits
-from ..ops.pallas_kernels.quant_gemm import quant_gemm
+from ..ops.pallas_kernels.quant_gemm import quant_gemm, lora_delta, \
+    compose_delta
 
 logger = logging.getLogger("paddle_tpu.paged_attention")
 
@@ -370,8 +371,25 @@ def paged_attention_read(q, kc_l, vc_l, table, pos, page_size, use_kernel,
                       kv_v).astype(out_dtype)
 
 
+def _adapted_proj(h, p, name, wq_kernel, aid, ad_l):
+    """``_proj`` plus the per-slot LoRA delta epilogue: when this layer's
+    adapter slab covers ``name`` the low-rank delta joins the base GEMM
+    output (before bias) through the masked compose — aid==0 rows keep
+    the base product bitwise. qkv_w is never in ``ad_l`` by construction
+    (AdapterRegistry forbids it), keeping the delta GEMM out of the
+    attention inner loop; prefix pages of ADAPTED requests still depend
+    on the delta bits through the residual stream, which is why the
+    engine salts their prefix-cache keys (engine._prefix_salt)."""
+    base = _proj(h, p, name, wq_kernel)
+    if ad_l is None or name not in ad_l:
+        return base
+    A_l, B_l = ad_l[name]
+    return compose_delta(base, lora_delta(h, A_l, B_l, aid), aid)
+
+
 def _layer_paged(p, h, kc_l, vc_l, table, pos, valid, nh, eps, page_size,
-                 use_kernel, ksc_l=None, vsc_l=None, wq_kernel=False):
+                 use_kernel, ksc_l=None, vsc_l=None, wq_kernel=False,
+                 aid=None, ad_l=None):
     """One transformer block over h [B, T, H] where each batch row is a
     serving slot processing the token window at absolute positions
     pos[b, :] (valid[b] of them real). K/V are scattered through the page
@@ -380,7 +398,10 @@ def _layer_paged(p, h, kc_l, vc_l, table, pos, valid, nh, eps, page_size,
     generation._layer_decode_slots / _layer_cached exactly, so a slot's
     stream is bitwise identical to single-request decode. Quantized
     engines route the GEMMs through ``_proj`` (epilogue dequant) and the
-    KV writes/reads through the per-page scales."""
+    KV writes/reads through the per-page scales. With adapters enabled,
+    aid [B] + this layer's slab rows ``ad_l`` route each slot's low-rank
+    delta into the out/up/down projection epilogues (qkv itself stays
+    un-adapted)."""
     B, T, H = h.shape
     d = H // nh
 
@@ -394,26 +415,30 @@ def _layer_paged(p, h, kc_l, vc_l, table, pos, valid, nh, eps, page_size,
     ctx = paged_attention_read(q, kc_l, vc_l, table, pos, page_size,
                                use_kernel, h.dtype, ksc_l, vsc_l)
 
-    attn = _proj(ctx.reshape(B, T, H), p, "out_w", wq_kernel) + \
-        p["out_b"].astype(h.dtype)
+    attn = _adapted_proj(ctx.reshape(B, T, H), p, "out_w", wq_kernel,
+                         aid, ad_l) + p["out_b"].astype(h.dtype)
     h = h + attn
     h2 = ln_fp32(h, p["ln2_g"], p["ln2_b"], eps)
-    up = _proj(h2, p, "up_w", wq_kernel) + p["up_b"].astype(h.dtype)
+    up = _adapted_proj(h2, p, "up_w", wq_kernel, aid, ad_l) + \
+        p["up_b"].astype(h.dtype)
     up = jax.nn.gelu(up, approximate=True)
-    return h + _proj(up, p, "down_w", wq_kernel) + \
+    return h + _adapted_proj(up, p, "down_w", wq_kernel, aid, ad_l) + \
         p["down_b"].astype(h.dtype), kc_l, vc_l
 
 
 def paged_forward(params, config, ids, kc, vc, start, valid, table,
                   page_size, use_kernel=False, kv_scales=None,
-                  wq_kernel=False):
+                  wq_kernel=False, adapters=None):
     """Fused chunk/decode forward: ids [B, T] is each slot's token window at
     absolute positions start[b]..start[b]+T-1 (valid[b] real). Returns
     logits at each slot's position valid[b]-1 ([B, V]) plus the updated
     paged pools [L, P, page_size, nh, d]. ``kv_scales`` = (k_scale,
     v_scale) [L, P] traced per-page dequant scales when the pool is
     quantized; ``wq_kernel`` routes quantized weight GEMMs through the
-    Pallas quant kernel (TPU)."""
+    Pallas quant kernel (TPU). ``adapters`` = (aid [B], slabs {target:
+    (A [L, cap, K, r], B [L, cap, r, F])}) traced per-slot adapter rows —
+    the slabs ride the layer scan alongside the block weights and the
+    per-slot delta joins the projection epilogues (adapters.py)."""
     compute = jnp.dtype(config.compute_dtype or "float32")
     B, T = ids.shape
     pos = start[:, None] + jnp.arange(T)[None, :]               # [B, T]
@@ -421,8 +446,13 @@ def paged_forward(params, config, ids, kc, vc, start, valid, table,
         jnp.take(params["wpe"].astype(compute), pos, axis=0)
     nh = config.num_heads
     ksc, vsc = kv_scales if kv_scales is not None else (None, None)
+    aid, slabs = adapters if adapters is not None else (None, None)
 
     def layer_fn(h, xs):
+        if adapters is not None:
+            xs, ad_l = xs[:-1], xs[-1]
+        else:
+            ad_l = None
         if kv_scales is not None:
             p_l, kc_l, vc_l, ksc_l, vsc_l = xs
         else:
@@ -431,11 +461,13 @@ def paged_forward(params, config, ids, kc, vc, start, valid, table,
         h, kc_l, vc_l = _layer_paged(p_l, h, kc_l, vc_l, table, pos, valid,
                                      nh, config.layer_norm_epsilon,
                                      page_size, use_kernel, ksc_l, vsc_l,
-                                     wq_kernel)
+                                     wq_kernel, aid, ad_l)
         return h, (kc_l, vc_l)
 
     xs = ((params["blocks"], kc, vc) if kv_scales is None
           else (params["blocks"], kc, vc, ksc, vsc))
+    if adapters is not None:
+        xs = xs + (slabs,)
     x, (kc, vc) = jax.lax.scan(layer_fn, x, xs)
     idx = jnp.maximum(valid - 1, 0)
     xlast = jax.vmap(

@@ -23,7 +23,16 @@ quantity). This module owns everything that is pure bookkeeping:
 Sharing is bitwise-safe because the KV of a token depends only on the
 token prefix before it: two requests whose prompts agree on ``m`` tokens
 compute bit-identical K/V for those positions, so reading the cached pages
-is indistinguishable from recomputing them.
+is indistinguishable from recomputing them. When the engine serves
+per-slot adapters (serving/adapters.py) that premise needs one more
+input: the adapted out/up/down projections feed the residual stream the
+NEXT layer's K/V is computed from, so an adapted request's prompt KV
+depends on its delta bits too. The engine therefore passes a ``salt``
+(adapter id + content version) into ``lookup``/``register`` — base
+traffic (id 0) keeps the unsalted keys and stays shared across every
+tenant, while adapted entries only ever match the exact delta content
+that produced them (a ``swap_adapter`` strands the old version's
+entries, which age out of the LRU; no flush needed).
 
 Quantized pool (``kv_dtype`` int8/fp8, serving/quant.py): the pool
 additionally owns per-PAGE dequant scales ``k_scale``/``v_scale``
@@ -244,15 +253,17 @@ class PagedKVPool:
         return copies
 
     # -- prefix cache --------------------------------------------------------
-    def lookup(self, prompt):
+    def lookup(self, prompt, salt=b""):
         """Longest cached prefix of `prompt` (np.int32 [plen]). Returns
         (matched_tokens, pages, exact): `pages` cover logical pages
         0..ceil(matched/page_size)-1 and are NOT ref-held yet (caller
         increfs). exact=True when the whole prompt matched an exact entry
-        (prefill reduces to re-forwarding the last prompt token)."""
+        (prefill reduces to re-forwarding the last prompt token).
+        ``salt`` namespaces the keys (adapter id + version for adapted
+        requests — see the module docstring); b"" is the shared base."""
         if not self.prefix_cache_enabled:
             return 0, [], False
-        raw = prompt.tobytes()
+        raw = salt + prompt.tobytes()
         hit = self._cache.get((b"E", raw))
         if hit is not None:
             self._cache.move_to_end((b"E", raw))
@@ -261,7 +272,7 @@ class PagedKVPool:
         ps = self.page_size
         pages = []
         for j in range(1, len(prompt) // ps + 1):
-            key = (b"P", prompt[:j * ps].tobytes())
+            key = (b"P", salt + prompt[:j * ps].tobytes())
             page = self._cache.get(key)
             if page is None:
                 break
@@ -269,25 +280,25 @@ class PagedKVPool:
             pages.append(page)
         return len(pages) * ps, pages, False
 
-    def peek_coverage(self, prompt):
+    def peek_coverage(self, prompt, salt=b""):
         """Longest cached prefix of ``prompt`` in TOKENS, without touching
         LRU recency or refcounts. The supervisor's affinity router probes
         every decode replica with this — a probe that bumped recency would
         let routing traffic keep cold entries pinned hot."""
         if not self.prefix_cache_enabled:
             return 0
-        hit = self._cache.get((b"E", prompt.tobytes()))
+        hit = self._cache.get((b"E", salt + prompt.tobytes()))
         if hit is not None:
             return hit[1]
         ps = self.page_size
         n = 0
         for j in range(1, len(prompt) // ps + 1):
-            if (b"P", prompt[:j * ps].tobytes()) not in self._cache:
+            if (b"P", salt + prompt[:j * ps].tobytes()) not in self._cache:
                 break
             n += 1
         return n * ps
 
-    def register(self, prompt, b, min_free_frac=0.25):
+    def register(self, prompt, b, min_free_frac=0.25, salt=b""):
         """Publish slot b's prompt pages into the cache (cumulative
         full-page hashes + the exact-prompt entry). The engine calls this
         on slot RELEASE (cache-on-free): the prompt KV is complete on
@@ -309,12 +320,12 @@ class PagedKVPool:
         ps = self.page_size
         row = self.table[b]
         for j in range(1, len(prompt) // ps + 1):
-            key = (b"P", prompt[:j * ps].tobytes())
+            key = (b"P", salt + prompt[:j * ps].tobytes())
             if key not in self._cache:
                 page = int(row[j - 1])
                 self._cache[key] = page
                 self.incref([page])
-        ekey = (b"E", prompt.tobytes())
+        ekey = (b"E", salt + prompt.tobytes())
         if ekey not in self._cache:
             pages = tuple(int(p) for p in
                           row[:pages_for(len(prompt), ps)])

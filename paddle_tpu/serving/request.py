@@ -67,6 +67,13 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
     # a latency-sensitive tenant trades throughput for the tightest
     # inter-token gap); on a plain engine it is carried but never read.
     speculate: str = "auto"
+    # adapter id to serve this request with (serving/adapters.py): 0 = the
+    # base model, 1..slots = a loaded low-rank delta, None = "resolve from
+    # the tenant mapping at submit" (FLAGS_serving_tenant_adapters;
+    # unmapped tenants get the base model). Submit raises a typed
+    # UnknownAdapterError for ids outside the engine's capacity; a merely
+    # non-resident id queues and blocks at admission until loaded.
+    adapter: int | None = None
 
     # -- engine-managed state ------------------------------------------------
     request_id: int = field(default_factory=lambda: next(_req_ids))
@@ -83,6 +90,10 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
     # admission; re-stamped when a requeue recomputes from scratch on a
     # swapped replica, so the RESULT is always single-version consistent)
     params_version: int | None = field(default=None)
+    # per-adapter content version the tokens were produced under (stamped
+    # at admission from AdapterRegistry.version; 0 for the base model) —
+    # the adapter analogue of params_version
+    adapter_version: int | None = field(default=None)
     # retry-after hint attached when load shedding resolves this request
     # (seconds until the shed backlog should have drained)
     retry_after: float | None = field(default=None)
@@ -121,6 +132,14 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
             raise ValueError(
                 f"speculate must be 'auto' or 'off', got "
                 f"{self.speculate!r}")
+        if self.adapter is not None:
+            self.adapter = int(self.adapter)
+            if self.adapter < 0:
+                from .adapters import UnknownAdapterError
+                raise UnknownAdapterError(
+                    self.adapter,
+                    f"adapter id must be >= 0 (0 = base model), got "
+                    f"{self.adapter}")
 
     @property
     def prompt_len(self):
@@ -205,7 +224,7 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
                     stop_token_ids=self.stop_token_ids, seed=self.seed,
                     deadline_s=self.deadline_s, on_token=self.on_token,
                     priority=self.priority, tenant=self.tenant,
-                    speculate=self.speculate)
+                    speculate=self.speculate, adapter=self.adapter)
         r.request_id = self.request_id
         r.submit_t = self.submit_t
         r.first_token_t = self.first_token_t
@@ -238,6 +257,9 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
             "priority": self.priority,
             "tenant": self.tenant,
             "speculate": self.speculate,
+            "adapter": None if self.adapter is None else int(self.adapter),
+            "adapter_version": (None if self.adapter_version is None
+                                else int(self.adapter_version)),
             "params_version": (None if self.params_version is None
                                else int(self.params_version)),
             "request_id": int(self.request_id),
@@ -264,8 +286,10 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
                 deadline_s=state["deadline_s"],
                 priority=state.get("priority", "batch"),
                 tenant=state.get("tenant", "default"),
-                speculate=state.get("speculate", "auto"))
+                speculate=state.get("speculate", "auto"),
+                adapter=state.get("adapter"))
         r.params_version = state.get("params_version")
+        r.adapter_version = state.get("adapter_version")
         r.request_id = int(state["request_id"])
         global _req_ids
         floor = next(_req_ids)
@@ -301,6 +325,8 @@ class Request:        # OBJECT, and field-wise eq would compare numpy prompts
             priority=self.priority,
             tenant=self.tenant,
             params_version=self.params_version,
+            adapter=0 if self.adapter is None else self.adapter,
+            adapter_version=self.adapter_version,
             retry_after=self.retry_after,
         )
 
@@ -322,6 +348,10 @@ class GenerationResult:
     # weight version the tokens were produced under (hot-swap audit trail);
     # None when the request never reached a slot
     params_version: int | None = None
+    # adapter id the request was served with (0 = base model) and the
+    # per-adapter content version its tokens were produced under
+    adapter: int = 0
+    adapter_version: int | None = None
     # seconds-until-retry hint on finish_reason == "shed"
     retry_after: float | None = None
 

@@ -55,10 +55,16 @@ class Scheduler:
     stays ONE arrival-ordered deque either way: snapshots, drains,
     requeue-at-original-arrival and cancel races are order-agnostic and
     shared between both modes — priority is a pure admission-order policy
-    computed at the boundary."""
+    computed at the boundary.
+
+    ``lane_key`` generalizes the WFQ lane axis: the default (None) lanes
+    by ``r.tenant``; an adapter-serving engine passes ``lambda r:
+    r.adapter or 0`` so fairness rotates across ADAPTERS — one hot
+    fine-tune's burst cannot starve the other models sharing the engine.
+    ``tenant_weights`` keys by whatever the lane key returns."""
 
     def __init__(self, buckets, max_queue=256, priority=False,
-                 tenant_weights=None):
+                 tenant_weights=None, lane_key=None):
         buckets = sorted(int(b) for b in buckets)
         if not buckets:
             raise ValueError("need at least one prefill bucket")
@@ -69,7 +75,8 @@ class Scheduler:
         # lane AND stall the WFQ rotation that expects every pass to drain
         self.tenant_weights = {str(t): max(1, int(w))
                                for t, w in (tenant_weights or {}).items()}
-        self._wfq_last = {}            # class rank -> last-served tenant
+        self.lane_key = (lambda r: r.tenant) if lane_key is None else lane_key
+        self._wfq_last = {}            # class rank -> last-served lane
         self._q = deque()
 
     def set_tenant_weight(self, tenant, weight):
@@ -173,26 +180,33 @@ class Scheduler:
         return out
 
     def _wfq_order(self, rank, reqs):
-        """Weighted fair order across tenants within one class."""
-        lanes, tenants = {}, []
+        """Weighted fair order across lanes (tenants, or adapters under
+        ``lane_key``) within one class."""
+        lanes, keys = {}, []
         for r in reqs:                     # arrival order within each lane
-            if r.tenant not in lanes:
-                tenants.append(r.tenant)
-                lanes[r.tenant] = deque()
-            lanes[r.tenant].append(r)
-        if len(tenants) <= 1:
+            k = self.lane_key(r)
+            if k not in lanes:
+                keys.append(k)
+                lanes[k] = deque()
+            lanes[k].append(r)
+        if len(keys) <= 1:
             return reqs
         last = self._wfq_last.get(rank)
-        if last in tenants:                # resume AFTER the last-served
-            i = tenants.index(last) + 1
-            tenants = tenants[i:] + tenants[:i]
+        if last in keys:                   # resume AFTER the last-served
+            i = keys.index(last) + 1
+            keys = keys[i:] + keys[:i]
         out = []
         while lanes:
-            for t in tenants:
+            for t in keys:
                 lane = lanes.get(t)
                 if lane is None:
                     continue
-                for _ in range(self.tenant_weights.get(t, 1)):
+                # weights keyed by the lane key; non-string keys (adapter
+                # ids) fall back to their string spelling so flag-file
+                # weights ({"1": 2}) apply to integer lanes too
+                w = self.tenant_weights.get(
+                    t, self.tenant_weights.get(str(t), 1))
+                for _ in range(w):
                     if not lane:
                         break
                     out.append(lane.popleft())
@@ -230,7 +244,7 @@ class Scheduler:
                 self._q.remove(req)
                 admitted.append(req)
                 if self.priority:
-                    self._wfq_last[req.class_rank] = req.tenant
+                    self._wfq_last[req.class_rank] = self.lane_key(req)
         while self._q and self._q[0].state == FINISHED:
             # cancelled while queued (e.g. mid-requeue race where the
             # cancel lost the deque.remove): already resolved, drop

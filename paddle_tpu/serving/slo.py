@@ -45,6 +45,31 @@ def class_rank(priority):
             f"{CLASSES}") from None
 
 
+def resolve_tenant_adapters(flags):
+    """Tenant -> default adapter id mapping from
+    ``FLAGS_serving_tenant_adapters`` (many-model serving,
+    serving/adapters.py): requests that don't name an adapter explicitly
+    are served with their tenant's mapped delta; unmapped tenants get the
+    base model (id 0). Accepts a dict ({"acme": 1}) or the flag-file
+    string spelling ("acme:1,beta:2"). Ids are validated against engine
+    capacity at Engine construction, not here."""
+    raw = flags.get("FLAGS_serving_tenant_adapters", {}) or {}
+    if isinstance(raw, str):
+        mapping = {}
+        for part in raw.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            tenant, _, aid = part.partition(":")
+            if not _ or not aid.strip():
+                raise ValueError(
+                    f"FLAGS_serving_tenant_adapters entry {part!r} is not "
+                    f"'tenant:adapter_id'")
+            mapping[tenant.strip()] = int(aid)
+        return mapping
+    return {str(t): int(a) for t, a in dict(raw).items()}
+
+
 class TokenBucket:
     """Per-tenant token bucket: ``rate`` sustained requests/second with a
     ``burst`` allowance. ``take()`` returns 0.0 when a token was consumed,

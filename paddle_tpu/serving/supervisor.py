@@ -262,6 +262,14 @@ class ServingSupervisor:
         # serves the new weights — a crash must not resurrect old ones
         self._live_params = None          # (params_tree, version) or None
         self._upgrading = False           # inside rolling_restart(new_params)
+        # many-model serving: the fleet's LIVE adapter set (adapter_id ->
+        # (tree, alpha)) — the adapter mirror of _live_params. Every later
+        # spawn (crash respawn, chip-loss reform, rolling restart,
+        # autoscale grow) re-applies it, and a restored snapshot is
+        # reconciled against it, so a crash can never resurrect a stale
+        # adapter set. Maintained by the fleet-level load_adapter/
+        # evict_adapter/swap_adapter below.
+        self._live_adapters = {}
         # per-tenant token buckets at the router (ShedError over-rate)
         rate = (flags.get("FLAGS_serving_tenant_rate", 0.0)
                 if tenant_rate is None else tenant_rate)
@@ -362,6 +370,7 @@ class ServingSupervisor:
             params, version = self._live_params
             eng.swap_params(params, version=version,
                             count=self._upgrading)
+        self._sync_adapters(eng)
         if rep.mgr is not None:
             eng.attach_checkpoint(rep.mgr, every=self.snapshot_every)
         return eng
@@ -394,6 +403,37 @@ class ServingSupervisor:
         if self._factory_arity >= 1:
             return self.engine_factory(rep.idx)
         return self.engine_factory()
+
+    def _sync_adapters(self, eng):
+        """Reconcile an engine's resident adapter set with the fleet's
+        LIVE one. A fresh spawn carries nothing and just loads the live
+        set; a restored snapshot may PREDATE a fleet-level load/evict/
+        swap, so residents the fleet has since evicted are dropped and
+        live adapters are re-applied (content rewrite, zero retraces).
+        All re-application, never new ops — ``count=False`` keeps the
+        ledger counting each fleet-level op exactly once, at apply time.
+
+        An adapter bound to a restored RUNNING slot is left untouched:
+        the resumed stream keeps the delta bits it started under (the
+        same mid-stream guarantee ``_check_adapter_unbound`` enforces on
+        live engines); the next fleet-level op re-syncs it once the
+        slot frees."""
+        reg = getattr(eng, "adapters", None)
+        if reg is None:
+            return
+        for aid in list(reg.resident_ids()):
+            if aid not in self._live_adapters:
+                try:
+                    eng.evict_adapter(aid, count=False)
+                except RuntimeError:
+                    pass              # bound mid-stream: keep its bits
+        for aid, (tree, alpha) in self._live_adapters.items():
+            if reg.resident(aid) and aid != 0:
+                try:
+                    eng.evict_adapter(aid, count=False)
+                except RuntimeError:
+                    continue          # bound mid-stream: keep its bits
+            eng.load_adapter(aid, tree, alpha=alpha, count=False)
 
     # -- routing -------------------------------------------------------------
     def _up(self):
@@ -1102,6 +1142,11 @@ class ServingSupervisor:
                 restored = True
             except Exception:      # incompatible/stale-format snapshot
                 restored = False
+        if restored:
+            # the snapshot replaced the registry content _spawn_engine
+            # just applied — and may predate a fleet-level adapter op;
+            # bring the restored set back to the LIVE one
+            self._sync_adapters(eng)
         rep.engine = eng
         rep.state = "up"
         metrics.bump("respawns")
@@ -1457,6 +1502,56 @@ class ServingSupervisor:
         finally:
             self._upgrading = False
 
+    # -- many-model serving: fleet-level adapter ops -------------------------
+    def _live_adapter_engines(self):
+        engines = [r.engine for r in self._replicas
+                   if r.state == "up" and r.engine is not None]
+        if not engines:
+            raise EngineStoppedError("no live serving replica",
+                                     queue_depth=0, requeued=())
+        return engines
+
+    def load_adapter(self, adapter_id, tree, alpha=None):
+        """Hot-load ``adapter_id`` onto every live replica and record it
+        in the fleet's LIVE adapter set, so every later spawn — crash
+        respawn, chip-loss reform, rolling restart, autoscale grow —
+        comes back serving it (a crash never resurrects a stale set, the
+        ``_live_params`` discipline). Counted ONCE in the ledger; zero
+        retraces and no prefix-cache flush per the engine contract.
+        Runs on the supervising thread (like rolling_restart)."""
+        engines = self._live_adapter_engines()
+        for eng in engines:           # all-or-nothing precheck first
+            eng._require_adapters()._check_id(adapter_id)
+            eng._check_adapter_unbound(adapter_id, "load over")
+        for i, eng in enumerate(engines):
+            eng.load_adapter(adapter_id, tree, alpha=alpha, count=(i == 0))
+        self._live_adapters[int(adapter_id)] = (tree, alpha)
+
+    def evict_adapter(self, adapter_id):
+        """Drop ``adapter_id`` fleet-wide (and from the live set, so
+        respawns stay evicted). Refused — before any replica mutates —
+        while ANY replica has the adapter bound to a running slot."""
+        engines = self._live_adapter_engines()
+        for eng in engines:
+            eng._require_adapters()
+            eng._check_adapter_unbound(adapter_id, "evict")
+        for i, eng in enumerate(engines):
+            eng.evict_adapter(adapter_id, count=(i == 0))
+        self._live_adapters.pop(int(adapter_id), None)
+
+    def swap_adapter(self, adapter_id, tree, alpha=None):
+        """Replace a resident adapter's delta fleet-wide, in place (the
+        adapter analogue of ``rolling_restart(new_params=)`` — but with
+        no drain needed: the unbound precheck is the consistency
+        boundary, and the rewrite is content-only with zero retraces)."""
+        engines = self._live_adapter_engines()
+        for eng in engines:
+            eng._require_adapters()
+            eng._check_adapter_unbound(adapter_id, "swap")
+        for i, eng in enumerate(engines):
+            eng.swap_adapter(adapter_id, tree, alpha=alpha, count=(i == 0))
+        self._live_adapters[int(adapter_id)] = (tree, alpha)
+
     def pending(self):
         """Requests submitted but not yet delivered."""
         with self._lock:
@@ -1546,6 +1641,9 @@ class ServingSupervisor:
         if self._disagg:
             with self._lock:
                 out["transfers_inflight"] = len(self._transfers)
+        if any(getattr(r.engine, "adapters", None) is not None
+               for r in self._replicas if r.engine is not None):
+            out["adapters_live"] = len(self._live_adapters)
         if self._topology is not None:
             out["configured_mp"] = int(self._configured_mp)
             out["degraded_groups"] = degraded_count(self._replicas,
@@ -1563,6 +1661,9 @@ class ServingSupervisor:
                 "params_version": (0 if eng is None
                                    else int(eng.params_version)),
             }
+            if eng is not None and getattr(eng, "adapters", None) is not None:
+                out[f"replica{rep.idx}"]["adapters_resident"] = len(
+                    eng.adapters.resident_ids())
             if self._topology is not None:
                 out[f"replica{rep.idx}"]["mp"] = int(rep.mp)
                 out[f"replica{rep.idx}"]["group"] = list(rep.group)

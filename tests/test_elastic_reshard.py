@@ -338,6 +338,8 @@ def test_monitor_resize_retires_ranks_consistently(tmp_path):
         assert mon.failed_ranks() == []  # retired rank no longer flagged
         # one-shot probe of the retired rank (grow-back scan) still works
         assert mon.failed_ranks(ranks=[2]) == [2]
+    mon.timeout = 5.0  # tight window served its purpose; a loaded runner
+    # can spend >10ms between a beat and the next scan, which is not a failure
     for hb in beats.values():
         hb.beat()  # plan inactive: rank 2 beats again
     assert mon.failed_ranks(ranks=[2]) == []

@@ -743,6 +743,200 @@ def run_spec_rung(quick=True, deterministic=False, rate=None, repeats=3):
     return out
 
 
+def run_adapter_rung(quick=True, deterministic=False, repeats=3):
+    """Many-model serving (serving/adapters.py): N LoRA-class variants of
+    one base checkpoint on ONE paged engine, vs the alternatives a fleet
+    actually has. Two comparisons:
+
+    * HBM ledger — serving N variants as resident low-rank deltas costs
+      ``param_bytes + slab_bytes`` where full weight copies cost
+      ``(N+1) * param_bytes``; reported via the registry's own
+      ``row_bytes``/``slab_bytes`` accounting.
+    * Throughput (timed mode) — mixed-tenant traffic on the adapter
+      engine (every tenant in ONE continuous batch, adapter ids traced
+      per slot) vs the swap-per-tenant baseline: the SAME engine without
+      adapters, requests grouped by tenant, a full ``swap_params`` to
+      that tenant's MERGED weights (W + A@B * alpha/r) between groups —
+      the best case for the baseline (minimum swaps, FCFS within group).
+      The baseline pays the swap uploads, the prefix-cache flush per
+      swap, and one batch-drain tail per tenant; the adapter engine pays
+      a delta GEMM epilogue. Gate: adapter engine >= 1.15x tokens/s.
+
+    Deterministic mode (tier-1): parity — every request in a mixed
+    greedy+sampled mixed-adapter batch is BITWISE its adapter's solo
+    ``generate_from_params(adapters=...)`` stream — plus the frozen-
+    executable gate (hot load/evict/swap between two waves, zero new
+    paged traces) and the HBM ledger; no wall-clock gates."""
+    from paddle_tpu import profiler
+    params, cfg = _paged_model(deterministic)
+    n_ad = 3 if deterministic else 6
+    rank = 4 if deterministic else 8
+    if deterministic:
+        smax, ps, slots, chunk = 48, 8, 4, 8
+        n, repeats = 10, 1
+        short_new = (3, 7)
+    else:
+        smax, ps, slots, chunk = 256, 16, 8, 64
+        n = 48 if quick else 96
+        short_new = (8, 25)
+    pages = slots * smax // ps + 1
+    rng = np.random.default_rng(0)
+    H = cfg.hidden_size
+    dims = {"out_w": (H, H), "up_w": (H, 4 * H), "down_w": (4 * H, H)}
+    alphas = {a: 2.0 * rank for a in range(1, n_ad + 1)}
+    deltas = {
+        a: {t: (rng.standard_normal(
+                    (cfg.num_layers, dims[t][0], rank)).astype(np.float32)
+                * 0.05,
+                rng.standard_normal(
+                    (cfg.num_layers, rank, dims[t][1])).astype(np.float32)
+                * 0.05)
+            for t in dims}
+        for a in range(1, n_ad + 1)}
+
+    def build(adapters=True):
+        kw = dict(params=params, config=cfg, num_slots=slots,
+                  max_seq_len=smax, page_size=ps, num_pages=pages,
+                  prefill_chunk=chunk, max_queue=2 * n + 2)
+        if adapters:
+            kw.update(adapter_slots=n_ad, adapter_rank=rank)
+        eng = serving.Engine(**kw)
+        if adapters:
+            for a in range(1, n_ad + 1):
+                eng.load_adapter(a, deltas[a], alpha=alphas[a])
+        return eng
+
+    def reqs(shift=0, sampled=deterministic):
+        out = []
+        for i in range(n):
+            plen = int(rng.integers(4, smax // 4))
+            kw = {"adapter": (i + shift) % (n_ad + 1)}
+            if sampled and i % 3 == 1:
+                kw.update(do_sample=True, temperature=0.8, top_p=0.9,
+                          seed=31 + i)
+            out.append(serving.Request(
+                rng.integers(0, cfg.vocab_size, plen),
+                max_new_tokens=int(rng.integers(*short_new)), **kw))
+        return out
+
+    param_bytes = sum(int(np.prod(x.shape)) * x.dtype.itemsize
+                      for x in jax.tree_util.tree_leaves(params))
+    eng = build()
+    hbm = {
+        "param_bytes": param_bytes,
+        "adapter_row_bytes": eng.adapters.row_bytes(),
+        "adapter_slab_bytes": eng.adapters.slab_bytes(),
+        "adapter_engine_bytes": param_bytes + eng.adapters.slab_bytes(),
+        "full_copy_fleet_bytes": (n_ad + 1) * param_bytes,
+    }
+    hbm["ratio"] = round(hbm["adapter_engine_bytes"]
+                         / hbm["full_copy_fleet_bytes"], 4)
+
+    if deterministic:
+        profiler.reset_serving_counters()
+        w1 = reqs()
+        res1 = eng.run(w1)
+        slabs = eng.adapters.device_slabs()
+        parity = True
+        for r in w1:
+            kw = {}
+            if r.do_sample:
+                kw = dict(do_sample=True, temperature=r.temperature,
+                          top_p=r.top_p, seed=r.seed)
+            ref = generate_from_params(
+                params, np.asarray(r.prompt)[None], cfg,
+                max_new_tokens=r.max_new_tokens,
+                adapters=(r.adapter or 0, slabs), **kw)
+            got = res1[r.request_id].tokens
+            ref = np.asarray(ref._data)[0, len(r.prompt):].tolist()
+            parity = parity and got == ref[:len(got)]
+        c1 = profiler.serving_counters()
+        # hot ops between waves: content-only rewrites, zero new traces
+        eng.swap_adapter(1, deltas[2], alpha=alphas[2])
+        eng.evict_adapter(3)
+        eng.load_adapter(3, deltas[1], alpha=alphas[1])
+        eng.run(reqs(shift=1))
+        c2 = profiler.serving_counters()
+        frozen = c1["paged_traces"] == c2["paged_traces"]
+        out = {"bench": "serving_adapter_smoke", "requests": 2 * n,
+               "backend": jax.default_backend(), "deterministic": True,
+               "adapters": n_ad, "rank": rank, "parity": parity,
+               "trace_frozen": frozen,
+               "paged_traces": c2["paged_traces"],
+               "adapter_ops": {"loads": c2["adapter_loads"],
+                               "evicts": c2["adapter_evicts"],
+                               "swaps": c2["adapter_swaps"]},
+               "hbm": hbm}
+        print(json.dumps(out))
+        return out
+
+    # -- timed: one mixed-tenant batch vs swap-per-tenant ------------------
+    def merged_params(a):
+        blocks = dict(params["blocks"])
+        for t, (A, B) in deltas[a].items():
+            scale = alphas[a] / rank
+            blocks[t] = np.asarray(blocks[t]) + scale * np.einsum(
+                "lkr,lrf->lkf", A, B)
+        return {**params, "blocks": blocks}
+
+    merged = {a: merged_params(a) for a in range(1, n_ad + 1)}
+    work = reqs(sampled=False)
+    by_tenant = {}
+    for r in work:
+        by_tenant.setdefault(r.adapter, []).append(r)
+
+    def clone(r, adapter=True):
+        return serving.Request(r.prompt, max_new_tokens=r.max_new_tokens,
+                               adapter=r.adapter if adapter else None)
+
+    best = {}
+    for _ in range(max(1, repeats)):
+        # adapter engine: every tenant shares one continuous batch
+        eng = build()
+        eng.generate([np.arange(1, ln + 1)
+                      for ln in sorted({ps + 1, *eng._chunk_ladder})],
+                     max_new_tokens=2)
+        batch = [clone(r) for r in work]
+        t0 = time.perf_counter()
+        res = eng.run(batch)
+        wall = time.perf_counter() - t0
+        tok = sum(len(v.tokens) for v in res.values())
+        rec = {"tokens": tok, "wall_s": round(wall, 3),
+               "tokens_per_s": round(tok / wall, 1)}
+        if "adapter" not in best or rec["wall_s"] < best["adapter"]["wall_s"]:
+            best["adapter"] = rec
+
+        # swap baseline: per-tenant groups on an adapter-less engine,
+        # swap_params to the tenant's merged weights between groups
+        eng = build(adapters=False)
+        eng.generate([np.arange(1, ln + 1)
+                      for ln in sorted({ps + 1, *eng._chunk_ladder})],
+                     max_new_tokens=2)
+        t0 = time.perf_counter()
+        tok = 0
+        for a in sorted(by_tenant):
+            if a != 0:
+                eng.swap_params(merged[a])
+            res = eng.run([clone(r, adapter=False) for r in by_tenant[a]])
+            tok += sum(len(v.tokens) for v in res.values())
+        wall = time.perf_counter() - t0
+        eng.swap_params(params)       # leave the engine on base weights
+        rec = {"tokens": tok, "wall_s": round(wall, 3),
+               "tokens_per_s": round(tok / wall, 1),
+               "weight_swaps": len(by_tenant) - 1}
+        if "swap" not in best or rec["wall_s"] < best["swap"]["wall_s"]:
+            best["swap"] = rec
+
+    out = {"bench": "serving_adapter_smoke", "requests": n,
+           "backend": jax.default_backend(), "adapters": n_ad,
+           "rank": rank, "hbm": hbm,
+           "adapter_engine": best["adapter"], "swap_baseline": best["swap"]}
+    out["speedup"] = round(best["adapter"]["tokens_per_s"]
+                           / max(best["swap"]["tokens_per_s"], 1e-9), 2)
+    print(json.dumps(out))
+    return out
+
+
 def _drive_sup(sup, work, seed0=0):
     """Drive a supervisor fleet over backlogged ``work``; returns
     (token lists in workload order, wall seconds, emission stamps)."""
@@ -1016,6 +1210,36 @@ if __name__ == "__main__":
                   f"{out['spec']['accept_rate'] * 100:.0f}%, streams bitwise "
                   f"the plain engine's: "
                   f"{'PASS' if out['parity'] else 'FAIL'}")
+        sys.exit(0)
+    if "--adapters" in sys.argv or "--adapters-det" in sys.argv:
+        # many-model serving: N LoRA-class adapters on one paged engine
+        quick = "--full" not in sys.argv
+        det = "--adapters-det" in sys.argv
+        out = run_adapter_rung(quick=quick, deterministic=det)
+        ratio = out["hbm"]["ratio"]
+        ok_hbm = ratio < 0.5
+        if det:
+            ok = out["parity"] and out["trace_frozen"]
+            print(f"# many-model serving (deterministic, "
+                  f"{out['adapters']} adapters r{out['rank']}): mixed-"
+                  f"adapter batch bitwise vs solo per-adapter reference: "
+                  f"{'PASS' if out['parity'] else 'FAIL'}, executables "
+                  f"frozen across hot load/evict/swap "
+                  f"(paged_traces={out['paged_traces']}): "
+                  f"{'PASS' if out['trace_frozen'] else 'FAIL'}, HBM "
+                  f"{ratio:.3f}x of full-copy fleet "
+                  f"({'PASS' if ok_hbm else 'FAIL'} < 0.5) "
+                  f"({'PASS' if ok and ok_hbm else 'FAIL'} overall)")
+        else:
+            ok_sp = out["speedup"] >= 1.15
+            print(f"# many-model serving ({out['adapters']} adapters "
+                  f"r{out['rank']} on one engine vs swap-per-tenant): "
+                  f"{out['speedup']:.2f}x tokens/s "
+                  f"({'PASS' if ok_sp else 'FAIL'} >= 1.15x gate), HBM "
+                  f"{out['hbm']['adapter_engine_bytes']} vs "
+                  f"{out['hbm']['full_copy_fleet_bytes']} bytes for "
+                  f"{out['adapters'] + 1} variants = {ratio:.3f}x "
+                  f"({'PASS' if ok_hbm else 'FAIL'} < 0.5)")
         sys.exit(0)
     if "--quant" in sys.argv:
         # quantized vs fp at equal KV memory: int8 weights + int8 KV
